@@ -1,0 +1,17 @@
+"""Test configuration: force an 8-device virtual CPU mesh.
+
+Multi-chip TPU hardware is unavailable in CI; all sharding/collective tests
+run on XLA's host platform with 8 virtual devices, which exercises the same
+mesh/collective code paths the TPU build uses (the multi-"node" one-host
+trick, mirroring the reference's thread-based integration tests,
+/root/reference/torchft/manager_integ_test.py:144-154).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

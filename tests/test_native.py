@@ -1,0 +1,146 @@
+"""Tests for the ctypes bridge to the C++ control plane.
+
+Python-side mirror of the reference's Rust inline tests
+(/root/reference/src/lighthouse.rs:463-613, src/manager.rs:398-477), driven
+through the same bindings the Manager runtime uses.
+"""
+
+import threading
+
+import pytest
+
+from torchft_tpu._native import (
+    Lighthouse,
+    ManagerClient,
+    ManagerServer,
+    NativeError,
+    Store,
+    StoreClient,
+)
+
+
+def test_store_set_get():
+    server = Store(bind="127.0.0.1:0")
+    try:
+        a = StoreClient(server.address())
+        b = StoreClient(server.address())
+        a.set("key", b"value")
+        assert b.get("key", timeout_ms=2000) == b"value"
+        with pytest.raises(NativeError):
+            b.get("missing", timeout_ms=50)
+    finally:
+        server.shutdown()
+
+
+def test_store_blocking_get():
+    server = Store(bind="127.0.0.1:0")
+    try:
+        a = StoreClient(server.address())
+        b = StoreClient(server.address())
+        t = threading.Timer(0.1, lambda: a.set("late", b"v"))
+        t.start()
+        assert b.get("late", timeout_ms=5000) == b"v"
+        t.join()
+    finally:
+        server.shutdown()
+
+
+def test_two_group_quorum_and_heal():
+    lh = Lighthouse(bind="127.0.0.1:0", min_replicas=2, join_timeout_ms=100,
+                    quorum_tick_ms=10)
+    try:
+        m_a = ManagerServer("group_a", lh.address(), store_addr="store_a",
+                            bind="127.0.0.1:0", world_size=1)
+        m_b = ManagerServer("group_b", lh.address(), store_addr="store_b",
+                            bind="127.0.0.1:0", world_size=1)
+        results = {}
+
+        def run(name, server, step):
+            client = ManagerClient(server.address())
+            results[name] = client.quorum(
+                rank=0, step=step, checkpoint_server_addr=f"ckpt_{name}",
+                timeout_ms=10_000)
+
+        # group_a is at step 5, group_b lags at step 3 → b heals from a.
+        ta = threading.Thread(target=run, args=("a", m_a, 5))
+        tb = threading.Thread(target=run, args=("b", m_b, 3))
+        ta.start(); tb.start(); ta.join(); tb.join()
+
+        ra, rb = results["a"], results["b"]
+        assert ra.quorum_id == rb.quorum_id
+        assert ra.max_step == rb.max_step == 5
+        assert ra.replica_world_size == rb.replica_world_size == 2
+        assert ra.replica_rank == 0 and rb.replica_rank == 1
+        assert not ra.heal and rb.heal
+        assert rb.recover_manager_address == m_a.address()
+        assert ra.max_rank == 0 and rb.max_rank is None
+        # Both groups rendezvous on participant[0]'s store.
+        assert ra.store_address == rb.store_address == "store_a"
+
+        # Healer fetches the primary's per-rank checkpoint address.
+        healer = ManagerClient(ra.recover_manager_address)
+        assert healer.checkpoint_address(0) == "ckpt_a"
+
+        # Commit barrier: world_size=1 per group, immediate decision.
+        ca = ManagerClient(m_a.address())
+        assert ca.should_commit(0, 5, True, timeout_ms=5000)
+        assert not ca.should_commit(0, 6, False, timeout_ms=5000)
+
+        m_a.shutdown()
+        m_b.shutdown()
+    finally:
+        lh.shutdown()
+
+
+def test_local_rank_barrier():
+    """All world_size local ranks must arrive before quorum returns."""
+    lh = Lighthouse(bind="127.0.0.1:0", min_replicas=1, join_timeout_ms=100,
+                    quorum_tick_ms=10)
+    try:
+        m = ManagerServer("group", lh.address(), bind="127.0.0.1:0",
+                          world_size=2)
+        results = [None, None]
+
+        def run(rank):
+            client = ManagerClient(m.address())
+            results[rank] = client.quorum(
+                rank=rank, step=1, checkpoint_server_addr=f"ckpt_{rank}",
+                timeout_ms=10_000)
+
+        t0 = threading.Thread(target=run, args=(0,))
+        t1 = threading.Thread(target=run, args=(1,))
+        t0.start(); t1.start(); t0.join(); t1.join()
+        assert results[0].quorum_id == results[1].quorum_id
+        assert results[0].replica_world_size == 1
+
+        # should_commit is an AND across local ranks.
+        votes = [None, None]
+
+        def vote(rank, ok):
+            client = ManagerClient(m.address())
+            votes[rank] = client.should_commit(rank, 1, ok, timeout_ms=10_000)
+
+        t0 = threading.Thread(target=vote, args=(0, True))
+        t1 = threading.Thread(target=vote, args=(1, False))
+        t0.start(); t1.start(); t0.join(); t1.join()
+        assert votes == [False, False]
+        m.shutdown()
+    finally:
+        lh.shutdown()
+
+
+def test_lighthouse_status():
+    lh = Lighthouse(bind="127.0.0.1:0", min_replicas=1, join_timeout_ms=50,
+                    quorum_tick_ms=10)
+    try:
+        m = ManagerServer("solo", lh.address(), bind="127.0.0.1:0",
+                          world_size=1)
+        c = ManagerClient(m.address())
+        c.quorum(rank=0, step=1, checkpoint_server_addr="x",
+                 timeout_ms=10_000)
+        status = lh.status()
+        assert status["quorum_id"] >= 1
+        assert [mm["replica_id"] for mm in status["members"]] == ["solo"]
+        m.shutdown()
+    finally:
+        lh.shutdown()

@@ -1,0 +1,290 @@
+// C ABI for Python ctypes bindings.
+//
+// Plays the role of the reference's pyo3 bridge (/root/reference/src/lib.rs):
+// exposes embeddable Lighthouse and Manager servers, a blocking
+// ManagerClient (quorum / checkpoint_address / should_commit / kill,
+// reference :105-181), and the KV store. ctypes releases the GIL for the
+// duration of every foreign call, giving the same GIL-released blocking
+// behavior as the reference's py.allow_threads (:48,91,112).
+//
+// Convention: functions return 0 on success, -1 on error with *err set to a
+// malloc'd message the caller frees with tft_free. All returned strings are
+// malloc'd copies.
+
+#include <string.h>
+
+#include <string>
+
+#include "lighthouse.h"
+#include "manager.h"
+#include "rpc.h"
+#include "store.h"
+#include "torchft.pb.h"
+
+using namespace torchft_tpu;
+
+namespace {
+char* dup_str(const std::string& s) {
+  char* p = (char*)malloc(s.size() + 1);
+  memcpy(p, s.data(), s.size());
+  p[s.size()] = 0;
+  return p;
+}
+int fail(char** err, const std::string& msg) {
+  if (err) *err = dup_str(msg);
+  return -1;
+}
+}  // namespace
+
+extern "C" {
+
+void tft_free(void* p) { free(p); }
+
+// ----------------------------------------------------------------- lighthouse
+
+void* tft_lighthouse_new(const char* bind, uint64_t min_replicas,
+                         int64_t join_timeout_ms, int64_t quorum_tick_ms,
+                         char** err) {
+  try {
+    LighthouseOpt opt;
+    opt.bind = bind;
+    opt.min_replicas = min_replicas;
+    opt.join_timeout_ms = join_timeout_ms;
+    opt.quorum_tick_ms = quorum_tick_ms;
+    return new Lighthouse(opt);
+  } catch (const std::exception& e) {
+    fail(err, e.what());
+    return nullptr;
+  }
+}
+
+char* tft_lighthouse_address(void* h) {
+  return dup_str(((Lighthouse*)h)->address());
+}
+
+void tft_lighthouse_shutdown(void* h) { ((Lighthouse*)h)->shutdown(); }
+
+void tft_lighthouse_free(void* h) { delete (Lighthouse*)h; }
+
+// -------------------------------------------------------------------- manager
+
+void* tft_manager_new(const char* replica_id, const char* lighthouse_addr,
+                      const char* bind, const char* store_addr,
+                      uint64_t world_size, int64_t heartbeat_ms, char** err) {
+  try {
+    ManagerOpt opt;
+    opt.replica_id = replica_id;
+    opt.lighthouse_addr = lighthouse_addr;
+    opt.bind = bind;
+    opt.store_addr = store_addr;
+    opt.world_size = world_size;
+    opt.heartbeat_ms = heartbeat_ms;
+    return new ManagerServer(opt);
+  } catch (const std::exception& e) {
+    fail(err, e.what());
+    return nullptr;
+  }
+}
+
+char* tft_manager_address(void* h) {
+  return dup_str(((ManagerServer*)h)->address());
+}
+
+void tft_manager_shutdown(void* h) { ((ManagerServer*)h)->shutdown(); }
+
+void tft_manager_free(void* h) { delete (ManagerServer*)h; }
+
+// ---------------------------------------------------------------------- store
+
+void* tft_store_new(const char* bind, char** err) {
+  try {
+    return new StoreServer(bind);
+  } catch (const std::exception& e) {
+    fail(err, e.what());
+    return nullptr;
+  }
+}
+
+char* tft_store_address(void* h) {
+  return dup_str(((StoreServer*)h)->address());
+}
+
+void tft_store_shutdown(void* h) { ((StoreServer*)h)->shutdown(); }
+
+void tft_store_free(void* h) { delete (StoreServer*)h; }
+
+void* tft_store_client_new(const char* addr, int64_t connect_timeout_ms,
+                           char** err) {
+  try {
+    return new StoreClient(addr, connect_timeout_ms);
+  } catch (const std::exception& e) {
+    fail(err, e.what());
+    return nullptr;
+  }
+}
+
+int tft_store_client_set(void* h, const char* key, const void* value,
+                         size_t value_len, char** err) {
+  try {
+    ((StoreClient*)h)->set(key, std::string((const char*)value, value_len));
+    return 0;
+  } catch (const std::exception& e) {
+    return fail(err, e.what());
+  }
+}
+
+int tft_store_client_get(void* h, const char* key, int64_t timeout_ms,
+                         void** value, size_t* value_len, char** err) {
+  try {
+    std::string v = ((StoreClient*)h)->get(key, timeout_ms);
+    *value = malloc(v.size() ? v.size() : 1);
+    memcpy(*value, v.data(), v.size());
+    *value_len = v.size();
+    return 0;
+  } catch (const std::exception& e) {
+    return fail(err, e.what());
+  }
+}
+
+void tft_store_client_free(void* h) { delete (StoreClient*)h; }
+
+// ------------------------------------------------------------- manager client
+
+struct TftQuorumResult {
+  int64_t quorum_id;
+  char* recover_manager_address;
+  char* store_address;
+  int64_t max_step;
+  int32_t has_max_rank;
+  int64_t max_rank;
+  int64_t max_world_size;
+  int64_t replica_rank;
+  int64_t replica_world_size;
+  int32_t heal;
+};
+
+void* tft_manager_client_new(const char* addr, int64_t connect_timeout_ms,
+                             char** err) {
+  try {
+    return new RpcClient(addr, connect_timeout_ms);
+  } catch (const std::exception& e) {
+    fail(err, e.what());
+    return nullptr;
+  }
+}
+
+int tft_manager_client_quorum(void* h, int64_t rank, int64_t step,
+                              const char* checkpoint_server_addr,
+                              int64_t timeout_ms, TftQuorumResult* out,
+                              char** err) {
+  ManagerQuorumRequest req;
+  req.set_rank(rank);
+  req.set_step(step);
+  req.set_checkpoint_server_addr(checkpoint_server_addr);
+  std::string resp, e;
+  if (!((RpcClient*)h)
+           ->call(kManagerQuorum, req.SerializeAsString(), &resp, &e,
+                  timeout_ms))
+    return fail(err, e);
+  ManagerQuorumResponse r;
+  if (!r.ParseFromString(resp)) return fail(err, "bad ManagerQuorumResponse");
+  out->quorum_id = r.quorum_id();
+  out->recover_manager_address = dup_str(r.recover_manager_address());
+  out->store_address = dup_str(r.store_address());
+  out->max_step = r.max_step();
+  out->has_max_rank = r.has_max_rank();
+  out->max_rank = r.max_rank();
+  out->max_world_size = r.max_world_size();
+  out->replica_rank = r.replica_rank();
+  out->replica_world_size = r.replica_world_size();
+  out->heal = r.heal();
+  return 0;
+}
+
+int tft_manager_client_checkpoint_address(void* h, int64_t rank,
+                                          int64_t timeout_ms, char** addr,
+                                          char** err) {
+  CheckpointAddressRequest req;
+  req.set_rank(rank);
+  std::string resp, e;
+  if (!((RpcClient*)h)
+           ->call(kManagerCheckpointAddress, req.SerializeAsString(), &resp,
+                  &e, timeout_ms))
+    return fail(err, e);
+  CheckpointAddressResponse r;
+  if (!r.ParseFromString(resp))
+    return fail(err, "bad CheckpointAddressResponse");
+  *addr = dup_str(r.checkpoint_server_address());
+  return 0;
+}
+
+int tft_manager_client_should_commit(void* h, int64_t rank, int64_t step,
+                                     int32_t should_commit, int64_t timeout_ms,
+                                     int32_t* decision, char** err) {
+  ShouldCommitRequest req;
+  req.set_rank(rank);
+  req.set_step(step);
+  req.set_should_commit(should_commit != 0);
+  std::string resp, e;
+  if (!((RpcClient*)h)
+           ->call(kManagerShouldCommit, req.SerializeAsString(), &resp, &e,
+                  timeout_ms))
+    return fail(err, e);
+  ShouldCommitResponse r;
+  if (!r.ParseFromString(resp)) return fail(err, "bad ShouldCommitResponse");
+  *decision = r.should_commit() ? 1 : 0;
+  return 0;
+}
+
+int tft_manager_client_kill(void* h, const char* msg, char** err) {
+  KillRequest req;
+  req.set_msg(msg);
+  std::string resp, e;
+  // The target exits before replying; transport errors are expected.
+  ((RpcClient*)h)->call(kManagerKill, req.SerializeAsString(), &resp, &e, 2000);
+  return 0;
+}
+
+void tft_manager_client_free(void* h) { delete (RpcClient*)h; }
+
+// ----------------------------------------------------------- lighthouse client
+
+// Status as a JSON string (Python side has no protobuf runtime for our proto;
+// JSON keeps the bridge dependency-free).
+int tft_lighthouse_client_status(const char* addr, int64_t timeout_ms,
+                                 char** json, char** err) {
+  try {
+    RpcClient client(addr, timeout_ms > 0 ? timeout_ms : 5000);
+    std::string resp, e;
+    if (!client.call(kLighthouseStatus, StatusRequest().SerializeAsString(),
+                     &resp, &e, timeout_ms))
+      return fail(err, e);
+    StatusResponse r;
+    if (!r.ParseFromString(resp)) return fail(err, "bad StatusResponse");
+    std::string out = "{\"quorum_id\":" + std::to_string(r.quorum_id()) +
+                      ",\"quorum_age_ms\":" + std::to_string(r.quorum_age_ms()) +
+                      ",\"members\":[";
+    for (int i = 0; i < r.members_size(); i++) {
+      const auto& m = r.members(i);
+      if (i) out += ",";
+      out += "{\"replica_id\":\"" + m.member().replica_id() +
+             "\",\"address\":\"" + m.member().address() + "\",\"step\":" +
+             std::to_string(m.member().step()) + ",\"world_size\":" +
+             std::to_string(m.member().world_size()) +
+             ",\"heartbeat_age_ms\":" + std::to_string(m.heartbeat_age_ms()) +
+             "}";
+    }
+    out += "],\"joining\":[";
+    for (int i = 0; i < r.joining_size(); i++) {
+      if (i) out += ",";
+      out += "\"" + r.joining(i) + "\"";
+    }
+    out += "]}";
+    *json = dup_str(out);
+    return 0;
+  } catch (const std::exception& e) {
+    return fail(err, e.what());
+  }
+}
+
+}  // extern "C"

@@ -1,0 +1,246 @@
+// Control-plane smoke tests (run by scripts/test.sh, the cargo-test analogue).
+// Mirrors the reference's Rust inline tests: quorum_changed pure-function test
+// (src/lighthouse.rs:584-613), lighthouse client-server e2e on ephemeral ports
+// (:542-582), manager should_commit voting with concurrent clients and a real
+// lighthouse+manager pair (src/manager.rs:398-477).
+#include <assert.h>
+
+#include <cstdio>
+#include <thread>
+#include <vector>
+
+#include "lighthouse.h"
+#include "manager.h"
+#include "rpc.h"
+#include "store.h"
+#include "torchft.pb.h"
+
+using namespace torchft_tpu;
+
+static QuorumMember member(const std::string& id, int64_t step) {
+  QuorumMember m;
+  m.set_replica_id(id);
+  m.set_step(step);
+  m.set_world_size(1);
+  return m;
+}
+
+static void test_quorum_changed() {
+  Quorum a, b;
+  *a.add_participants() = member("a", 1);
+  *b.add_participants() = member("a", 2);
+  assert(!Lighthouse::quorum_changed(a, b));  // step change alone: no change
+  *b.add_participants() = member("b", 2);
+  assert(Lighthouse::quorum_changed(a, b));
+  printf("test_quorum_changed ok\n");
+}
+
+static void test_store() {
+  StoreServer server("127.0.0.1:0");
+  StoreClient c1(server.address(), 2000);
+  StoreClient c2(server.address(), 2000);
+  std::thread t([&] { c1.set("k", "v"); });
+  assert(c2.get("k", 5000) == "v");
+  t.join();
+  bool threw = false;
+  try {
+    c2.get("missing", 50);
+  } catch (...) {
+    threw = true;
+  }
+  assert(threw);
+  server.shutdown();
+  printf("test_store ok\n");
+}
+
+// Two replica groups (world_size=1 each) reach a quorum; both see each other.
+static void test_lighthouse_manager_e2e() {
+  LighthouseOpt lopt;
+  lopt.bind = "127.0.0.1:0";
+  lopt.min_replicas = 2;
+  lopt.join_timeout_ms = 100;
+  lopt.quorum_tick_ms = 10;
+  Lighthouse lh(lopt);
+
+  auto make_manager = [&](const std::string& id) {
+    ManagerOpt mopt;
+    mopt.replica_id = id;
+    mopt.lighthouse_addr = lh.address();
+    mopt.bind = "127.0.0.1:0";
+    mopt.store_addr = "store-" + id;
+    mopt.world_size = 1;
+    return new ManagerServer(mopt);
+  };
+  ManagerServer* m_a = make_manager("group_a");
+  ManagerServer* m_b = make_manager("group_b");
+
+  struct R {
+    ManagerQuorumResponse resp;
+    bool ok = false;
+  };
+  auto quorum_call = [&](ManagerServer* m, int64_t step, R* out) {
+    RpcClient c(m->address(), 2000);
+    ManagerQuorumRequest req;
+    req.set_rank(0);
+    req.set_step(step);
+    req.set_checkpoint_server_addr("ckpt:" + std::to_string(step));
+    std::string resp, err;
+    if (!c.call(kManagerQuorum, req.SerializeAsString(), &resp, &err, 10'000)) {
+      fprintf(stderr, "quorum failed: %s\n", err.c_str());
+      return;
+    }
+    out->ok = out->resp.ParseFromString(resp);
+  };
+
+  R ra, rb;
+  std::thread ta([&] { quorum_call(m_a, 1, &ra); });
+  std::thread tb([&] { quorum_call(m_b, 1, &rb); });
+  ta.join();
+  tb.join();
+  assert(ra.ok && rb.ok);
+  assert(ra.resp.quorum_id() == rb.resp.quorum_id());
+  assert(ra.resp.replica_world_size() == 2);
+  assert(ra.resp.max_step() == 1);
+  assert(ra.resp.replica_rank() == 0);  // "group_a" sorts first
+  assert(rb.resp.replica_rank() == 1);
+  // Step-1 init sync: exactly the non-primary groups heal. Primaries are
+  // spread by replica_rank, so the two groups pick different primaries and
+  // at most one heals from the other.
+  assert(ra.resp.store_address() == "store-group_a");
+  assert(rb.resp.store_address() == "store-group_a");
+
+  // should_commit barrier across local ranks: world_size=1 → immediate.
+  {
+    RpcClient c(m_a->address(), 2000);
+    ShouldCommitRequest req;
+    req.set_rank(0);
+    req.set_step(1);
+    req.set_should_commit(true);
+    std::string resp, err;
+    assert(c.call(kManagerShouldCommit, req.SerializeAsString(), &resp, &err,
+                  5000));
+    ShouldCommitResponse r;
+    assert(r.ParseFromString(resp));
+    assert(r.should_commit());
+  }
+
+  // Checkpoint address registry was refreshed at quorum.
+  {
+    RpcClient c(m_b->address(), 2000);
+    CheckpointAddressRequest req;
+    req.set_rank(0);
+    std::string resp, err;
+    assert(c.call(kManagerCheckpointAddress, req.SerializeAsString(), &resp,
+                  &err, 5000));
+    CheckpointAddressResponse r;
+    assert(r.ParseFromString(resp));
+    assert(r.checkpoint_server_address() == "ckpt:1");
+  }
+
+  delete m_a;
+  delete m_b;
+  printf("test_lighthouse_manager_e2e ok\n");
+}
+
+// A lagging group (step 2 vs 5) must heal from the max-step primary.
+static void test_heal_decision() {
+  LighthouseOpt lopt;
+  lopt.bind = "127.0.0.1:0";
+  lopt.min_replicas = 2;
+  lopt.join_timeout_ms = 100;
+  lopt.quorum_tick_ms = 10;
+  Lighthouse lh(lopt);
+
+  ManagerOpt ma;
+  ma.replica_id = "healthy";
+  ma.lighthouse_addr = lh.address();
+  ma.bind = "127.0.0.1:0";
+  ma.world_size = 1;
+  ManagerServer m_h(ma);
+  ManagerOpt mb = ma;
+  mb.replica_id = "lagging";
+  ManagerServer m_l(mb);
+
+  ManagerQuorumResponse rh, rl;
+  bool ok_h = false, ok_l = false;
+  auto call = [](ManagerServer* m, int64_t step, ManagerQuorumResponse* out,
+                 bool* ok) {
+    RpcClient c(m->address(), 2000);
+    ManagerQuorumRequest req;
+    req.set_rank(0);
+    req.set_step(step);
+    req.set_checkpoint_server_addr("ckpt");
+    std::string resp, err;
+    if (c.call(kManagerQuorum, req.SerializeAsString(), &resp, &err, 10'000))
+      *ok = out->ParseFromString(resp);
+  };
+  std::thread th([&] { call(&m_h, 5, &rh, &ok_h); });
+  std::thread tl([&] { call(&m_l, 2, &rl, &ok_l); });
+  th.join();
+  tl.join();
+  assert(ok_h && ok_l);
+  assert(rh.max_step() == 5 && rl.max_step() == 5);
+  assert(!rh.heal());
+  assert(rl.heal());
+  assert(rl.recover_manager_address() == m_h.address());
+  assert(rh.max_world_size() == 1 && rh.has_max_rank() && rh.max_rank() == 0);
+  assert(!rl.has_max_rank());
+  printf("test_heal_decision ok\n");
+}
+
+// Fast quorum: once a quorum exists, unchanged membership re-forms instantly
+// (no join_timeout wait) and quorum_id is stable; a member death bumps it.
+static void test_fast_quorum_and_id_bump() {
+  LighthouseOpt lopt;
+  lopt.bind = "127.0.0.1:0";
+  lopt.min_replicas = 1;
+  lopt.join_timeout_ms = 200;
+  lopt.quorum_tick_ms = 10;
+  Lighthouse lh(lopt);
+
+  auto join = [&](const std::string& id, int64_t step) {
+    RpcClient c(lh.address(), 2000);
+    LighthouseQuorumRequest req;
+    *req.mutable_requester() = member(id, step);
+    std::string resp, err;
+    assert(c.call(kLighthouseQuorum, req.SerializeAsString(), &resp, &err,
+                  10'000));
+    LighthouseQuorumResponse r;
+    assert(r.ParseFromString(resp));
+    return r.quorum();
+  };
+
+  Quorum q1_a, q1_b;
+  std::thread t1([&] { q1_a = join("a", 1); });
+  std::thread t2([&] { q1_b = join("b", 1); });
+  t1.join();
+  t2.join();
+  assert(q1_a.quorum_id() == q1_b.quorum_id());
+  assert(q1_a.participants_size() == 2);
+
+  // Same membership again: fast path, same quorum_id.
+  int64_t t_start = now_ms();
+  Quorum q2_a, q2_b;
+  std::thread t3([&] { q2_a = join("a", 2); });
+  std::thread t4([&] { q2_b = join("b", 2); });
+  t3.join();
+  t4.join();
+  assert(q2_a.quorum_id() == q1_a.quorum_id());
+  assert(now_ms() - t_start < 150);  // did not wait out join_timeout_ms
+
+  // "b" died: only "a" joins; must wait join_timeout, then id bumps.
+  Quorum q3 = join("a", 3);
+  assert(q3.participants_size() == 1);
+  assert(q3.quorum_id() == q1_a.quorum_id() + 1);
+  printf("test_fast_quorum_and_id_bump ok\n");
+}
+
+int main() {
+  test_quorum_changed();
+  test_store();
+  test_lighthouse_manager_e2e();
+  test_heal_decision();
+  test_fast_quorum_and_id_bump();
+  printf("ALL CORE TESTS PASSED\n");
+  return 0;
+}

@@ -1,0 +1,237 @@
+#include "lighthouse.h"
+
+#include <algorithm>
+#include <cstring>
+#include <set>
+#include <sstream>
+
+namespace torchft_tpu {
+
+Lighthouse::Lighthouse(const LighthouseOpt& opt) : opt_(opt) {
+  server_ = std::make_unique<RpcServer>(
+      opt.bind,
+      [this](uint8_t m, const std::string& req, std::string* resp,
+             std::string* err) { return handle(m, req, resp, err); },
+      [this](const std::string& req) { return handle_http(req); });
+  tick_thread_ = std::thread([this] {
+    std::unique_lock<std::mutex> lk(mu_);
+    while (!shutdown_) {
+      cv_.wait_for(lk, std::chrono::milliseconds(opt_.quorum_tick_ms));
+      if (!shutdown_) tick();
+    }
+  });
+}
+
+Lighthouse::~Lighthouse() { shutdown(); }
+
+void Lighthouse::shutdown() {
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (shutdown_) return;
+    shutdown_ = true;
+  }
+  cv_.notify_all();
+  if (tick_thread_.joinable()) tick_thread_.join();
+  server_->shutdown();
+}
+
+bool Lighthouse::quorum_changed(const Quorum& a, const Quorum& b) {
+  // Membership (replica_id set) comparison only — step changes alone do not
+  // constitute a new quorum (mirrors reference src/lighthouse.rs:81-86).
+  std::set<std::string> sa, sb;
+  for (const auto& m : a.participants()) sa.insert(m.replica_id());
+  for (const auto& m : b.participants()) sb.insert(m.replica_id());
+  return sa != sb;
+}
+
+bool Lighthouse::quorum_valid_locked() const {
+  if (participants_.empty()) return false;
+  if (has_prev_quorum_) {
+    // Fast quorum: every member of the previous quorum has re-joined, so
+    // membership is unchanged and there is no reason to wait for stragglers
+    // (reference src/lighthouse.rs:118-131).
+    bool all_present = true;
+    for (const auto& m : prev_quorum_.participants())
+      if (!participants_.count(m.replica_id())) {
+        all_present = false;
+        break;
+      }
+    if (all_present) return true;
+  }
+  if (participants_.size() < opt_.min_replicas) return false;
+  // Membership is changing: give stragglers join_timeout_ms (measured from
+  // the first join of this round) before forming the smaller/different
+  // quorum (reference src/lighthouse.rs:133-156).
+  return now_ms() - first_join_ms_ >= opt_.join_timeout_ms;
+}
+
+bool Lighthouse::tick() {
+  if (!quorum_valid_locked()) return false;
+  Quorum q;
+  // Deterministic participant order: sorted by replica_id (std::map
+  // iteration order), mirrors reference :175. Replica ranks derive from it.
+  for (const auto& [id, joiner] : participants_)
+    *q.add_participants() = joiner.member;
+  if (!has_prev_quorum_ || quorum_changed(prev_quorum_, q)) quorum_id_++;
+  q.set_quorum_id(quorum_id_);
+  q.set_created_unix_ms(
+      std::chrono::duration_cast<std::chrono::milliseconds>(
+          std::chrono::system_clock::now().time_since_epoch())
+          .count());
+  prev_quorum_ = q;
+  has_prev_quorum_ = true;
+  participants_.clear();
+  first_join_ms_ = 0;
+  broadcast_seq_++;
+  cv_.notify_all();
+  return true;
+}
+
+bool Lighthouse::handle(uint8_t method, const std::string& req,
+                        std::string* resp, std::string* err) {
+  switch (method) {
+    case kLighthouseQuorum: {
+      LighthouseQuorumRequest r;
+      if (!r.ParseFromString(req)) {
+        *err = "bad LighthouseQuorumRequest";
+        return false;
+      }
+      std::unique_lock<std::mutex> lk(mu_);
+      if (participants_.empty()) first_join_ms_ = now_ms();
+      participants_[r.requester().replica_id()] = {r.requester(), now_ms()};
+      int64_t entry_seq = broadcast_seq_;
+      tick();  // proactive: don't wait for the tick thread if already valid
+      while (broadcast_seq_ == entry_seq && !shutdown_) {
+        cv_.wait_for(lk, std::chrono::milliseconds(opt_.quorum_tick_ms));
+        if (broadcast_seq_ == entry_seq && !shutdown_) tick();
+      }
+      if (shutdown_) {
+        *err = "lighthouse shutting down";
+        return false;
+      }
+      LighthouseQuorumResponse out;
+      *out.mutable_quorum() = prev_quorum_;
+      *resp = out.SerializeAsString();
+      return true;
+    }
+    case kLighthouseHeartbeat: {
+      LighthouseHeartbeatRequest r;
+      if (!r.ParseFromString(req)) {
+        *err = "bad LighthouseHeartbeatRequest";
+        return false;
+      }
+      {
+        std::lock_guard<std::mutex> lk(mu_);
+        heartbeats_[r.replica_id()] = now_ms();
+      }
+      *resp = LighthouseHeartbeatResponse().SerializeAsString();
+      return true;
+    }
+    case kLighthouseStatus: {
+      StatusResponse out;
+      {
+        std::lock_guard<std::mutex> lk(mu_);
+        status_locked(&out);
+      }
+      *resp = out.SerializeAsString();
+      return true;
+    }
+    default:
+      *err = "lighthouse: unknown method";
+      return false;
+  }
+}
+
+void Lighthouse::status_locked(StatusResponse* out) const {
+  out->set_quorum_id(quorum_id_);
+  if (has_prev_quorum_) {
+    int64_t created = prev_quorum_.created_unix_ms();
+    int64_t now_wall = std::chrono::duration_cast<std::chrono::milliseconds>(
+                           std::chrono::system_clock::now().time_since_epoch())
+                           .count();
+    out->set_quorum_age_ms(now_wall - created);
+    for (const auto& m : prev_quorum_.participants()) {
+      auto* ms = out->add_members();
+      *ms->mutable_member() = m;
+      auto it = heartbeats_.find(m.replica_id());
+      ms->set_heartbeat_age_ms(it == heartbeats_.end() ? -1
+                                                       : now_ms() - it->second);
+    }
+  }
+  for (const auto& [id, _] : participants_) out->add_joining(id);
+}
+
+// Minimal HTML dashboard: quorum status, per-member step/heartbeat, kill
+// buttons (the reference's askama/htmx dashboard, templates/status.html).
+std::string Lighthouse::handle_http(const std::string& request) {
+  std::string body;
+  // POST /replica/{id}/kill → Kill RPC to that member's manager.
+  if (request.rfind("POST /replica/", 0) == 0) {
+    const size_t id_start = strlen("POST /replica/");
+    size_t id_end = request.find("/kill", id_start);
+    std::string id = id_end == std::string::npos
+                         ? ""
+                         : request.substr(id_start, id_end - id_start);
+    std::string target;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      if (has_prev_quorum_)
+        for (const auto& m : prev_quorum_.participants())
+          if (m.replica_id() == id) target = m.address();
+    }
+    if (!target.empty()) {
+      // The target exits before replying, so a transport error on the reply
+      // is the expected success shape; only a failed connect means the kill
+      // definitely did not land.
+      try {
+        RpcClient c(target, 2'000);
+        std::string resp, err;
+        KillRequest kr;
+        kr.set_msg("killed from lighthouse dashboard");
+        c.call(kManagerKill, kr.SerializeAsString(), &resp, &err, 2'000);
+        body = "killed " + id;
+      } catch (const std::exception& e) {
+        body = "kill of " + id + " failed: " + e.what();
+      }
+    } else {
+      body = "unknown replica " + id;
+    }
+  } else {
+    StatusResponse st;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      status_locked(&st);
+    }
+    std::ostringstream os;
+    os << "<html><head><title>torchft_tpu lighthouse</title>"
+       << "<meta http-equiv=refresh content=1></head><body>"
+       << "<h1>torchft_tpu lighthouse</h1>"
+       << "<p>quorum_id: " << st.quorum_id()
+       << " &middot; age: " << st.quorum_age_ms() << "ms</p>"
+       << "<table border=1 cellpadding=4><tr><th>replica</th><th>step</th>"
+       << "<th>world</th><th>heartbeat age</th><th></th></tr>";
+    int64_t max_step = 0;
+    for (const auto& m : st.members())
+      max_step = std::max(max_step, m.member().step());
+    for (const auto& m : st.members()) {
+      bool recovering = m.member().step() != max_step;
+      os << "<tr" << (recovering ? " style='background:#fdd'" : "") << "><td>"
+         << m.member().replica_id() << "</td><td>" << m.member().step()
+         << "</td><td>" << m.member().world_size() << "</td><td>"
+         << m.heartbeat_age_ms() << "ms</td>"
+         << "<td><form method=post action='/replica/" << m.member().replica_id()
+         << "/kill'><button>kill</button></form></td></tr>";
+    }
+    os << "</table><p>joining: ";
+    for (const auto& j : st.joining()) os << j << " ";
+    os << "</p></body></html>";
+    body = os.str();
+  }
+  std::ostringstream resp;
+  resp << "HTTP/1.1 200 OK\r\nContent-Type: text/html\r\nContent-Length: "
+       << body.size() << "\r\nConnection: close\r\n\r\n"
+       << body;
+  return resp.str();
+}
+
+}  // namespace torchft_tpu

@@ -1,0 +1,72 @@
+// Lighthouse: the global quorum arbiter.
+//
+// C++ re-implementation of the reference's Rust lighthouse
+// (/root/reference/src/lighthouse.rs): tracks joining participants, forms a
+// quorum per tick with fast-quorum / min_replicas / join-timeout semantics
+// (reference :106-208), bumps quorum_id only when membership changes
+// (reference quorum_changed :81-86), parks Quorum RPCs until the next quorum
+// broadcast, records heartbeats (visualized only, reference :378-391), and
+// serves an HTML dashboard with kill buttons on the same port
+// (reference :234-252).
+#pragma once
+
+#include <condition_variable>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <thread>
+
+#include "rpc.h"
+#include "torchft.pb.h"
+
+namespace torchft_tpu {
+
+struct LighthouseOpt {
+  std::string bind = "0.0.0.0:0";
+  uint64_t min_replicas = 1;
+  int64_t join_timeout_ms = 60'000;
+  int64_t quorum_tick_ms = 100;
+};
+
+class Lighthouse {
+ public:
+  explicit Lighthouse(const LighthouseOpt& opt);
+  ~Lighthouse();
+
+  std::string address() const { return server_->address(); }
+  void shutdown();
+
+  // Pure membership-change predicate (mirrors reference quorum_changed).
+  static bool quorum_changed(const Quorum& a, const Quorum& b);
+
+ private:
+  bool handle(uint8_t method, const std::string& req, std::string* resp,
+              std::string* err);
+  std::string handle_http(const std::string& request);
+  // Requires mu_ held. Forms a quorum if valid; returns true if one formed.
+  bool tick();
+  bool quorum_valid_locked() const;
+  void status_locked(StatusResponse* out) const;
+
+  LighthouseOpt opt_;
+  mutable std::mutex mu_;
+  std::condition_variable cv_;
+  struct Joiner {
+    QuorumMember member;
+    int64_t joined_at_ms;
+  };
+  std::map<std::string, Joiner> participants_;  // keyed by replica_id
+  int64_t first_join_ms_ = 0;
+  bool has_prev_quorum_ = false;
+  Quorum prev_quorum_;
+  int64_t quorum_id_ = 0;
+  int64_t broadcast_seq_ = 0;
+  std::map<std::string, int64_t> heartbeats_;  // replica_id -> last seen ms
+  bool shutdown_ = false;
+
+  std::thread tick_thread_;
+  std::unique_ptr<RpcServer> server_;
+};
+
+}  // namespace torchft_tpu

@@ -1,0 +1,268 @@
+#include "manager.h"
+
+#include <stdlib.h>
+
+#include <algorithm>
+#include <cstdio>
+#include <vector>
+
+namespace torchft_tpu {
+
+ManagerServer::ManagerServer(const ManagerOpt& opt) : opt_(opt) {
+  quorum_round_ = std::make_shared<QuorumRound>();
+  commit_round_ = std::make_shared<CommitRound>();
+  server_ = std::make_unique<RpcServer>(
+      opt.bind, [this](uint8_t m, const std::string& req, std::string* resp,
+                       std::string* err) { return handle(m, req, resp, err); });
+  heartbeat_thread_ = std::thread([this] { heartbeat_loop(); });
+}
+
+ManagerServer::~ManagerServer() { shutdown(); }
+
+std::string ManagerServer::address() const {
+  return opt_.advertise_addr.empty() ? server_->address() : opt_.advertise_addr;
+}
+
+void ManagerServer::shutdown() {
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (shutdown_) return;
+    shutdown_ = true;
+  }
+  cv_.notify_all();
+  if (heartbeat_thread_.joinable()) heartbeat_thread_.join();
+  server_->shutdown();
+}
+
+void ManagerServer::heartbeat_loop() {
+  // Periodic liveness signal to the lighthouse (reference
+  // src/manager.rs:148-159; only visualized there, same here).
+  std::unique_ptr<RpcClient> client;
+  while (true) {
+    {
+      std::unique_lock<std::mutex> lk(mu_);
+      cv_.wait_for(lk, std::chrono::milliseconds(opt_.heartbeat_ms));
+      if (shutdown_) return;
+    }
+    try {
+      if (!client)
+        client = std::make_unique<RpcClient>(opt_.lighthouse_addr, 1'000);
+      LighthouseHeartbeatRequest r;
+      r.set_replica_id(opt_.replica_id);
+      std::string resp, err;
+      if (!client->call(kLighthouseHeartbeat, r.SerializeAsString(), &resp,
+                        &err, 1'000))
+        client.reset();
+    } catch (...) {
+      client.reset();
+    }
+  }
+}
+
+bool ManagerServer::handle(uint8_t method, const std::string& req,
+                           std::string* resp, std::string* err) {
+  switch (method) {
+    case kManagerQuorum: {
+      ManagerQuorumRequest r;
+      if (!r.ParseFromString(req)) {
+        *err = "bad ManagerQuorumRequest";
+        return false;
+      }
+      ManagerQuorumResponse out;
+      if (!handle_quorum(r, &out, err)) return false;
+      *resp = out.SerializeAsString();
+      return true;
+    }
+    case kManagerShouldCommit: {
+      ShouldCommitRequest r;
+      if (!r.ParseFromString(req)) {
+        *err = "bad ShouldCommitRequest";
+        return false;
+      }
+      ShouldCommitResponse out;
+      if (!handle_should_commit(r, &out, err)) return false;
+      *resp = out.SerializeAsString();
+      return true;
+    }
+    case kManagerCheckpointAddress: {
+      CheckpointAddressRequest r;
+      if (!r.ParseFromString(req)) {
+        *err = "bad CheckpointAddressRequest";
+        return false;
+      }
+      CheckpointAddressResponse out;
+      {
+        std::lock_guard<std::mutex> lk(mu_);
+        auto it = checkpoint_addrs_.find(r.rank());
+        if (it == checkpoint_addrs_.end()) {
+          *err = "no checkpoint address for rank " + std::to_string(r.rank());
+          return false;
+        }
+        out.set_checkpoint_server_address(it->second);
+      }
+      *resp = out.SerializeAsString();
+      return true;
+    }
+    case kManagerKill: {
+      KillRequest r;
+      r.ParseFromString(req);
+      fprintf(stderr, "torchft_tpu manager [%s]: Kill RPC received: %s\n",
+              opt_.replica_id.c_str(), r.msg().c_str());
+      fflush(stderr);
+      // Hard exit, matching reference semantics (src/manager.rs:368-373).
+      exit(1);
+    }
+    default:
+      *err = "manager: unknown method";
+      return false;
+  }
+}
+
+bool ManagerServer::handle_quorum(const ManagerQuorumRequest& r,
+                                  ManagerQuorumResponse* out,
+                                  std::string* err) {
+  std::unique_lock<std::mutex> lk(mu_);
+  if (quorum_round_->done) quorum_round_ = std::make_shared<QuorumRound>();
+  auto round = quorum_round_;
+  round->joined[r.rank()] = r.checkpoint_server_addr();
+  round->max_local_step = std::max(round->max_local_step, r.step());
+
+  if (round->joined.size() >= opt_.world_size && !round->in_flight) {
+    // Last local rank to arrive does the lighthouse round-trip for the group.
+    round->in_flight = true;
+    QuorumMember self;
+    self.set_replica_id(opt_.replica_id);
+    self.set_address(address());
+    self.set_store_address(opt_.store_addr);
+    self.set_step(round->max_local_step);
+    self.set_world_size(opt_.world_size);
+    int64_t req_step = round->max_local_step;
+    lk.unlock();
+
+    Quorum quorum;
+    std::string rpc_err;
+    bool ok = false;
+    try {
+      RpcClient client(opt_.lighthouse_addr, opt_.connect_timeout_ms);
+      LighthouseQuorumRequest lr;
+      *lr.mutable_requester() = self;
+      std::string resp;
+      // No deadline: the lighthouse legitimately parks this RPC until quorum
+      // forms (join_timeout_ms of straggler wait on membership change).
+      if (client.call(kLighthouseQuorum, lr.SerializeAsString(), &resp,
+                      &rpc_err, 0)) {
+        LighthouseQuorumResponse lout;
+        if (lout.ParseFromString(resp)) {
+          quorum = lout.quorum();
+          ok = true;
+        } else {
+          rpc_err = "bad LighthouseQuorumResponse";
+        }
+      }
+    } catch (const std::exception& e) {
+      rpc_err = e.what();
+    }
+
+    lk.lock();
+    if (!ok) {
+      round->error = "lighthouse quorum failed: " + rpc_err;
+    } else {
+      round->quorum = quorum;
+      // Refresh the healing registry for this quorum.
+      checkpoint_addrs_.clear();
+      for (const auto& [rank, addr] : round->joined)
+        checkpoint_addrs_[rank] = addr;
+    }
+    round->done = true;
+    cv_.notify_all();
+  } else {
+    while (!round->done && !shutdown_) cv_.wait(lk);
+    if (shutdown_) {
+      *err = "manager shutting down";
+      return false;
+    }
+  }
+
+  if (!round->error.empty()) {
+    *err = round->error;
+    return false;
+  }
+  return compute_response(*round, r.rank(), r.step(), out, err);
+}
+
+bool ManagerServer::compute_response(const QuorumRound& round, int64_t rank,
+                                     int64_t req_step,
+                                     ManagerQuorumResponse* out,
+                                     std::string* err) {
+  // The group's view of the quorum, specialized to one local rank
+  // (reference src/manager.rs:244-287).
+  const auto& parts = round.quorum.participants();
+  int64_t replica_rank = -1;
+  int64_t max_step = 0;
+  for (int i = 0; i < parts.size(); i++) {
+    if (parts[i].replica_id() == opt_.replica_id) replica_rank = i;
+    max_step = std::max(max_step, parts[i].step());
+  }
+  if (replica_rank < 0) {
+    *err = "own replica_id missing from quorum";
+    return false;
+  }
+  std::vector<const QuorumMember*> max_parts;
+  for (const auto& p : parts)
+    if (p.step() == max_step) max_parts.push_back(&p);
+  // Recovery primary for this local rank. Every group sees the same sorted
+  // participant list, so rank r of every group agrees on the same primary —
+  // and different local ranks pick different max-step groups, spreading both
+  // healing traffic and store rendezvous load.
+  const QuorumMember* primary = max_parts[rank % (int64_t)max_parts.size()];
+  out->set_quorum_id(round.quorum.quorum_id());
+  out->set_recover_manager_address(primary->address());
+  // Rendezvous store for this rank's cross-group communicator = the
+  // primary's store, namespaced by quorum_id downstream (the PrefixStore
+  // trick, reference manager.py:374-376).
+  out->set_store_address(primary->store_address());
+  out->set_max_step(max_step);
+  out->set_max_world_size((int64_t)max_parts.size());
+  out->set_replica_rank(replica_rank);
+  out->set_replica_world_size(parts.size());
+  for (int i = 0; i < (int)max_parts.size(); i++)
+    if (max_parts[i]->replica_id() == opt_.replica_id) {
+      out->set_has_max_rank(true);
+      out->set_max_rank(i);
+    }
+  // Heal when lagging the quorum, or at the very first step when we are not
+  // the recovery primary (initial weight sync replaces DDP's init broadcast,
+  // reference src/manager.rs:266-275 + torchft/ddp.py:39-41).
+  out->set_heal(max_step != req_step ||
+                (max_step == 1 && primary->replica_id() != opt_.replica_id));
+  return true;
+}
+
+bool ManagerServer::handle_should_commit(const ShouldCommitRequest& r,
+                                         ShouldCommitResponse* out,
+                                         std::string* err) {
+  std::unique_lock<std::mutex> lk(mu_);
+  if (commit_round_->done) commit_round_ = std::make_shared<CommitRound>();
+  auto round = commit_round_;
+  round->votes[r.rank()] = r.should_commit();
+
+  if (round->votes.size() >= opt_.world_size) {
+    // Commit only if every local rank succeeded
+    // (reference src/manager.rs:314-366).
+    bool all = true;
+    for (const auto& [rank, v] : round->votes) all = all && v;
+    round->decision = all;
+    round->done = true;
+    cv_.notify_all();
+  } else {
+    while (!round->done && !shutdown_) cv_.wait(lk);
+    if (shutdown_) {
+      *err = "manager shutting down";
+      return false;
+    }
+  }
+  out->set_should_commit(round->decision);
+  return true;
+}
+
+}  // namespace torchft_tpu

@@ -1,0 +1,96 @@
+// Manager: per-replica-group coordinator, lives in the group's rank-0 process.
+//
+// C++ re-implementation of the reference's Rust manager
+// (/root/reference/src/manager.rs): parks each local rank's Quorum RPC until
+// all world_size ranks arrive (reference :186-235), the completing rank does
+// one Lighthouse round-trip for the whole group (:205-231), then computes
+// replica_rank / max_step / recovery primary / heal for the group
+// (:244-287); keeps a per-rank checkpoint-server address registry for healing
+// lookups (:189-193, :295-312); runs the all-rank should_commit barrier vote
+// (:314-366); heartbeats the lighthouse (:148-159); Kill = process exit
+// (:368-373).
+#pragma once
+
+#include <condition_variable>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <thread>
+
+#include "rpc.h"
+#include "torchft.pb.h"
+
+namespace torchft_tpu {
+
+struct ManagerOpt {
+  std::string replica_id;
+  std::string lighthouse_addr;
+  std::string bind = "0.0.0.0:0";
+  // Address advertised to peers (defaults to the bound address).
+  std::string advertise_addr;
+  // KV store address for communicator rendezvous, advertised in QuorumMember.
+  std::string store_addr;
+  uint64_t world_size = 1;  // local ranks in this replica group
+  int64_t heartbeat_ms = 100;
+  int64_t connect_timeout_ms = 10'000;
+};
+
+class ManagerServer {
+ public:
+  explicit ManagerServer(const ManagerOpt& opt);
+  ~ManagerServer();
+
+  std::string address() const;
+  void shutdown();
+
+ private:
+  bool handle(uint8_t method, const std::string& req, std::string* resp,
+              std::string* err);
+  bool handle_quorum(const ManagerQuorumRequest& r, ManagerQuorumResponse* out,
+                     std::string* err);
+  bool handle_should_commit(const ShouldCommitRequest& r,
+                            ShouldCommitResponse* out, std::string* err);
+  void heartbeat_loop();
+
+  ManagerOpt opt_;
+
+  std::mutex mu_;
+  std::condition_variable cv_;
+  bool shutdown_ = false;
+
+  // Barrier round for quorum: all world_size local ranks must arrive; the
+  // completing rank performs the lighthouse RPC for the group. The response
+  // is then computed PER LOCAL RANK from the shared quorum (each local rank
+  // gets its own recovery primary / store, spreading healing and rendezvous
+  // load across max-step groups — reference src/manager.rs:256).
+  struct QuorumRound {
+    std::map<int64_t, std::string> joined;  // rank -> checkpoint server addr
+    int64_t max_local_step = 0;
+    bool in_flight = false;  // lighthouse RPC running
+    bool done = false;
+    Quorum quorum;
+    std::string error;
+  };
+  std::shared_ptr<QuorumRound> quorum_round_;
+  // Requires the round to be done and error-free.
+  bool compute_response(const QuorumRound& round, int64_t rank,
+                        int64_t req_step, ManagerQuorumResponse* out,
+                        std::string* err);
+
+  struct CommitRound {
+    std::map<int64_t, bool> votes;  // rank -> local should_commit
+    bool done = false;
+    bool decision = false;
+  };
+  std::shared_ptr<CommitRound> commit_round_;
+
+  // rank -> checkpoint server address, refreshed each quorum; served to
+  // healing peers via CheckpointAddress.
+  std::map<int64_t, std::string> checkpoint_addrs_;
+
+  std::unique_ptr<RpcServer> server_;
+  std::thread heartbeat_thread_;
+};
+
+}  // namespace torchft_tpu

@@ -1,0 +1,340 @@
+"""ctypes bridge to the C++ control plane (``torchft_tpu/_core``).
+
+Plays the role of the reference's pyo3 bridge (``/root/reference/src/lib.rs``):
+exposes embeddable :class:`Lighthouse` and :class:`ManagerServer` servers, a
+blocking :class:`ManagerClient` (``quorum`` / ``checkpoint_address`` /
+``should_commit`` / ``kill``, reference ``src/lib.rs:105-181``), and the KV
+:class:`Store` used for rendezvous (the TCPStore analogue). ctypes releases
+the GIL for every foreign call, matching the reference's ``py.allow_threads``
+blocking behavior.
+
+The shared library is auto-built with cmake+ninja on first import if missing
+(the maturin-build analogue, reference ``pyproject.toml``).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from dataclasses import dataclass
+from typing import Optional
+
+_CORE_DIR = os.path.join(os.path.dirname(__file__), "_core")
+_LIB_PATH = os.path.join(_CORE_DIR, "build", "libtorchft_tpu_core.so")
+
+
+def _build_native() -> None:
+    subprocess.run(
+        ["cmake", "-B", "build", "-G", "Ninja", "-DCMAKE_BUILD_TYPE=Release"],
+        cwd=_CORE_DIR,
+        check=True,
+        capture_output=True,
+    )
+    subprocess.run(
+        ["ninja", "-C", "build", "torchft_tpu_core"],
+        cwd=_CORE_DIR,
+        check=True,
+        capture_output=True,
+    )
+
+
+def _load() -> ctypes.CDLL:
+    if not os.path.exists(_LIB_PATH):
+        _build_native()
+    lib = ctypes.CDLL(_LIB_PATH)
+
+    c = ctypes.c_char_p
+    vp = ctypes.c_void_p
+    i64 = ctypes.c_int64
+    u64 = ctypes.c_uint64
+    i32 = ctypes.c_int32
+
+    lib.tft_free.argtypes = [vp]
+    lib.tft_free.restype = None
+
+    lib.tft_lighthouse_new.argtypes = [c, u64, i64, i64, ctypes.POINTER(vp)]
+    lib.tft_lighthouse_new.restype = vp
+    lib.tft_lighthouse_address.argtypes = [vp]
+    lib.tft_lighthouse_address.restype = vp
+    lib.tft_lighthouse_shutdown.argtypes = [vp]
+    lib.tft_lighthouse_free.argtypes = [vp]
+
+    lib.tft_manager_new.argtypes = [c, c, c, c, u64, i64, ctypes.POINTER(vp)]
+    lib.tft_manager_new.restype = vp
+    lib.tft_manager_address.argtypes = [vp]
+    lib.tft_manager_address.restype = vp
+    lib.tft_manager_shutdown.argtypes = [vp]
+    lib.tft_manager_free.argtypes = [vp]
+
+    lib.tft_store_new.argtypes = [c, ctypes.POINTER(vp)]
+    lib.tft_store_new.restype = vp
+    lib.tft_store_address.argtypes = [vp]
+    lib.tft_store_address.restype = vp
+    lib.tft_store_shutdown.argtypes = [vp]
+    lib.tft_store_free.argtypes = [vp]
+
+    lib.tft_store_client_new.argtypes = [c, i64, ctypes.POINTER(vp)]
+    lib.tft_store_client_new.restype = vp
+    lib.tft_store_client_set.argtypes = [vp, c, c, ctypes.c_size_t,
+                                         ctypes.POINTER(vp)]
+    lib.tft_store_client_set.restype = i32
+    lib.tft_store_client_get.argtypes = [
+        vp, c, i64, ctypes.POINTER(vp), ctypes.POINTER(ctypes.c_size_t),
+        ctypes.POINTER(vp)]
+    lib.tft_store_client_get.restype = i32
+    lib.tft_store_client_free.argtypes = [vp]
+
+    lib.tft_manager_client_new.argtypes = [c, i64, ctypes.POINTER(vp)]
+    lib.tft_manager_client_new.restype = vp
+    lib.tft_manager_client_quorum.argtypes = [
+        vp, i64, i64, c, i64, ctypes.POINTER(_CQuorumResult),
+        ctypes.POINTER(vp)]
+    lib.tft_manager_client_quorum.restype = i32
+    lib.tft_manager_client_checkpoint_address.argtypes = [
+        vp, i64, i64, ctypes.POINTER(vp), ctypes.POINTER(vp)]
+    lib.tft_manager_client_checkpoint_address.restype = i32
+    lib.tft_manager_client_should_commit.argtypes = [
+        vp, i64, i64, i32, i64, ctypes.POINTER(i32), ctypes.POINTER(vp)]
+    lib.tft_manager_client_should_commit.restype = i32
+    lib.tft_manager_client_kill.argtypes = [vp, c, ctypes.POINTER(vp)]
+    lib.tft_manager_client_kill.restype = i32
+    lib.tft_manager_client_free.argtypes = [vp]
+
+    lib.tft_lighthouse_client_status.argtypes = [c, i64, ctypes.POINTER(vp),
+                                                 ctypes.POINTER(vp)]
+    lib.tft_lighthouse_client_status.restype = i32
+    return lib
+
+
+class _CQuorumResult(ctypes.Structure):
+    _fields_ = [
+        ("quorum_id", ctypes.c_int64),
+        ("recover_manager_address", ctypes.c_void_p),
+        ("store_address", ctypes.c_void_p),
+        ("max_step", ctypes.c_int64),
+        ("has_max_rank", ctypes.c_int32),
+        ("max_rank", ctypes.c_int64),
+        ("max_world_size", ctypes.c_int64),
+        ("replica_rank", ctypes.c_int64),
+        ("replica_world_size", ctypes.c_int64),
+        ("heal", ctypes.c_int32),
+    ]
+
+
+_lib: Optional[ctypes.CDLL] = None
+
+
+def lib() -> ctypes.CDLL:
+    global _lib
+    if _lib is None:
+        _lib = _load()
+    return _lib
+
+
+class NativeError(RuntimeError):
+    """An error surfaced from the C++ control plane (incl. transport errors)."""
+
+
+def _take_str(p: int) -> str:
+    try:
+        return ctypes.string_at(p).decode()
+    finally:
+        lib().tft_free(p)
+
+
+def _check(rc: int, err: ctypes.c_void_p) -> None:
+    if rc != 0:
+        msg = _take_str(err.value) if err.value else "unknown native error"
+        raise NativeError(msg)
+
+
+def _check_handle(h, err: ctypes.c_void_p):
+    if not h:
+        msg = _take_str(err.value) if err.value else "unknown native error"
+        raise NativeError(msg)
+    return h
+
+
+class Lighthouse:
+    """Embeddable global quorum server (reference ``src/lib.rs:216-256``)."""
+
+    def __init__(self, bind: str = "0.0.0.0:0", min_replicas: int = 1,
+                 join_timeout_ms: int = 100, quorum_tick_ms: int = 100):
+        err = ctypes.c_void_p()
+        self._h = _check_handle(
+            lib().tft_lighthouse_new(bind.encode(), min_replicas,
+                                     join_timeout_ms, quorum_tick_ms,
+                                     ctypes.byref(err)), err)
+
+    def address(self) -> str:
+        return _take_str(lib().tft_lighthouse_address(self._h))
+
+    def status(self, timeout_ms: int = 5000) -> dict:
+        import json
+        out, err = ctypes.c_void_p(), ctypes.c_void_p()
+        _check(lib().tft_lighthouse_client_status(
+            self.address().encode(), timeout_ms, ctypes.byref(out),
+            ctypes.byref(err)), err)
+        return json.loads(_take_str(out.value))
+
+    def shutdown(self) -> None:
+        if self._h:
+            lib().tft_lighthouse_shutdown(self._h)
+
+    def __del__(self):
+        h, self._h = getattr(self, "_h", None), None
+        if h:
+            lib().tft_lighthouse_free(h)
+
+
+class ManagerServer:
+    """Embeddable per-replica-group coordinator (reference ``src/lib.rs:29-78``)."""
+
+    def __init__(self, replica_id: str, lighthouse_addr: str,
+                 store_addr: str = "", bind: str = "0.0.0.0:0",
+                 world_size: int = 1, heartbeat_ms: int = 100):
+        err = ctypes.c_void_p()
+        self._h = _check_handle(
+            lib().tft_manager_new(replica_id.encode(),
+                                  lighthouse_addr.encode(), bind.encode(),
+                                  store_addr.encode(), world_size,
+                                  heartbeat_ms, ctypes.byref(err)), err)
+
+    def address(self) -> str:
+        return _take_str(lib().tft_manager_address(self._h))
+
+    def shutdown(self) -> None:
+        if self._h:
+            lib().tft_manager_shutdown(self._h)
+
+    def __del__(self):
+        h, self._h = getattr(self, "_h", None), None
+        if h:
+            lib().tft_manager_free(h)
+
+
+class Store:
+    """KV store server for rendezvous (the TCPStore analogue)."""
+
+    def __init__(self, bind: str = "0.0.0.0:0"):
+        err = ctypes.c_void_p()
+        self._h = _check_handle(
+            lib().tft_store_new(bind.encode(), ctypes.byref(err)), err)
+
+    def address(self) -> str:
+        return _take_str(lib().tft_store_address(self._h))
+
+    def shutdown(self) -> None:
+        if self._h:
+            lib().tft_store_shutdown(self._h)
+
+    def __del__(self):
+        h, self._h = getattr(self, "_h", None), None
+        if h:
+            lib().tft_store_free(h)
+
+
+class StoreClient:
+    def __init__(self, address: str, connect_timeout_ms: int = 10_000):
+        err = ctypes.c_void_p()
+        self._h = _check_handle(
+            lib().tft_store_client_new(address.encode(), connect_timeout_ms,
+                                       ctypes.byref(err)), err)
+        self._address = address
+
+    def set(self, key: str, value: bytes) -> None:
+        if isinstance(value, str):
+            value = value.encode()
+        err = ctypes.c_void_p()
+        _check(lib().tft_store_client_set(self._h, key.encode(), value,
+                                          len(value), ctypes.byref(err)), err)
+
+    def get(self, key: str, timeout_ms: int = 30_000) -> bytes:
+        out, n, err = ctypes.c_void_p(), ctypes.c_size_t(), ctypes.c_void_p()
+        _check(lib().tft_store_client_get(self._h, key.encode(), timeout_ms,
+                                          ctypes.byref(out), ctypes.byref(n),
+                                          ctypes.byref(err)), err)
+        try:
+            return ctypes.string_at(out.value, n.value)
+        finally:
+            lib().tft_free(out.value)
+
+    def __del__(self):
+        h, self._h = getattr(self, "_h", None), None
+        if h:
+            lib().tft_store_client_free(h)
+
+
+@dataclass
+class QuorumResult:
+    """The 9-field quorum view a rank receives each step (reference
+    ``ManagerQuorumResponse``, ``proto/torchft.proto:77-89``)."""
+
+    quorum_id: int
+    recover_manager_address: str
+    store_address: str
+    max_step: int
+    max_rank: Optional[int]
+    max_world_size: int
+    replica_rank: int
+    replica_world_size: int
+    heal: bool
+
+
+class ManagerClient:
+    """Blocking client to a replica group's manager server (reference
+    ``src/lib.rs:81-181``)."""
+
+    def __init__(self, address: str, connect_timeout_ms: int = 10_000):
+        err = ctypes.c_void_p()
+        self._h = _check_handle(
+            lib().tft_manager_client_new(address.encode(), connect_timeout_ms,
+                                         ctypes.byref(err)), err)
+        self._address = address
+
+    @property
+    def address(self) -> str:
+        return self._address
+
+    def quorum(self, rank: int, step: int, checkpoint_server_addr: str,
+               timeout_ms: int = 0) -> QuorumResult:
+        res, err = _CQuorumResult(), ctypes.c_void_p()
+        _check(lib().tft_manager_client_quorum(
+            self._h, rank, step, checkpoint_server_addr.encode(), timeout_ms,
+            ctypes.byref(res), ctypes.byref(err)), err)
+        return QuorumResult(
+            quorum_id=res.quorum_id,
+            recover_manager_address=_take_str(res.recover_manager_address),
+            store_address=_take_str(res.store_address),
+            max_step=res.max_step,
+            max_rank=res.max_rank if res.has_max_rank else None,
+            max_world_size=res.max_world_size,
+            replica_rank=res.replica_rank,
+            replica_world_size=res.replica_world_size,
+            heal=bool(res.heal),
+        )
+
+    def checkpoint_address(self, rank: int, timeout_ms: int = 10_000) -> str:
+        out, err = ctypes.c_void_p(), ctypes.c_void_p()
+        _check(lib().tft_manager_client_checkpoint_address(
+            self._h, rank, timeout_ms, ctypes.byref(out), ctypes.byref(err)),
+            err)
+        return _take_str(out.value)
+
+    def should_commit(self, rank: int, step: int, should_commit: bool,
+                      timeout_ms: int = 0) -> bool:
+        out, err = ctypes.c_int32(), ctypes.c_void_p()
+        _check(lib().tft_manager_client_should_commit(
+            self._h, rank, step, 1 if should_commit else 0, timeout_ms,
+            ctypes.byref(out), ctypes.byref(err)), err)
+        return bool(out.value)
+
+    def kill(self, msg: str = "") -> None:
+        err = ctypes.c_void_p()
+        lib().tft_manager_client_kill(self._h, msg.encode(), ctypes.byref(err))
+
+    def __del__(self):
+        h, self._h = getattr(self, "_h", None), None
+        if h:
+            lib().tft_manager_client_free(h)
