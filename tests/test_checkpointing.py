@@ -1,0 +1,135 @@
+"""Checkpoint transfer tests (reference checkpointing semantics:
+step gating, live lazy state, 400 on step mismatch —
+/root/reference/torchft/checkpointing.py)."""
+
+import threading
+import urllib.error
+import urllib.request
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchft_tpu.checkpointing import CheckpointServer
+from torchft_tpu.serialization import load_pytree, save_pytree
+
+
+def tree_equal(a, b):
+    import jax
+
+    flat_a = jax.tree_util.tree_leaves(a)
+    flat_b = jax.tree_util.tree_leaves(b)
+    assert len(flat_a) == len(flat_b)
+    for x, y in zip(flat_a, flat_b):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+class TestSerialization:
+    def test_round_trip(self):
+        tree = {
+            "params": {
+                "w": jnp.arange(12, dtype=jnp.float32).reshape(3, 4),
+                "b": jnp.ones((4,), dtype=jnp.bfloat16),
+            },
+            "opt": [jnp.zeros((2, 2)), np.int64(7)],
+            "step": 42,
+            "name": "model",
+            "flag": True,
+            "none": None,
+        }
+        data = save_pytree(tree)
+        restored = load_pytree(data, tree)
+        tree_equal(restored, tree)
+        assert restored["step"] == 42
+        assert restored["name"] == "model"
+        assert restored["none"] is None
+
+    def test_structure_mismatch_fails(self):
+        data = save_pytree({"a": np.ones(3)})
+        with pytest.raises(ValueError, match="does not match|leaves"):
+            load_pytree(data, {"b": np.ones(3)})
+        with pytest.raises(ValueError):
+            load_pytree(data, {"a": np.ones(3), "c": np.ones(1)})
+
+    def test_bad_magic(self):
+        with pytest.raises(ValueError, match="not a torchft_tpu"):
+            load_pytree(b"garbage_bytes_here", {"a": np.ones(1)})
+
+
+class TestCheckpointServer:
+    def test_serve_and_load(self):
+        state = {"w": np.arange(10, dtype=np.float32), "step": 3}
+        server = CheckpointServer(lambda: state)
+        try:
+            server.allow_checkpoint(3)
+            restored = CheckpointServer.load_from_address(
+                server.address(), state, device_put=False)
+            tree_equal(restored, state)
+        finally:
+            server.shutdown()
+
+    def test_step_mismatch_is_400(self):
+        server = CheckpointServer(lambda: {"x": np.ones(1)})
+        try:
+            server.allow_checkpoint(5)
+            addr = server.address().replace("/checkpoint/5", "/checkpoint/4")
+            with pytest.raises(urllib.error.HTTPError) as exc_info:
+                urllib.request.urlopen(addr, timeout=10)
+            assert exc_info.value.code == 400
+        finally:
+            server.shutdown()
+
+    def test_serves_live_state(self):
+        """State is read lazily at GET time, not at allow time."""
+        state = {"v": np.zeros(2)}
+        server = CheckpointServer(lambda: state)
+        try:
+            server.allow_checkpoint(1)
+            state["v"] = np.full(2, 9.0)  # mutate after allow
+            restored = CheckpointServer.load_from_address(
+                server.address(), state, device_put=False)
+            np.testing.assert_array_equal(restored["v"], np.full(2, 9.0))
+        finally:
+            server.shutdown()
+
+    def test_disallow_blocks_serving(self):
+        server = CheckpointServer(lambda: {"x": np.ones(1)})
+        try:
+            server.allow_checkpoint(1)
+            addr = server.address()
+            server.disallow_checkpoint()
+
+            result = {}
+
+            def fetch():
+                try:
+                    result["data"] = CheckpointServer.load_from_address(
+                        addr, {"x": np.ones(1)}, timeout_sec=10,
+                        device_put=False)
+                except Exception as e:  # noqa: BLE001
+                    result["err"] = e
+
+            t = threading.Thread(target=fetch)
+            t.start()
+            t.join(timeout=0.5)
+            assert t.is_alive(), "fetch should block while disallowed"
+            server.allow_checkpoint(1)  # reopen the window
+            t.join(timeout=10)
+            assert not t.is_alive()
+            assert "data" in result
+        finally:
+            server.shutdown()
+
+    def test_double_allow_and_double_disallow(self):
+        server = CheckpointServer(lambda: {"x": np.ones(1)})
+        try:
+            server.allow_checkpoint(1)
+            server.allow_checkpoint(2)  # idempotent-ish: moves the window
+            server.disallow_checkpoint()
+            server.disallow_checkpoint()  # no deadlock / double-acquire
+            server.allow_checkpoint(3)
+            restored = CheckpointServer.load_from_address(
+                server.address(), {"x": np.ones(1)}, device_put=False)
+            assert restored["x"].shape == (1,)
+        finally:
+            server.shutdown()

@@ -1,0 +1,250 @@
+"""Communicator layer tests.
+
+Mirrors the reference's process-group test strategy
+(/root/reference/torchft/process_group_test.py): dummy-backend counters,
+error-latching wrapper semantics, and real collectives with all ranks as
+threads in one process over localhost.
+"""
+
+import threading
+from concurrent.futures import Future
+
+import numpy as np
+import pytest
+
+from torchft_tpu._native import Store
+from torchft_tpu.backends.host import HostCommunicator
+from torchft_tpu.communicator import (
+    Communicator,
+    CommunicatorError,
+    DummyCommunicator,
+    ErrorSwallowingCommunicator,
+)
+
+
+class TestDummy:
+    def test_counters_and_identity(self):
+        d = DummyCommunicator(rank=0, world_size=3)
+        d.configure("ignored/prefix", 1, 3)
+        tree = {"g": np.ones(4)}
+        out = d.allreduce(tree).result()
+        assert out is tree
+        assert d.allgather(tree).result() == [tree, tree, tree]
+        assert d.configure_count == 1
+        assert d.allreduce_count == 1
+        assert d.allgather_count == 1
+        assert d.size() == 3 and d.rank() == 1
+
+
+class _FailingComm(Communicator):
+    """Raises on every collective (sync or async depending on mode)."""
+
+    def __init__(self, sync_raise: bool):
+        self.sync_raise = sync_raise
+
+    def configure(self, store_addr, rank, world_size):
+        pass
+
+    def _fail(self):
+        if self.sync_raise:
+            raise CommunicatorError("boom")
+        f: Future = Future()
+        f.set_exception(CommunicatorError("boom"))
+        return f
+
+    def allreduce(self, tree, op="sum"):
+        return self._fail()
+
+    def broadcast(self, tree, root=0):
+        return self._fail()
+
+    def allgather(self, tree):
+        return self._fail()
+
+    def size(self):
+        return 2
+
+    def rank(self):
+        return 0
+
+
+class TestErrorSwallowing:
+    @pytest.mark.parametrize("sync_raise", [True, False])
+    def test_latches_and_swallows(self, sync_raise):
+        errors = []
+        comm = ErrorSwallowingCommunicator(
+            _FailingComm(sync_raise), on_error=errors.append)
+        tree = {"g": np.full(3, 7.0)}
+        out = comm.allreduce(tree).result(timeout=5)
+        # Error swallowed: input returned unchanged, error latched.
+        assert out is tree
+        assert isinstance(comm.error(), CommunicatorError)
+        assert len(errors) == 1
+        # Subsequent ops short-circuit without touching the backend.
+        out2 = comm.allreduce(tree).result(timeout=5)
+        assert out2 is tree
+        assert len(errors) == 1  # only first error reported
+        # Reconfigure clears the latch.
+        comm.configure("addr/p", 0, 2)
+        assert comm.error() is None
+
+
+def _run_ranks(world_size, fn):
+    """Run fn(rank) in world_size threads; propagate the first exception."""
+    results = [None] * world_size
+    errors = []
+
+    def wrap(r):
+        try:
+            results[r] = fn(r)
+        except Exception as e:  # noqa: BLE001
+            errors.append((r, e))
+
+    threads = [threading.Thread(target=wrap, args=(r,))
+               for r in range(world_size)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    if errors:
+        raise errors[0][1]
+    return results
+
+
+@pytest.fixture
+def store():
+    s = Store(bind="127.0.0.1:0")
+    yield s
+    s.shutdown()
+
+
+class TestHostCommunicator:
+    @pytest.mark.parametrize("world_size", [2, 3, 4])
+    def test_allreduce_sum(self, store, world_size):
+        addr = store.address()
+        comms = [HostCommunicator(timeout_sec=30) for _ in range(world_size)]
+
+        def run(rank):
+            comm = comms[rank]
+            comm.configure(f"{addr}/q1", rank, world_size)
+            tree = {
+                "a": np.full((5, 3), float(rank + 1), dtype=np.float32),
+                "b": np.arange(7, dtype=np.float64) * (rank + 1),
+                "c": np.full(2, rank, dtype=np.int32),
+            }
+            return comm.allreduce(tree).result(timeout=30)
+
+        results = _run_ranks(world_size, run)
+        tot = sum(range(1, world_size + 1))
+        for out in results:
+            np.testing.assert_allclose(
+                out["a"], np.full((5, 3), float(tot), dtype=np.float32))
+            np.testing.assert_allclose(
+                out["b"], np.arange(7, dtype=np.float64) * tot)
+            np.testing.assert_array_equal(
+                out["c"],
+                np.full(2, sum(range(world_size)), dtype=np.int32))
+            assert out["a"].dtype == np.float32
+            assert out["c"].dtype == np.int32
+        for c in comms:
+            c.shutdown()
+
+    def test_allreduce_mean(self, store):
+        addr = store.address()
+        comms = [HostCommunicator(timeout_sec=30) for _ in range(2)]
+
+        def run(rank):
+            comm = comms[rank]
+            comm.configure(f"{addr}/qm", rank, 2)
+            return comm.allreduce(
+                {"g": np.full(4, float(rank), dtype=np.float32)},
+                op="mean").result(timeout=30)
+
+        for out in _run_ranks(2, run):
+            np.testing.assert_allclose(out["g"], np.full(4, 0.5))
+        for c in comms:
+            c.shutdown()
+
+    def test_broadcast(self, store):
+        addr = store.address()
+        world = 3
+        comms = [HostCommunicator(timeout_sec=30) for _ in range(world)]
+
+        def run(rank):
+            comm = comms[rank]
+            comm.configure(f"{addr}/qb", rank, world)
+            tree = {"w": np.full(6, float(rank), dtype=np.float32)}
+            return comm.broadcast(tree, root=1).result(timeout=30)
+
+        for out in _run_ranks(world, run):
+            np.testing.assert_allclose(out["w"], np.full(6, 1.0))
+        for c in comms:
+            c.shutdown()
+
+    def test_allgather(self, store):
+        addr = store.address()
+        world = 3
+        comms = [HostCommunicator(timeout_sec=30) for _ in range(world)]
+
+        def run(rank):
+            comm = comms[rank]
+            comm.configure(f"{addr}/qg", rank, world)
+            return comm.allgather(
+                {"v": np.full(3, float(rank))}).result(timeout=30)
+
+        for out in _run_ranks(world, run):
+            assert len(out) == world
+            for r in range(world):
+                np.testing.assert_allclose(out[r]["v"], np.full(3, float(r)))
+        for c in comms:
+            c.shutdown()
+
+    def test_world_size_one_is_noop(self):
+        comm = HostCommunicator()
+        comm.configure("unused/prefix", 0, 1)
+        tree = {"x": np.ones(3)}
+        assert comm.allreduce(tree).result(timeout=5) is tree
+        comm.shutdown()
+
+    def test_reconfigure_shrink(self, store):
+        """3-rank ring reconfigures to a 2-rank ring (a group died)."""
+        addr = store.address()
+        comms = [HostCommunicator(timeout_sec=30) for _ in range(3)]
+
+        def run3(rank):
+            comms[rank].configure(f"{addr}/e1", rank, 3)
+            return comms[rank].allreduce(
+                {"g": np.ones(4, dtype=np.float32)}).result(timeout=30)
+
+        for out in _run_ranks(3, run3):
+            np.testing.assert_allclose(out["g"], np.full(4, 3.0))
+
+        # rank 2 "dies"; ranks 0,1 reconfigure onto a new prefix.
+        def run2(rank):
+            comms[rank].configure(f"{addr}/e2", rank, 2)
+            return comms[rank].allreduce(
+                {"g": np.ones(4, dtype=np.float32)}).result(timeout=30)
+
+        for out in _run_ranks(2, run2):
+            np.testing.assert_allclose(out["g"], np.full(4, 2.0))
+        for c in comms:
+            c.shutdown()
+
+    def test_peer_death_aborts_with_error(self, store):
+        """If a peer dies mid-collective, survivors get CommunicatorError,
+        not a hang (the reference needed subprocess isolation for this;
+        socket closure gives it to us directly)."""
+        addr = store.address()
+        comms = [HostCommunicator(timeout_sec=30) for _ in range(2)]
+
+        def run(rank):
+            comms[rank].configure(f"{addr}/dead", rank, 2)
+            if rank == 1:
+                comms[1].shutdown()  # dies before the collective
+                return None
+            return comms[0].allreduce({"g": np.ones(1024)})
+
+        results = _run_ranks(2, run)
+        with pytest.raises(CommunicatorError):
+            results[0].result(timeout=30)
+        comms[0].shutdown()

@@ -4,6 +4,7 @@
 // (:542-582), manager should_commit voting with concurrent clients and a real
 // lighthouse+manager pair (src/manager.rs:398-477).
 #include <assert.h>
+#include <unistd.h>
 
 #include <cstdio>
 #include <thread>
@@ -235,12 +236,51 @@ static void test_fast_quorum_and_id_bump() {
   printf("test_fast_quorum_and_id_bump ok\n");
 }
 
+// Shutdown must not hang while a quorum RPC is parked at the lighthouse
+// waiting for a min_replicas that never arrives.
+static void test_shutdown_while_parked() {
+  LighthouseOpt lopt;
+  lopt.bind = "127.0.0.1:0";
+  lopt.min_replicas = 2;  // never satisfied
+  lopt.join_timeout_ms = 60'000;
+  lopt.quorum_tick_ms = 10;
+  Lighthouse lh(lopt);
+
+  ManagerOpt mopt;
+  mopt.replica_id = "lonely";
+  mopt.lighthouse_addr = lh.address();
+  mopt.bind = "127.0.0.1:0";
+  mopt.world_size = 1;
+  ManagerServer m(mopt);
+
+  std::thread caller([&] {
+    try {
+      RpcClient c(m.address(), 2'000);
+      ManagerQuorumRequest req;
+      req.set_rank(0);
+      req.set_step(1);
+      std::string resp, err;
+      c.call(kManagerQuorum, req.SerializeAsString(), &resp, &err, 30'000);
+    } catch (...) {
+    }
+  });
+  usleep(300'000);  // let the call park
+  int64_t t0 = now_ms();
+  m.shutdown();
+  int64_t elapsed = now_ms() - t0;
+  assert(elapsed < 3'000);
+  caller.join();
+  lh.shutdown();
+  printf("test_shutdown_while_parked ok (%lldms)\n", (long long)elapsed);
+}
+
 int main() {
   test_quorum_changed();
   test_store();
   test_lighthouse_manager_e2e();
   test_heal_decision();
   test_fast_quorum_and_id_bump();
+  test_shutdown_while_parked();
   printf("ALL CORE TESTS PASSED\n");
   return 0;
 }

@@ -35,6 +35,22 @@ void Lighthouse::shutdown() {
   server_->shutdown();
 }
 
+static std::string html_escape(const std::string& s) {
+  std::string out;
+  out.reserve(s.size());
+  for (char c : s) {
+    switch (c) {
+      case '<': out += "&lt;"; break;
+      case '>': out += "&gt;"; break;
+      case '&': out += "&amp;"; break;
+      case '"': out += "&quot;"; break;
+      case '\'': out += "&#39;"; break;
+      default: out += c;
+    }
+  }
+  return out;
+}
+
 bool Lighthouse::quorum_changed(const Quorum& a, const Quorum& b) {
   // Membership (replica_id set) comparison only — step changes alone do not
   // constitute a new quorum (mirrors reference src/lighthouse.rs:81-86).
@@ -215,15 +231,16 @@ std::string Lighthouse::handle_http(const std::string& request) {
       max_step = std::max(max_step, m.member().step());
     for (const auto& m : st.members()) {
       bool recovering = m.member().step() != max_step;
+      std::string id = html_escape(m.member().replica_id());
       os << "<tr" << (recovering ? " style='background:#fdd'" : "") << "><td>"
-         << m.member().replica_id() << "</td><td>" << m.member().step()
-         << "</td><td>" << m.member().world_size() << "</td><td>"
-         << m.heartbeat_age_ms() << "ms</td>"
-         << "<td><form method=post action='/replica/" << m.member().replica_id()
+         << id << "</td><td>" << m.member().step() << "</td><td>"
+         << m.member().world_size() << "</td><td>" << m.heartbeat_age_ms()
+         << "ms</td>"
+         << "<td><form method=post action='/replica/" << id
          << "/kill'><button>kill</button></form></td></tr>";
     }
     os << "</table><p>joining: ";
-    for (const auto& j : st.joining()) os << j << " ";
+    for (const auto& j : st.joining()) os << html_escape(j) << " ";
     os << "</p></body></html>";
     body = os.str();
   }

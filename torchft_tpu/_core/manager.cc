@@ -1,6 +1,7 @@
 #include "manager.h"
 
 #include <stdlib.h>
+#include <unistd.h>
 
 #include <algorithm>
 #include <cstdio>
@@ -9,8 +10,6 @@
 namespace torchft_tpu {
 
 ManagerServer::ManagerServer(const ManagerOpt& opt) : opt_(opt) {
-  quorum_round_ = std::make_shared<QuorumRound>();
-  commit_round_ = std::make_shared<CommitRound>();
   server_ = std::make_unique<RpcServer>(
       opt.bind, [this](uint8_t m, const std::string& req, std::string* resp,
                        std::string* err) { return handle(m, req, resp, err); });
@@ -24,11 +23,14 @@ std::string ManagerServer::address() const {
 }
 
 void ManagerServer::shutdown() {
+  std::shared_ptr<RpcClient> inflight;
   {
     std::lock_guard<std::mutex> lk(mu_);
     if (shutdown_) return;
     shutdown_ = true;
+    inflight = lighthouse_inflight_;
   }
+  if (inflight) inflight->cancel();
   cv_.notify_all();
   if (heartbeat_thread_.joinable()) heartbeat_thread_.join();
   server_->shutdown();
@@ -122,48 +124,78 @@ bool ManagerServer::handle_quorum(const ManagerQuorumRequest& r,
                                   ManagerQuorumResponse* out,
                                   std::string* err) {
   std::unique_lock<std::mutex> lk(mu_);
-  if (quorum_round_->done) quorum_round_ = std::make_shared<QuorumRound>();
-  auto round = quorum_round_;
+  auto& slot = quorum_rounds_[r.step()];
+  if (!slot) slot = std::make_shared<QuorumRound>();
+  auto round = slot;
+  // Drop stale rounds so retries of long-gone steps can't pile up state.
+  quorum_rounds_.erase(quorum_rounds_.begin(),
+                       quorum_rounds_.lower_bound(r.step() - 8));
   round->joined[r.rank()] = r.checkpoint_server_addr();
-  round->max_local_step = std::max(round->max_local_step, r.step());
 
-  if (round->joined.size() >= opt_.world_size && !round->in_flight) {
+  if (round->done) {
+    // Client retry after a lost response: idempotent replay.
+  } else if (round->joined.size() >= opt_.world_size && !round->in_flight) {
     // Last local rank to arrive does the lighthouse round-trip for the group.
     round->in_flight = true;
     QuorumMember self;
     self.set_replica_id(opt_.replica_id);
     self.set_address(address());
     self.set_store_address(opt_.store_addr);
-    self.set_step(round->max_local_step);
+    self.set_step(r.step());
     self.set_world_size(opt_.world_size);
-    int64_t req_step = round->max_local_step;
     lk.unlock();
 
+    // The lighthouse legitimately parks this RPC until quorum forms (up to
+    // join_timeout_ms of straggler wait), so poll with bounded per-call
+    // deadlines and re-join on timeout — the lighthouse treats a re-join as
+    // an overwrite of the same participant, and bounded calls keep this
+    // thread cancellable by shutdown() (a deadline-less call here would
+    // deadlock shutdown against the parked connection).
     Quorum quorum;
     std::string rpc_err;
     bool ok = false;
-    try {
-      RpcClient client(opt_.lighthouse_addr, opt_.connect_timeout_ms);
-      LighthouseQuorumRequest lr;
-      *lr.mutable_requester() = self;
-      std::string resp;
-      // No deadline: the lighthouse legitimately parks this RPC until quorum
-      // forms (join_timeout_ms of straggler wait on membership change).
-      if (client.call(kLighthouseQuorum, lr.SerializeAsString(), &resp,
-                      &rpc_err, 0)) {
-        LighthouseQuorumResponse lout;
-        if (lout.ParseFromString(resp)) {
-          quorum = lout.quorum();
-          ok = true;
-        } else {
-          rpc_err = "bad LighthouseQuorumResponse";
+    std::shared_ptr<RpcClient> client;
+    LighthouseQuorumRequest lr;
+    *lr.mutable_requester() = self;
+    const std::string payload = lr.SerializeAsString();
+    while (!ok) {
+      {
+        std::lock_guard<std::mutex> g(mu_);
+        if (shutdown_) {
+          rpc_err = "manager shutting down";
+          break;
         }
       }
-    } catch (const std::exception& e) {
-      rpc_err = e.what();
+      try {
+        if (!client) {
+          client = std::make_shared<RpcClient>(opt_.lighthouse_addr, 2'000);
+          std::lock_guard<std::mutex> g(mu_);
+          lighthouse_inflight_ = client;
+          if (shutdown_) client->cancel();
+        }
+        std::string resp;
+        if (client->call(kLighthouseQuorum, payload, &resp, &rpc_err,
+                         5'000)) {
+          LighthouseQuorumResponse lout;
+          if (lout.ParseFromString(resp)) {
+            quorum = lout.quorum();
+            ok = true;
+          } else {
+            rpc_err = "bad LighthouseQuorumResponse";
+            break;
+          }
+        } else if (rpc_err == "transport: cancelled") {
+          break;
+        }
+      } catch (const std::exception& e) {
+        rpc_err = e.what();
+        client.reset();
+        usleep(200'000);  // lighthouse unreachable; back off
+      }
     }
 
     lk.lock();
+    lighthouse_inflight_.reset();
     if (!ok) {
       round->error = "lighthouse quorum failed: " + rpc_err;
     } else {
@@ -242,11 +274,16 @@ bool ManagerServer::handle_should_commit(const ShouldCommitRequest& r,
                                          ShouldCommitResponse* out,
                                          std::string* err) {
   std::unique_lock<std::mutex> lk(mu_);
-  if (commit_round_->done) commit_round_ = std::make_shared<CommitRound>();
-  auto round = commit_round_;
-  round->votes[r.rank()] = r.should_commit();
+  auto& slot = commit_rounds_[r.step()];
+  if (!slot) slot = std::make_shared<CommitRound>();
+  auto round = slot;
+  commit_rounds_.erase(commit_rounds_.begin(),
+                       commit_rounds_.lower_bound(r.step() - 8));
+  if (!round->done) round->votes[r.rank()] = r.should_commit();
 
-  if (round->votes.size() >= opt_.world_size) {
+  if (round->done) {
+    // Idempotent replay for retries.
+  } else if (round->votes.size() >= opt_.world_size) {
     // Commit only if every local rank succeeded
     // (reference src/manager.rs:314-366).
     bool all = true;

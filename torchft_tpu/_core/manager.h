@@ -64,15 +64,18 @@ class ManagerServer {
   // is then computed PER LOCAL RANK from the shared quorum (each local rank
   // gets its own recovery primary / store, spreading healing and rendezvous
   // load across max-step groups — reference src/manager.rs:256).
+  //
+  // Rounds are keyed by step so a client retry after a lost response
+  // re-lands in ITS OWN round and gets the identical (idempotent) answer
+  // instead of double-joining the next round's barrier.
   struct QuorumRound {
     std::map<int64_t, std::string> joined;  // rank -> checkpoint server addr
-    int64_t max_local_step = 0;
     bool in_flight = false;  // lighthouse RPC running
     bool done = false;
     Quorum quorum;
     std::string error;
   };
-  std::shared_ptr<QuorumRound> quorum_round_;
+  std::map<int64_t, std::shared_ptr<QuorumRound>> quorum_rounds_;  // by step
   // Requires the round to be done and error-free.
   bool compute_response(const QuorumRound& round, int64_t rank,
                         int64_t req_step, ManagerQuorumResponse* out,
@@ -83,11 +86,15 @@ class ManagerServer {
     bool done = false;
     bool decision = false;
   };
-  std::shared_ptr<CommitRound> commit_round_;
+  std::map<int64_t, std::shared_ptr<CommitRound>> commit_rounds_;  // by step
 
   // rank -> checkpoint server address, refreshed each quorum; served to
   // healing peers via CheckpointAddress.
   std::map<int64_t, std::string> checkpoint_addrs_;
+
+  // In-flight lighthouse quorum client, published so shutdown() can cancel
+  // a call parked at the lighthouse.
+  std::shared_ptr<RpcClient> lighthouse_inflight_;
 
   std::unique_ptr<RpcServer> server_;
   std::thread heartbeat_thread_;

@@ -174,13 +174,11 @@ void RpcServer::shutdown() {
   ::shutdown(listen_fd_, SHUT_RDWR);
   close(listen_fd_);
   if (accept_thread_.joinable()) accept_thread_.join();
-  std::vector<std::thread> threads;
-  {
-    std::lock_guard<std::mutex> lk(conns_mu_);
-    for (int fd : conn_fds_) ::shutdown(fd, SHUT_RDWR);
-    threads.swap(conn_threads_);
-  }
-  for (auto& t : threads) t.join();
+  // Wake live connections and wait for their (detached) threads to
+  // deregister — handlers must not outlive the server they call into.
+  std::unique_lock<std::mutex> lk(conns_mu_);
+  for (int fd : conn_fds_) ::shutdown(fd, SHUT_RDWR);
+  conns_cv_.wait(lk, [this] { return conn_fds_.empty(); });
 }
 
 void RpcServer::accept_loop() {
@@ -194,18 +192,30 @@ void RpcServer::accept_loop() {
       close(fd);
       return;
     }
-    conn_fds_.push_back(fd);
-    conn_threads_.emplace_back([this, fd] { serve_conn(fd); });
+    conn_fds_.insert(fd);
+    std::thread([this, fd] {
+      serve_conn(fd);
+      // Deregister and close atomically so shutdown() can never hit a
+      // recycled fd number.
+      std::lock_guard<std::mutex> lk2(conns_mu_);
+      conn_fds_.erase(fd);
+      close(fd);
+      conns_cv_.notify_all();
+    }).detach();
   }
 }
 
 void RpcServer::serve_conn(int fd) {
   // Sniff for HTTP (dashboard sharing the control port, like the reference
-  // lighthouse's accept_http1).
-  char first;
-  ssize_t r = recv(fd, &first, 1, MSG_PEEK);
-  if (r == 1 && (first == 'G' || first == 'P' || first == 'H') &&
-      http_handler_) {
+  // lighthouse's accept_http1). A single byte is ambiguous with the RPC
+  // length prefix (payload sizes whose low byte is 'G'/'P'/'H'), so require
+  // a full method token.
+  char head[4] = {0};
+  ssize_t r = recv(fd, head, 4, MSG_PEEK | MSG_WAITALL);
+  bool is_http = r == 4 && (memcmp(head, "GET ", 4) == 0 ||
+                            memcmp(head, "POST", 4) == 0 ||
+                            memcmp(head, "HEAD", 4) == 0);
+  if (is_http && http_handler_) {
     std::string req;
     char buf[4096];
     while (req.find("\r\n\r\n") == std::string::npos) {
@@ -218,8 +228,7 @@ void RpcServer::serve_conn(int fd) {
       std::string resp = http_handler_(req);
       net_write_all(fd, resp.data(), resp.size());
     }
-    close(fd);
-    return;
+    return;  // fd closed by the accept_loop wrapper after deregistration
   }
 
   while (true) {
@@ -236,7 +245,7 @@ void RpcServer::serve_conn(int fd) {
     }
     if (!write_frame(fd, ok ? 0 : 1, ok ? resp : err)) break;
   }
-  close(fd);
+  // fd closed by the accept_loop wrapper after deregistration.
 }
 
 // ------------------------------------------------------------------ client
@@ -252,9 +261,24 @@ RpcClient::~RpcClient() {
   if (fd_ >= 0) close(fd_);
 }
 
+void RpcClient::cancel() {
+  std::lock_guard<std::mutex> lk(fd_mu_);
+  cancelled_ = true;
+  if (fd_ >= 0) ::shutdown(fd_, SHUT_RDWR);
+}
+
+bool RpcClient::check_cancelled(std::string* err) {
+  std::lock_guard<std::mutex> lk(fd_mu_);
+  if (cancelled_) *err = "transport: cancelled";
+  return cancelled_;
+}
+
 bool RpcClient::reconnect(std::string* err) {
+  int nfd = net_connect(address_, connect_timeout_ms_);
+  std::lock_guard<std::mutex> lk(fd_mu_);
   if (fd_ >= 0) close(fd_);
-  fd_ = net_connect(address_, connect_timeout_ms_);
+  fd_ = nfd;
+  if (cancelled_ && fd_ >= 0) ::shutdown(fd_, SHUT_RDWR);
   if (fd_ < 0) {
     *err = "transport: reconnect to " + address_ + " failed";
     return false;
@@ -265,6 +289,7 @@ bool RpcClient::reconnect(std::string* err) {
 bool RpcClient::call(uint8_t method, const std::string& req, std::string* resp,
                      std::string* err, int64_t timeout_ms) {
   std::lock_guard<std::mutex> lk(mu_);
+  if (check_cancelled(err)) return false;
   struct timeval tv = {};
   if (timeout_ms > 0) {
     tv.tv_sec = timeout_ms / 1000;
@@ -273,6 +298,7 @@ bool RpcClient::call(uint8_t method, const std::string& req, std::string* resp,
   setsockopt(fd_, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
 
   for (int attempt = 0; attempt < 2; attempt++) {
+    if (check_cancelled(err)) return false;
     if (write_frame(fd_, method, req)) {
       uint8_t status;
       std::string payload;

@@ -15,10 +15,12 @@
 // (src/lighthouse.rs:257-263 accept_http1).
 #pragma once
 
+#include <condition_variable>
 #include <cstdint>
 #include <functional>
 #include <memory>
 #include <mutex>
+#include <set>
 #include <string>
 #include <thread>
 #include <vector>
@@ -66,8 +68,11 @@ class RpcServer {
   HttpHandler http_handler_;
   std::thread accept_thread_;
   std::mutex conns_mu_;
-  std::vector<std::thread> conn_threads_;
-  std::vector<int> conn_fds_;
+  std::condition_variable conns_cv_;
+  // Live connection fds only; serve_conn threads are detached and deregister
+  // themselves on exit (dashboard polling creates one short-lived connection
+  // per second — tracking finished threads forever would leak).
+  std::set<int> conn_fds_;
   bool shutdown_ = false;
 };
 
@@ -83,14 +88,24 @@ class RpcClient {
   bool call(uint8_t method, const std::string& req, std::string* resp,
             std::string* err, int64_t timeout_ms);
 
+  // Thread-safe: aborts any in-flight call (its socket read fails
+  // immediately) and makes all future calls fail fast. Used to make
+  // server shutdown cancellable while a call is parked at a peer.
+  void cancel();
+
   const std::string& address() const { return address_; }
 
  private:
   bool reconnect(std::string* err);
+  bool check_cancelled(std::string* err);
   std::string address_;
   int64_t connect_timeout_ms_;
   int fd_ = -1;
   std::mutex mu_;
+  // Guards fd_ swaps/cancellation only (mu_ is held for a whole call, so
+  // cancel() cannot take it).
+  std::mutex fd_mu_;
+  bool cancelled_ = false;
 };
 
 // --- small net utils (shared with the checkpoint/http bits) ---

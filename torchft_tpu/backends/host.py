@@ -1,0 +1,373 @@
+"""Host-mediated TCP communicator: the cross-replica-group collective backend.
+
+This is the Gloo-role backend of the framework (reference
+``ProcessGroupGloo``, /root/reference/torchft/process_group.py:246-257):
+rank-``r`` hosts of every replica group form a TCP ring over DCN and run
+bandwidth-optimal ring collectives on host numpy buffers. It is
+reconfigure-friendly by construction — sockets are rebuilt per
+``configure()`` from a store rendezvous namespaced by quorum id, and closing
+them aborts in-flight work immediately (no wedged NCCL-style aborts, the
+problem that forced the reference into subprocess isolation,
+``process_group.py:511-741``).
+
+Design notes:
+- One background op thread per communicator: collectives are issued in
+  program order on every rank (a requirement shared with every collective
+  library), run asynchronously, and resolve ``Future``s.
+- Leaves are concatenated per dtype into single ring buffers, so per-step
+  cost is O(bytes) with one ring round-trip per dtype, not per leaf.
+- A fresh listener per configure + per-quorum store prefixes make stale
+  peers from an old quorum fail fast instead of cross-talking.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import socket
+import struct
+import threading
+from concurrent.futures import Future
+from typing import Any, List, Optional, Tuple
+
+import numpy as np
+import jax
+
+from torchft_tpu._native import StoreClient
+from torchft_tpu.communicator import Communicator, CommunicatorError
+from torchft_tpu.serialization import load_pytree, save_pytree
+from torchft_tpu.utils import advertise_host
+
+logger: logging.Logger = logging.getLogger(__name__)
+
+
+def _send_all(sock: socket.socket, data: bytes | memoryview) -> None:
+    sock.sendall(data)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytearray:
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        r = sock.recv_into(view[got:], n - got)
+        if r == 0:
+            raise CommunicatorError("peer closed connection")
+        got += r
+    return buf
+
+
+class _Ring:
+    """The per-epoch socket pair (next/prev neighbors on the ring)."""
+
+    def __init__(self, next_sock: socket.socket, prev_sock: socket.socket,
+                 listener: socket.socket):
+        self.next_sock = next_sock
+        self.prev_sock = prev_sock
+        self.listener = listener
+
+    def exchange(self, send_buf, recv_nbytes: int) -> bytearray:
+        """Full-duplex: send to next while receiving from prev."""
+        err: List[Exception] = []
+
+        def sender() -> None:
+            try:
+                _send_all(self.next_sock, send_buf)
+            except Exception as e:  # noqa: BLE001
+                err.append(e)
+
+        t = threading.Thread(target=sender, daemon=True)
+        t.start()
+        try:
+            out = _recv_exact(self.prev_sock, recv_nbytes)
+        finally:
+            t.join()
+        if err:
+            raise CommunicatorError(f"ring send failed: {err[0]}")
+        return out
+
+    def close(self) -> None:
+        for s in (self.next_sock, self.prev_sock, self.listener):
+            try:
+                s.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                s.close()
+            except OSError:
+                pass
+
+
+class HostCommunicator(Communicator):
+    def __init__(self, timeout_sec: float = 60.0) -> None:
+        self._timeout = timeout_sec
+        self._rank = 0
+        self._world = 1
+        self._ring: Optional[_Ring] = None
+        self._epoch = 0
+        self._lock = threading.Lock()
+        self._ops: "queue.Queue[Optional[Tuple]]" = queue.Queue()
+        self._worker = threading.Thread(target=self._run, daemon=True,
+                                        name="host-comm")
+        self._worker.start()
+        self._shutdown = False
+
+    # ------------------------------------------------------------ configure
+
+    def configure(self, store_addr: str, rank: int, world_size: int) -> None:
+        """Rebuild the ring for a new (rank, world_size).
+
+        ``store_addr`` is ``"host:port/prefix..."``; each rank publishes its
+        fresh listener under ``{prefix}/{rank}`` and dials its successor.
+        In-flight collectives from the previous epoch are aborted by closing
+        their sockets (reference abort-then-rebuild,
+        ``process_group.py:203-218``)."""
+        with self._lock:
+            old, self._ring = self._ring, None
+            self._epoch += 1
+            epoch = self._epoch
+        if old is not None:
+            old.close()
+        # Fail anything still queued from the old epoch.
+        self._drain_queue("aborted by reconfigure")
+
+        self._rank = rank
+        self._world = world_size
+        if world_size == 1:
+            return
+
+        host_port, _, prefix = store_addr.partition("/")
+        store = StoreClient(host_port, connect_timeout_ms=int(
+            self._timeout * 1000))
+
+        listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        listener.bind(("0.0.0.0", 0))
+        listener.listen(4)
+        listener.settimeout(self._timeout)
+        my_addr = f"{advertise_host()}:{listener.getsockname()[1]}"
+        store.set(f"{prefix}/{rank}", my_addr.encode())
+
+        next_rank = (rank + 1) % world_size
+        next_addr = store.get(f"{prefix}/{next_rank}",
+                              timeout_ms=int(self._timeout * 1000)).decode()
+        nhost, _, nport = next_addr.rpartition(":")
+        next_sock = socket.create_connection((nhost, int(nport)),
+                                             timeout=self._timeout)
+        next_sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        # Identify ourselves so the acceptor can reject stale dialers.
+        _send_all(next_sock, struct.pack("<qq", epoch_key(prefix), rank))
+
+        prev_sock = None
+        while prev_sock is None:
+            cand, _ = listener.accept()
+            cand.settimeout(self._timeout)
+            cand.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            key, peer_rank = struct.unpack("<qq",
+                                           bytes(_recv_exact(cand, 16)))
+            if key == epoch_key(prefix) and peer_rank == (
+                    rank - 1) % world_size:
+                prev_sock = cand
+            else:
+                cand.close()
+        next_sock.settimeout(self._timeout)
+
+        with self._lock:
+            if self._epoch != epoch:  # raced with another configure
+                next_sock.close()
+                prev_sock.close()
+                listener.close()
+                return
+            self._ring = _Ring(next_sock, prev_sock, listener)
+        logger.info("host communicator configured: rank=%d world=%d (%s)",
+                    rank, world_size, prefix)
+
+    def _drain_queue(self, reason: str) -> None:
+        while True:
+            try:
+                item = self._ops.get_nowait()
+            except queue.Empty:
+                return
+            if item is not None:
+                item[0].set_exception(CommunicatorError(reason))
+
+    # ------------------------------------------------------------ op plumbing
+
+    def _submit(self, kind: str, *args: Any) -> Future:
+        fut: Future = Future()
+        self._ops.put((fut, self._epoch, kind, args))
+        return fut
+
+    def _run(self) -> None:
+        while True:
+            item = self._ops.get()
+            if item is None:
+                return
+            fut, epoch, kind, args = item
+            try:
+                with self._lock:
+                    ring = self._ring
+                    if epoch != self._epoch:
+                        raise CommunicatorError("aborted by reconfigure")
+                if kind == "allreduce":
+                    fut.set_result(self._do_allreduce(ring, *args))
+                elif kind == "broadcast":
+                    fut.set_result(self._do_broadcast(ring, *args))
+                elif kind == "allgather":
+                    fut.set_result(self._do_allgather(ring, *args))
+                else:
+                    raise CommunicatorError(f"unknown op {kind}")
+            except Exception as e:  # noqa: BLE001
+                fut.set_exception(
+                    e if isinstance(e, CommunicatorError)
+                    else CommunicatorError(str(e)))
+
+    # ------------------------------------------------------------ collectives
+
+    def allreduce(self, tree: Any, op: str = "sum") -> Future:
+        if self._world == 1:
+            return self._immediate(tree)
+        return self._submit("allreduce", tree, op)
+
+    def broadcast(self, tree: Any, root: int = 0) -> Future:
+        if self._world == 1:
+            return self._immediate(tree)
+        return self._submit("broadcast", tree, root)
+
+    def allgather(self, tree: Any) -> Future:
+        if self._world == 1:
+            return self._immediate([tree])
+        return self._submit("allgather", tree)
+
+    def _immediate(self, value: Any) -> Future:
+        f: Future = Future()
+        f.set_result(value)
+        return f
+
+    def _do_allreduce(self, ring: Optional[_Ring], tree: Any, op: str) -> Any:
+        if ring is None:
+            raise CommunicatorError("communicator not configured")
+        leaves, treedef = jax.tree_util.tree_flatten(tree)
+        arrs = [np.asarray(leaf) for leaf in leaves]
+        # Group leaves by dtype into contiguous ring buffers.
+        by_dtype: dict = {}
+        for i, a in enumerate(arrs):
+            by_dtype.setdefault(a.dtype.str, []).append(i)
+        out: List[Optional[np.ndarray]] = [None] * len(arrs)
+        for dtype_str, idxs in by_dtype.items():
+            flat = np.concatenate(
+                [arrs[i].reshape(-1) for i in idxs]) if idxs else None
+            reduced = self._ring_allreduce_buffer(ring, flat)
+            if op == "mean":
+                if np.issubdtype(reduced.dtype, np.inexact):
+                    reduced /= self._world
+                else:
+                    reduced //= self._world
+            pos = 0
+            for i in idxs:
+                n = arrs[i].size
+                out[i] = reduced[pos:pos + n].reshape(arrs[i].shape)
+                pos += n
+        return jax.tree_util.tree_unflatten(treedef, out)
+
+    def _ring_allreduce_buffer(self, ring: _Ring,
+                               flat: np.ndarray) -> np.ndarray:
+        """Bandwidth-optimal ring allreduce: reduce-scatter + allgather."""
+        n = self._world
+        rank = self._rank
+        acc = flat.copy()
+        bounds = np.linspace(0, acc.size, n + 1, dtype=np.int64)
+
+        def chunk(i: int) -> np.ndarray:
+            i %= n
+            return acc[bounds[i]:bounds[i + 1]]
+
+        itemsize = acc.itemsize
+        for step in range(n - 1):
+            send_c = chunk(rank - step)
+            recv_c = chunk(rank - step - 1)
+            data = ring.exchange(np.ascontiguousarray(send_c).data,
+                                 recv_c.size * itemsize)
+            recv_c += np.frombuffer(bytes(data), dtype=acc.dtype)
+        for step in range(n - 1):
+            send_c = chunk(rank + 1 - step)
+            recv_c = chunk(rank - step)
+            data = ring.exchange(np.ascontiguousarray(send_c).data,
+                                 recv_c.size * itemsize)
+            recv_c[:] = np.frombuffer(bytes(data), dtype=acc.dtype)
+        return acc
+
+    def _do_broadcast(self, ring: Optional[_Ring], tree: Any,
+                      root: int) -> Any:
+        if ring is None:
+            raise CommunicatorError("communicator not configured")
+        n, rank = self._world, self._rank
+        if rank == root:
+            payload = save_pytree(tree)
+            _send_all(ring.next_sock, struct.pack("<q", len(payload)))
+            _send_all(ring.next_sock, payload)
+            return tree
+        size = struct.unpack("<q", bytes(_recv_exact(ring.prev_sock, 8)))[0]
+        payload = bytes(_recv_exact(ring.prev_sock, size))
+        if (rank + 1) % n != root:  # forward along the ring
+            _send_all(ring.next_sock, struct.pack("<q", len(payload)))
+            _send_all(ring.next_sock, payload)
+        return load_pytree(payload, tree)
+
+    def _do_allgather(self, ring: Optional[_Ring], tree: Any) -> List[Any]:
+        if ring is None:
+            raise CommunicatorError("communicator not configured")
+        n, rank = self._world, self._rank
+        results: List[Optional[Any]] = [None] * n
+        results[rank] = tree
+        payload = save_pytree(tree)
+        for step in range(n - 1):
+            header = struct.pack("<qq", (rank - step) % n, len(payload))
+            err: List[Exception] = []
+
+            def sender(h=header, p=payload):
+                try:
+                    _send_all(ring.next_sock, h)
+                    _send_all(ring.next_sock, p)
+                except Exception as e:  # noqa: BLE001
+                    err.append(e)
+
+            t = threading.Thread(target=sender, daemon=True)
+            t.start()
+            src, size = struct.unpack(
+                "<qq", bytes(_recv_exact(ring.prev_sock, 16)))
+            payload = bytes(_recv_exact(ring.prev_sock, size))
+            t.join()
+            if err:
+                raise CommunicatorError(f"allgather send failed: {err[0]}")
+            results[src] = load_pytree(payload, tree)
+        return results  # type: ignore[return-value]
+
+    # ------------------------------------------------------------- accessors
+
+    def size(self) -> int:
+        return self._world
+
+    def rank(self) -> int:
+        return self._rank
+
+    def shutdown(self) -> None:
+        if self._shutdown:
+            return
+        self._shutdown = True
+        self._drain_queue("communicator shutdown")
+        self._ops.put(None)
+        with self._lock:
+            ring, self._ring = self._ring, None
+        if ring is not None:
+            ring.close()
+        self._worker.join(timeout=5)
+
+
+def epoch_key(prefix: str) -> int:
+    """Stable 63-bit hash of the store prefix, used in the ring handshake so
+    dialers from a different quorum epoch are rejected at accept."""
+    h = 1469598103934665603
+    for b in prefix.encode():
+        h = ((h ^ b) * 1099511628211) & 0x7FFFFFFFFFFFFFFF
+    return h

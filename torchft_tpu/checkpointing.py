@@ -1,0 +1,144 @@
+"""Live-weight checkpoint transfer for healing.
+
+Each worker runs a :class:`CheckpointServer`: a daemon-threaded HTTP server
+streaming the **live** state pytree for ``GET /checkpoint/{step}`` — state is
+produced lazily inside the request handler, no disk involved, exactly like the
+reference (/root/reference/torchft/checkpointing.py:50-72 serving
+``torch.save(state_dict())`` per request).
+
+Consistency comes from step gating (reference ``checkpointing.py:123-144``):
+the Manager opens the window with :meth:`allow_checkpoint` at step start
+(while compute runs) and shuts it with :meth:`disallow_checkpoint` at commit,
+so a healer can never observe a half-updated state. Requests for a different
+step get 400.
+
+TPU-native difference: the payload is the :mod:`torchft_tpu.serialization`
+pytree format (no pickle — a malicious peer cannot execute code on the
+healer, unlike ``torch.load``), and restore goes through ``jax.device_put``
+with the healer's own shardings.
+"""
+
+from __future__ import annotations
+
+import logging
+import socket
+import threading
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Optional, TypeVar
+
+from torchft_tpu.utils import advertise_host
+from torchft_tpu.serialization import (
+    device_put_like,
+    load_pytree,
+    save_pytree,
+)
+
+T = TypeVar("T")
+logger: logging.Logger = logging.getLogger(__name__)
+
+
+class _CheckpointHTTPServer(ThreadingHTTPServer):
+    # Large accept backlog: after a failure many healers may hit the same
+    # primary at once (reference /root/reference/torchft/http.py:5-7).
+    request_queue_size = 1024
+    daemon_threads = True
+    address_family = socket.AF_INET
+
+
+class CheckpointServer:
+    """Serves the live state pytree to healing peers, step-gated.
+
+    Args:
+        state_fn: zero-arg callable returning the current state pytree. Called
+            lazily inside the GET handler, under the serve lock.
+    """
+
+    def __init__(self, state_fn: Callable[[], T]) -> None:
+        self._state_fn = state_fn
+        # The serve gate: held (locked) whenever serving is disallowed.
+        # Acquired/released across threads, which plain Lock permits — same
+        # discipline as the reference (checkpointing.py:123-144).
+        self._checkpoint_lock = threading.Lock()
+        self._disallowed = False
+        self._step = -1
+
+        ckpt_server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # quiet
+                logger.debug("checkpoint http: " + fmt, *args)
+
+            def do_GET(self) -> None:
+                with ckpt_server._checkpoint_lock:
+                    step = ckpt_server._step
+                    prefix = "/checkpoint/"
+                    if not self.path.startswith(prefix):
+                        self.send_error(404, "unknown path")
+                        return
+                    try:
+                        req_step = int(self.path[len(prefix):])
+                    except ValueError:
+                        self.send_error(400, "bad step")
+                        return
+                    if req_step != step:
+                        self.send_error(
+                            400,
+                            f"invalid checkpoint requested: serving {step} "
+                            f"but got {req_step}")
+                        return
+                    try:
+                        data = save_pytree(ckpt_server._state_fn())
+                    except Exception as e:  # surface to healer, keep serving
+                        logger.exception("checkpoint state_fn failed")
+                        self.send_error(500, str(e))
+                        return
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "application/octet-stream")
+                    self.send_header("Content-Length", str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data)
+
+        self._server = _CheckpointHTTPServer(("0.0.0.0", 0), Handler)
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name="checkpoint-server")
+        self._thread.start()
+
+    def address(self) -> str:
+        """Dialable HTTP URL for the current step's checkpoint."""
+        port = self._server.server_address[1]
+        return f"http://{advertise_host()}:{port}/checkpoint/{self._step}"
+
+    def allow_checkpoint(self, step: int) -> None:
+        """Open the serve window for ``step`` (called at step start, while
+        the forward/backward runs — the state is still the pre-update one)."""
+        self._step = step
+        if self._disallowed:
+            self._disallowed = False
+            self._checkpoint_lock.release()
+
+    def disallow_checkpoint(self) -> None:
+        """Shut the serve window (called at commit, before state mutates).
+        Blocks until in-flight GETs finish."""
+        if not self._disallowed:
+            self._disallowed = True
+            self._checkpoint_lock.acquire()
+
+    def shutdown(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+    @classmethod
+    def load_from_address(cls, address: str, target: T,
+                          timeout_sec: float = 300.0,
+                          device_put: bool = True) -> T:
+        """Fetch a peer's live checkpoint and restore it into ``target``'s
+        structure (and shardings, when ``device_put``)."""
+        logger.info("fetching checkpoint from %s", address)
+        with urllib.request.urlopen(address, timeout=timeout_sec) as resp:
+            data = resp.read()
+        return load_pytree(
+            data, target,
+            device_put_fn=device_put_like if device_put else None)

@@ -1,0 +1,269 @@
+"""Resizable cross-replica-group communicators.
+
+The fault-tolerance-critical collective path. Plays the role of the
+reference's reconfigurable ProcessGroups
+(/root/reference/torchft/process_group.py): a :class:`Communicator` can be
+``configure()``-d onto a new (rank, world_size) between steps via a
+store-prefix rendezvous keyed by quorum id — stragglers from an old quorum
+can never cross-talk with the new one (reference ``manager.py:374-376``).
+
+TPU-native mapping (SURVEY.md §7): *intra*-group collectives are XLA's job
+(``psum`` et al. over ICI inside the jitted step); communicators here carry
+*cross*-group traffic (gradient averaging between slices) host-side over
+TCP/DCN, which is what makes membership changes possible at all — XLA cannot
+resize a compiled collective's world at runtime, so the resizable collective
+must live outside the accelerator runtime. The reference reached the same
+architecture for different reasons (NCCL aborts hang,
+``process_group.py:259-275``); on TPU the host-mediated path is the design
+default, with the on-device multi-slice mesh as the stable-membership
+optimization (``backends/mesh.py``).
+
+Variants mirror the reference inventory: :class:`DummyCommunicator`
+(``ProcessGroupDummy``, :279-344), :class:`ErrorSwallowingCommunicator`
+(:347-440), :class:`ManagedCommunicator` (:443-468), and
+:class:`HostCommunicator` (the Gloo-role backend, in
+``backends/host.py``).
+
+All collectives operate on pytrees of host numpy arrays and return
+:class:`concurrent.futures.Future` so the Manager can overlap them with
+compute and drain them at commit (``manager.py:429-438``).
+"""
+
+from __future__ import annotations
+
+import logging
+from abc import ABC, abstractmethod
+from concurrent.futures import Future
+from typing import Any, Callable, Optional
+
+logger: logging.Logger = logging.getLogger(__name__)
+
+
+class CommunicatorError(RuntimeError):
+    """A collective failed (peer death, timeout, reconfiguration abort)."""
+
+
+class Communicator(ABC):
+    """Abstract resizable communicator (reference ``ProcessGroup``,
+    ``process_group.py:88-187``)."""
+
+    @abstractmethod
+    def configure(self, store_addr: str, rank: int, world_size: int) -> None:
+        """(Re)configure onto a new world. ``store_addr`` is
+        ``"host:port/prefix..."`` — a KV store plus key prefix unique to the
+        quorum. Aborts any in-flight work from the previous configuration."""
+
+    @abstractmethod
+    def allreduce(self, tree: Any, op: str = "sum") -> Future:
+        """Sum (or mean) a pytree of numpy arrays across the world."""
+
+    @abstractmethod
+    def broadcast(self, tree: Any, root: int = 0) -> Future:
+        """Broadcast root's pytree to all ranks."""
+
+    @abstractmethod
+    def allgather(self, tree: Any) -> Future:
+        """Gather every rank's pytree; resolves to a list of ``world_size``
+        pytrees."""
+
+    @abstractmethod
+    def size(self) -> int: ...
+
+    @abstractmethod
+    def rank(self) -> int: ...
+
+    def shutdown(self) -> None:  # noqa: B027
+        pass
+
+
+def _done_future(value: Any = None) -> Future:
+    f: Future = Future()
+    f.set_result(value)
+    return f
+
+
+class DummyCommunicator(Communicator):
+    """Discards collectives, resolves immediately with the input.
+
+    First-class library code, not a test double only: used to soak init-time
+    collectives and as the world-size-1 stand-in, like the reference's
+    ``ProcessGroupDummy`` (``process_group.py:278-344``, used in prod at
+    ``ddp.py:50``). Instrumented with counters for tests
+    (``process_group.py:309-315``)."""
+
+    def __init__(self, rank: int = 0, world_size: int = 1) -> None:
+        self._rank = rank
+        self._world = world_size
+        self.configure_count = 0
+        self.allreduce_count = 0
+        self.broadcast_count = 0
+        self.allgather_count = 0
+
+    def configure(self, store_addr: str, rank: int, world_size: int) -> None:
+        self.configure_count += 1
+        self._rank = rank
+        self._world = world_size
+
+    def allreduce(self, tree: Any, op: str = "sum") -> Future:
+        self.allreduce_count += 1
+        return _done_future(tree)
+
+    def broadcast(self, tree: Any, root: int = 0) -> Future:
+        self.broadcast_count += 1
+        return _done_future(tree)
+
+    def allgather(self, tree: Any) -> Future:
+        self.allgather_count += 1
+        return _done_future([tree] * self._world)
+
+    def size(self) -> int:
+        return self._world
+
+    def rank(self) -> int:
+        return self._rank
+
+
+class ErrorSwallowingCommunicator(Communicator):
+    """Latches the first error; subsequent collectives return already-resolved
+    futures with the input unchanged until the next ``configure()``.
+
+    This keeps every rank's step structure identical even when collectives
+    fail mid-step, deferring the consequence to the commit vote — the
+    reference's ``ErrorSwallowingProcessGroupWrapper``
+    (``process_group.py:347-440``)."""
+
+    def __init__(self, comm: Communicator,
+                 on_error: Optional[Callable[[Exception], None]] = None):
+        self._comm = comm
+        self._on_error = on_error
+        self._error: Optional[Exception] = None
+
+    def error(self) -> Optional[Exception]:
+        return self._error
+
+    def report_error(self, e: Exception) -> None:
+        if self._error is None:
+            logger.warning("communicator error latched: %s", e)
+            self._error = e
+            if self._on_error is not None:
+                self._on_error(e)
+
+    def configure(self, store_addr: str, rank: int, world_size: int) -> None:
+        self._error = None  # reconfiguration clears the latch (ref :397-400)
+        self._comm.configure(store_addr, rank, world_size)
+
+    def _wrap(self, fut: Future, fallback: Any) -> Future:
+        out: Future = Future()
+
+        def relay(f: Future) -> None:
+            e = f.exception()
+            if e is None:
+                out.set_result(f.result())
+            else:
+                self.report_error(e)
+                out.set_result(fallback)
+
+        fut.add_done_callback(relay)
+        return out
+
+    def allreduce(self, tree: Any, op: str = "sum") -> Future:
+        if self._error is not None:
+            return _done_future(tree)
+        try:
+            return self._wrap(self._comm.allreduce(tree, op), tree)
+        except Exception as e:
+            self.report_error(e)
+            return _done_future(tree)
+
+    def broadcast(self, tree: Any, root: int = 0) -> Future:
+        if self._error is not None:
+            return _done_future(tree)
+        try:
+            return self._wrap(self._comm.broadcast(tree, root), tree)
+        except Exception as e:
+            self.report_error(e)
+            return _done_future(tree)
+
+    def allgather(self, tree: Any) -> Future:
+        fallback = [tree] * self.size()
+        if self._error is not None:
+            return _done_future(fallback)
+        try:
+            return self._wrap(self._comm.allgather(tree), fallback)
+        except Exception as e:
+            self.report_error(e)
+            return _done_future(fallback)
+
+    def size(self) -> int:
+        return self._comm.size()
+
+    def rank(self) -> int:
+        return self._comm.rank()
+
+    def shutdown(self) -> None:
+        self._comm.shutdown()
+
+
+class ManagedCommunicator(Communicator):
+    """Binds a communicator to a Manager: errors are reported to the manager
+    (feeding the commit vote) and ``size()`` reflects the current number of
+    participating groups, so 1/n normalization tracks membership — the
+    reference's ``ManagedProcessGroup`` (``process_group.py:443-468``)."""
+
+    def __init__(self, manager: "Manager") -> None:  # noqa: F821
+        self._manager = manager
+
+    @property
+    def _comm(self) -> Communicator:
+        return self._manager._comm
+
+    def configure(self, store_addr: str, rank: int, world_size: int) -> None:
+        self._comm.configure(store_addr, rank, world_size)
+
+    def _guard(self, fut: Future, fallback: Any) -> Future:
+        out: Future = Future()
+
+        def relay(f: Future) -> None:
+            e = f.exception()
+            if e is None:
+                out.set_result(f.result())
+            else:
+                self._manager.report_error(e)
+                out.set_result(fallback)
+
+        fut.add_done_callback(relay)
+        return out
+
+    def allreduce(self, tree: Any, op: str = "sum") -> Future:
+        if self._manager.errored() is not None:
+            return _done_future(tree)
+        try:
+            return self._guard(self._comm.allreduce(tree, op), tree)
+        except Exception as e:
+            self._manager.report_error(e)
+            return _done_future(tree)
+
+    def broadcast(self, tree: Any, root: int = 0) -> Future:
+        if self._manager.errored() is not None:
+            return _done_future(tree)
+        try:
+            return self._guard(self._comm.broadcast(tree, root), tree)
+        except Exception as e:
+            self._manager.report_error(e)
+            return _done_future(tree)
+
+    def allgather(self, tree: Any) -> Future:
+        fallback = [tree] * self.size()
+        if self._manager.errored() is not None:
+            return _done_future(fallback)
+        try:
+            return self._guard(self._comm.allgather(tree), fallback)
+        except Exception as e:
+            self._manager.report_error(e)
+            return _done_future(fallback)
+
+    def size(self) -> int:
+        return self._manager.num_participants()
+
+    def rank(self) -> int:
+        return self._comm.rank()

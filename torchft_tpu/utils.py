@@ -1,0 +1,16 @@
+"""Small shared utilities."""
+
+from __future__ import annotations
+
+import socket
+
+
+def advertise_host() -> str:
+    """Hostname peers should dial; falls back to loopback when the hostname
+    doesn't resolve (single-host test topologies)."""
+    host = socket.gethostname()
+    try:
+        socket.getaddrinfo(host, None)
+        return host
+    except OSError:
+        return "127.0.0.1"
