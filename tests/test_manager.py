@@ -1,0 +1,349 @@
+"""Manager state-machine unit tests.
+
+Mirrors the reference's mocked-client test strategy
+(/root/reference/torchft/manager_test.py): a real :class:`Manager` with the
+native ``ManagerClient`` replaced by a mock and the communicator replaced by
+:class:`DummyCommunicator`, making every protocol branch testable in one
+process — happy path, sync/async healing, error latching + next-step
+recovery, spares participation, and 1/n numerics.
+"""
+
+from unittest.mock import MagicMock, patch
+
+import numpy as np
+import pytest
+
+from torchft_tpu._native import QuorumResult
+from torchft_tpu.communicator import DummyCommunicator
+from torchft_tpu.manager import Manager, WorldSizeMode
+
+
+def quorum_result(
+    quorum_id=1,
+    recover_manager_address="manager:1234",
+    store_address="store:1234",
+    max_step=1,
+    max_rank=0,
+    max_world_size=2,
+    replica_rank=0,
+    replica_world_size=2,
+    heal=False,
+):
+    return QuorumResult(
+        quorum_id=quorum_id,
+        recover_manager_address=recover_manager_address,
+        store_address=store_address,
+        max_step=max_step,
+        max_rank=max_rank,
+        max_world_size=max_world_size,
+        replica_rank=replica_rank,
+        replica_world_size=replica_world_size,
+        heal=heal,
+    )
+
+
+def make_manager(client, comm=None, use_async_quorum=True,
+                 min_replica_size=2, world_size_mode=WorldSizeMode.DYNAMIC,
+                 load_state_dict=None, state_dict=None):
+    return Manager(
+        comm=comm or DummyCommunicator(),
+        load_state_dict=load_state_dict or MagicMock(),
+        state_dict=state_dict or (lambda: {"w": np.ones(2)}),
+        min_replica_size=min_replica_size,
+        use_async_quorum=use_async_quorum,
+        world_size_mode=world_size_mode,
+        rank=0,
+        world_size=1,
+        replica_id="testgroup",
+        _manager_client=client,
+    )
+
+
+class TestManagerHappyPath:
+    """reference manager_test.py:81-113"""
+
+    def test_step_commit(self):
+        client = MagicMock()
+        client.quorum.return_value = quorum_result(max_step=1)
+        client.should_commit.return_value = True
+        comm = DummyCommunicator()
+        m = make_manager(client, comm)
+        try:
+            assert m.current_step() == 0
+            m.step()
+            fut = m.allreduce({"g": np.array([2.0, 4.0])})
+            out = fut.result()
+            # DummyCommunicator returns input unchanged; n=2 → halved.
+            np.testing.assert_allclose(out["g"], [1.0, 2.0])
+            assert m.should_commit()
+            assert m.current_step() == 1
+            assert m.num_participants() == 2
+            assert comm.configure_count == 1  # quorum_id -1 → 1
+            m.step()
+            assert m.current_step() == 2
+            assert m.batches_committed() == 2
+            # same quorum id → no reconfigure
+            assert comm.configure_count == 1
+        finally:
+            m.shutdown()
+
+    def test_quorum_id_change_reconfigures(self):
+        client = MagicMock()
+        client.quorum.return_value = quorum_result(quorum_id=1)
+        client.should_commit.return_value = True
+        comm = DummyCommunicator()
+        m = make_manager(client, comm)
+        try:
+            m.step()
+            m.should_commit()
+            client.quorum.return_value = quorum_result(quorum_id=2)
+            m.step()
+            m.allreduce({"g": np.zeros(1)}).result()
+            assert comm.configure_count == 2
+        finally:
+            m.shutdown()
+
+
+class TestManagerHealing:
+    """reference manager_test.py:116-257"""
+
+    def _heal_quorum(self, max_step=20):
+        return quorum_result(
+            quorum_id=1, max_step=max_step, max_rank=None, max_world_size=1,
+            replica_rank=1, replica_world_size=2, heal=True,
+        )
+
+    def _patch_heal(self, state):
+        checkpoint = patch(
+            "torchft_tpu.manager.CheckpointServer.load_from_address",
+            return_value=state,
+        )
+        primary = patch("torchft_tpu.manager.ManagerClient")
+        return checkpoint, primary
+
+    def test_async_heal(self):
+        client = MagicMock()
+        client.quorum.return_value = self._heal_quorum(max_step=20)
+        client.should_commit.return_value = True
+        loaded = MagicMock()
+        m = make_manager(client, use_async_quorum=True,
+                         load_state_dict=loaded, min_replica_size=1)
+        state = {"user": {"w": np.full(2, 7.0)},
+                 "torchft": {"step": 20, "batches_committed": 40}}
+        cp, pc = self._patch_heal(state)
+        try:
+            with cp, pc:
+                m.step()
+                # healer zeroes its contribution
+                fut = m.allreduce({"g": np.array([8.0])})
+                np.testing.assert_allclose(fut.result()["g"], [0.0])
+                assert m.is_healing()
+                assert not m.is_participating()
+                assert m.num_participants() == 1
+                assert m.should_commit()
+            # user state applied on the main thread at commit
+            loaded.assert_called_once()
+            assert loaded.call_args[0][0] == state["user"]
+            # manager metadata restored: step jumped to max_step
+            assert m.current_step() == 20
+            # next step participates normally
+            client.quorum.return_value = quorum_result(
+                quorum_id=1, max_step=21, max_rank=1, max_world_size=2,
+                replica_rank=1, replica_world_size=2)
+            m.step()
+            m._quorum_future.result()  # deterministic: join quorum thread
+            assert m.current_step() == 21
+            assert not m.is_healing()
+            assert m.is_participating()
+        finally:
+            m.shutdown()
+
+    def test_sync_heal_participates_immediately(self):
+        client = MagicMock()
+        client.quorum.return_value = quorum_result(
+            quorum_id=1, max_step=5, max_rank=None, max_world_size=1,
+            replica_rank=1, replica_world_size=2, heal=True)
+        client.should_commit.return_value = True
+        loaded = MagicMock()
+        m = make_manager(client, use_async_quorum=False,
+                         load_state_dict=loaded, min_replica_size=1)
+        state = {"user": {"w": np.zeros(1)},
+                 "torchft": {"step": 5, "batches_committed": 10}}
+        cp, pc = self._patch_heal(state)
+        try:
+            with cp, pc:
+                m.step()
+            # sync mode: state restored before compute, participates now
+            loaded.assert_called_once()
+            assert m.is_participating()
+            assert m.num_participants() == 2
+            assert m.current_step() == 5
+        finally:
+            m.shutdown()
+
+    def test_async_heal_too_few_participants_aborts_commit(self):
+        client = MagicMock()
+        client.quorum.return_value = self._heal_quorum()
+        client.should_commit.return_value = False
+        m = make_manager(client, min_replica_size=2)  # only 1 at max step
+        state = {"user": {}, "torchft": {"step": 20, "batches_committed": 0}}
+        cp, pc = self._patch_heal(state)
+        try:
+            with cp, pc:
+                m.step()
+                assert not m.should_commit()
+            # local vote must have been False (not enough participants)
+            assert client.should_commit.call_args.kwargs["should_commit"] is False
+        finally:
+            m.shutdown()
+
+
+class TestManagerErrors:
+    """reference manager_test.py:260-342"""
+
+    def test_allreduce_error_latches_and_recovers(self):
+        client = MagicMock()
+        client.quorum.return_value = quorum_result()
+        client.should_commit.side_effect = [False, True]
+        comm = DummyCommunicator()
+        m = make_manager(client, comm)
+        try:
+            m.step()
+            comm.allreduce = MagicMock(side_effect=RuntimeError("boom"))
+            tree = {"g": np.array([3.0])}
+            out = m.allreduce(tree).result()
+            np.testing.assert_allclose(out["g"], [3.0])  # fallback: unchanged
+            assert m.errored() is not None
+            # further collectives no-op instantly
+            out2 = m.allreduce({"g": np.array([5.0])}).result()
+            np.testing.assert_allclose(out2["g"], [5.0])
+            assert not m.should_commit()
+            assert client.should_commit.call_args.kwargs["should_commit"] is False
+
+            # next step: error cleared, step NOT bumped (no commit)
+            comm.allreduce = DummyCommunicator.allreduce.__get__(comm)
+            m.step()
+            assert m.errored() is None
+            assert m.current_step() == 1
+            m.allreduce({"g": np.array([4.0])}).result()
+            assert m.should_commit()
+        finally:
+            m.shutdown()
+
+    def test_poisoned_future_swallowed(self):
+        from concurrent.futures import Future
+
+        client = MagicMock()
+        client.quorum.return_value = quorum_result()
+        client.should_commit.return_value = False
+        comm = DummyCommunicator()
+        poisoned: Future = Future()
+        poisoned.set_exception(RuntimeError("late failure"))
+        comm.allreduce = MagicMock(return_value=poisoned)
+        m = make_manager(client, comm)
+        try:
+            m.step()
+            out = m.allreduce({"g": np.array([1.0, 2.0])}).result()
+            np.testing.assert_allclose(out["g"], [1.0, 2.0])
+            assert m.errored() is not None
+            assert not m.should_commit()
+        finally:
+            m.shutdown()
+
+    def test_quorum_error_latches(self):
+        client = MagicMock()
+        client.quorum.side_effect = RuntimeError("lighthouse down")
+        client.should_commit.return_value = False
+        m = make_manager(client)
+        try:
+            m.step()
+            out = m.allreduce({"g": np.array([9.0])}).result()
+            np.testing.assert_allclose(out["g"], [9.0])
+            assert m.errored() is not None
+        finally:
+            m.shutdown()
+
+
+class TestSpares:
+    """reference manager_test.py:345-379"""
+
+    def test_spare_is_benched(self):
+        client = MagicMock()
+        client.quorum.return_value = quorum_result(
+            max_rank=2, max_world_size=3, replica_rank=2,
+            replica_world_size=3)
+        client.should_commit.return_value = True
+        m = make_manager(client, min_replica_size=2,
+                         world_size_mode=WorldSizeMode.FIXED_WITH_SPARES)
+        try:
+            m.step()
+            out = m.allreduce({"g": np.array([6.0])}).result()
+            # benched: zero contribution, world clamped to 2 → 0/2
+            np.testing.assert_allclose(out["g"], [0.0])
+            assert not m.is_participating()
+            assert m.num_participants() == 2
+        finally:
+            m.shutdown()
+
+    def test_non_spare_clamped_world(self):
+        client = MagicMock()
+        client.quorum.return_value = quorum_result(
+            max_rank=1, max_world_size=3, replica_rank=1,
+            replica_world_size=3)
+        client.should_commit.return_value = True
+        m = make_manager(client, min_replica_size=2,
+                         world_size_mode=WorldSizeMode.FIXED_WITH_SPARES)
+        try:
+            m.step()
+            out = m.allreduce({"g": np.array([6.0])}).result()
+            np.testing.assert_allclose(out["g"], [3.0])  # 1/2 not 1/3
+            assert m.is_participating()
+        finally:
+            m.shutdown()
+
+
+class TestNumerics:
+    """reference manager_test.py:405-427"""
+
+    @pytest.mark.parametrize("world", [1, 2, 4, 7])
+    def test_one_over_n(self, world):
+        client = MagicMock()
+        client.quorum.return_value = quorum_result(
+            max_rank=0, max_world_size=world, replica_rank=0,
+            replica_world_size=world)
+        client.should_commit.return_value = True
+        m = make_manager(client, min_replica_size=1)
+        try:
+            m.step()
+            out = m.allreduce({"g": np.full(3, float(world))}).result()
+            np.testing.assert_allclose(out["g"], np.ones(3))
+        finally:
+            m.shutdown()
+
+    def test_int_grads_floor_divide(self):
+        client = MagicMock()
+        client.quorum.return_value = quorum_result()
+        client.should_commit.return_value = True
+        m = make_manager(client)
+        try:
+            m.step()
+            out = m.allreduce({"g": np.array([5], dtype=np.int64)}).result()
+            assert out["g"][0] == 2
+        finally:
+            m.shutdown()
+
+    def test_state_dict_roundtrip(self):
+        client = MagicMock()
+        client.quorum.return_value = quorum_result()
+        client.should_commit.return_value = True
+        m = make_manager(client)
+        try:
+            m.step()
+            m.should_commit()
+            sd = m.state_dict()
+            assert sd == {"step": 1, "batches_committed": 0}
+            m.load_state_dict({"step": 42, "batches_committed": 84})
+            assert m.current_step() == 42
+            assert m.batches_committed() == 84
+        finally:
+            m.shutdown()

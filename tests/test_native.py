@@ -144,3 +144,28 @@ def test_lighthouse_status():
         m.shutdown()
     finally:
         lh.shutdown()
+
+
+def test_step_retry_gets_fresh_rounds():
+    """After a failed commit the Manager retries the SAME step; both the
+    quorum and the vote must run fresh rounds, not replay the stale result
+    (regression: step-keyed rounds livelocked retries forever)."""
+    lh = Lighthouse(bind="127.0.0.1:0", min_replicas=1, join_timeout_ms=50,
+                    quorum_tick_ms=10)
+    try:
+        m = ManagerServer("retry", lh.address(), bind="127.0.0.1:0",
+                          world_size=1)
+        c = ManagerClient(m.address())
+
+        q1 = c.quorum(rank=0, step=3, checkpoint_server_addr="a",
+                      timeout_ms=10_000)
+        assert c.should_commit(0, 3, False, timeout_ms=10_000) is False
+
+        # Retry of step 3: a new vote round must be able to flip to True.
+        q2 = c.quorum(rank=0, step=3, checkpoint_server_addr="a",
+                      timeout_ms=10_000)
+        assert q2.quorum_id >= q1.quorum_id
+        assert c.should_commit(0, 3, True, timeout_ms=10_000) is True
+        m.shutdown()
+    finally:
+        lh.shutdown()

@@ -51,6 +51,24 @@ static std::string html_escape(const std::string& s) {
   return out;
 }
 
+// Percent-encode for use inside a URL path segment (the kill-button form
+// action); HTML escaping is only correct for display text.
+static std::string url_encode(const std::string& s) {
+  static const char* hex = "0123456789ABCDEF";
+  std::string out;
+  out.reserve(s.size());
+  for (unsigned char c : s) {
+    if (isalnum(c) || c == '-' || c == '_' || c == '.' || c == '~') {
+      out += (char)c;
+    } else {
+      out += '%';
+      out += hex[c >> 4];
+      out += hex[c & 0xF];
+    }
+  }
+  return out;
+}
+
 bool Lighthouse::quorum_changed(const Quorum& a, const Quorum& b) {
   // Membership (replica_id set) comparison only — step changes alone do not
   // constitute a new quorum (mirrors reference src/lighthouse.rs:81-86).
@@ -188,6 +206,18 @@ std::string Lighthouse::handle_http(const std::string& request) {
     std::string id = id_end == std::string::npos
                          ? ""
                          : request.substr(id_start, id_end - id_start);
+    // Undo the form action's percent-encoding.
+    std::string decoded;
+    decoded.reserve(id.size());
+    for (size_t i = 0; i < id.size(); i++) {
+      if (id[i] == '%' && i + 2 < id.size()) {
+        decoded += (char)strtol(id.substr(i + 1, 2).c_str(), nullptr, 16);
+        i += 2;
+      } else {
+        decoded += id[i];
+      }
+    }
+    id = decoded;
     std::string target;
     {
       std::lock_guard<std::mutex> lk(mu_);
@@ -236,7 +266,8 @@ std::string Lighthouse::handle_http(const std::string& request) {
          << id << "</td><td>" << m.member().step() << "</td><td>"
          << m.member().world_size() << "</td><td>" << m.heartbeat_age_ms()
          << "ms</td>"
-         << "<td><form method=post action='/replica/" << id
+         << "<td><form method=post action='/replica/"
+         << url_encode(m.member().replica_id())
          << "/kill'><button>kill</button></form></td></tr>";
     }
     os << "</table><p>joining: ";

@@ -126,6 +126,15 @@ bool ManagerServer::handle_quorum(const ManagerQuorumRequest& r,
   std::unique_lock<std::mutex> lk(mu_);
   auto& slot = quorum_rounds_[r.step()];
   if (!slot) slot = std::make_shared<QuorumRound>();
+  // A rank that already consumed this round's result and is back at the same
+  // step is *retrying the step* (its commit failed, so Manager.step() did not
+  // bump the step counter). It needs a FRESH lighthouse round — replaying the
+  // stale quorum would keep a dead peer in the membership forever and the
+  // group would never reconfigure. Mirrors the reference's per-round reset
+  // (src/manager.rs:328-355).
+  if (slot->done && slot->served.count(r.rank())) {
+    slot = std::make_shared<QuorumRound>();
+  }
   auto round = slot;
   // Drop stale rounds so retries of long-gone steps can't pile up state.
   quorum_rounds_.erase(quorum_rounds_.begin(),
@@ -215,6 +224,7 @@ bool ManagerServer::handle_quorum(const ManagerQuorumRequest& r,
     }
   }
 
+  round->served.insert(r.rank());
   if (!round->error.empty()) {
     *err = round->error;
     return false;
@@ -276,6 +286,12 @@ bool ManagerServer::handle_should_commit(const ShouldCommitRequest& r,
   std::unique_lock<std::mutex> lk(mu_);
   auto& slot = commit_rounds_[r.step()];
   if (!slot) slot = std::make_shared<CommitRound>();
+  // Same fresh-round rule as handle_quorum: a served rank re-voting at the
+  // same step means the step is being retried after a failed commit; a new
+  // vote round must run (replaying the old "false" would livelock forever).
+  if (slot->done && slot->served.count(r.rank())) {
+    slot = std::make_shared<CommitRound>();
+  }
   auto round = slot;
   commit_rounds_.erase(commit_rounds_.begin(),
                        commit_rounds_.lower_bound(r.step() - 8));
@@ -298,6 +314,7 @@ bool ManagerServer::handle_should_commit(const ShouldCommitRequest& r,
       return false;
     }
   }
+  round->served.insert(r.rank());
   out->set_should_commit(round->decision);
   return true;
 }

@@ -15,6 +15,7 @@
 #include <map>
 #include <memory>
 #include <mutex>
+#include <set>
 #include <string>
 #include <thread>
 
@@ -70,6 +71,7 @@ class ManagerServer {
   // instead of double-joining the next round's barrier.
   struct QuorumRound {
     std::map<int64_t, std::string> joined;  // rank -> checkpoint server addr
+    std::set<int64_t> served;  // ranks that consumed this round's result
     bool in_flight = false;  // lighthouse RPC running
     bool done = false;
     Quorum quorum;
@@ -83,6 +85,7 @@ class ManagerServer {
 
   struct CommitRound {
     std::map<int64_t, bool> votes;  // rank -> local should_commit
+    std::set<int64_t> served;  // ranks that consumed this round's decision
     bool done = false;
     bool decision = false;
   };

@@ -288,13 +288,15 @@ class HostCommunicator(Communicator):
             recv_c = chunk(rank - step - 1)
             data = ring.exchange(np.ascontiguousarray(send_c).data,
                                  recv_c.size * itemsize)
-            recv_c += np.frombuffer(bytes(data), dtype=acc.dtype)
+            # np.frombuffer reads the bytearray zero-copy — no bytes() dup on
+            # the hot gradient path.
+            recv_c += np.frombuffer(data, dtype=acc.dtype)
         for step in range(n - 1):
             send_c = chunk(rank + 1 - step)
             recv_c = chunk(rank - step)
             data = ring.exchange(np.ascontiguousarray(send_c).data,
                                  recv_c.size * itemsize)
-            recv_c[:] = np.frombuffer(bytes(data), dtype=acc.dtype)
+            recv_c[:] = np.frombuffer(data, dtype=acc.dtype)
         return acc
 
     def _do_broadcast(self, ring: Optional[_Ring], tree: Any,
@@ -308,7 +310,7 @@ class HostCommunicator(Communicator):
             _send_all(ring.next_sock, payload)
             return tree
         size = struct.unpack("<q", bytes(_recv_exact(ring.prev_sock, 8)))[0]
-        payload = bytes(_recv_exact(ring.prev_sock, size))
+        payload = _recv_exact(ring.prev_sock, size)  # bytearray, no copy
         if (rank + 1) % n != root:  # forward along the ring
             _send_all(ring.next_sock, struct.pack("<q", len(payload)))
             _send_all(ring.next_sock, payload)
@@ -336,7 +338,7 @@ class HostCommunicator(Communicator):
             t.start()
             src, size = struct.unpack(
                 "<qq", bytes(_recv_exact(ring.prev_sock, 16)))
-            payload = bytes(_recv_exact(ring.prev_sock, size))
+            payload = _recv_exact(ring.prev_sock, size)  # bytearray, no copy
             t.join()
             if err:
                 raise CommunicatorError(f"allgather send failed: {err[0]}")

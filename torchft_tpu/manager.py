@@ -1,0 +1,530 @@
+"""Per-step fault-tolerance state machine — the heart of the framework.
+
+Plays the role of the reference's ``Manager``
+(/root/reference/torchft/manager.py): every training step it (1) joins the
+global quorum (overlapped with the forward pass), (2) reconfigures the
+cross-replica-group communicator when membership changed, (3) heals itself
+from a healthy peer's live weights when lagging, (4) averages gradients
+across participating groups with 1/n normalization that tracks membership,
+and (5) runs a distributed commit vote so the optimizer update is applied
+only if every rank everywhere succeeded.
+
+TPU-native differences from the reference (SURVEY.md §7):
+
+- State is a **JAX pytree** (params / optax state), not a torch state dict;
+  healing restores through ``jax.device_put`` with the healer's shardings.
+- "Don't commit" is trivial because JAX is functional: the caller simply
+  keeps the old param pytree (see :mod:`torchft_tpu.optim`); there is no
+  optimizer-state rollback problem.
+- Gradients cross groups host-side over DCN (:mod:`torchft_tpu.backends`):
+  collectives inside the group are XLA's job on the slice mesh; the
+  resizable collective lives outside the accelerator runtime because XLA
+  cannot resize a compiled collective's world (reference reached the same
+  split for NCCL-abort reasons, ``process_group.py:259-275``).
+
+Step protocol, branch-for-branch with reference ``manager.py:301-458``:
+
+    manager.step()                 # quorum kicked off async, heal window opens
+    grads = ...                    # jitted forward/backward (overlaps quorum)
+    fut = manager.allreduce(grads) # joins quorum, averages across groups
+    grads = fut.result()
+    if manager.should_commit():    # drain work, barrier vote
+        params = apply(params, grads)
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import socket
+import threading
+import uuid
+from concurrent.futures import Future, ThreadPoolExecutor
+from datetime import timedelta  # noqa: F401  (kept for API familiarity)
+from enum import Enum
+from typing import Any, Callable, Dict, Optional, TypeVar, cast
+
+import numpy as np
+import jax
+
+from torchft_tpu._native import ManagerClient, ManagerServer, Store, StoreClient
+from torchft_tpu.checkpointing import CheckpointServer
+from torchft_tpu.communicator import Communicator
+from torchft_tpu.utils import advertise_host
+
+logger: logging.Logger = logging.getLogger(__name__)
+
+MANAGER_ADDR_KEY: str = "manager/addr"
+T = TypeVar("T")
+
+
+class WorldSizeMode(Enum):
+    """How the participating world reacts to membership changes (reference
+    ``manager.py:55-70``).
+
+    DYNAMIC: quorum proceeds with however many healthy groups exist
+        (>= min_replica_size); batch size effectively varies step to step.
+    FIXED_WITH_SPARES: participating world is clamped to exactly
+        ``min_replica_size``; surplus groups run as warm spares that compute
+        but contribute zero gradients, ready to be promoted instantly.
+    """
+
+    DYNAMIC = 0
+    FIXED_WITH_SPARES = 1
+
+
+class Manager:
+    """Fault-tolerance manager for one local rank of one replica group.
+
+    Args:
+        comm: resizable cross-group communicator
+            (:class:`~torchft_tpu.communicator.Communicator`).
+        load_state_dict: callable restoring the *user* state pytree (params,
+            optimizer state, ...) into the live training loop. Called on the
+            main thread at commit time when healing (reference
+            ``manager.py:441-442``).
+        state_dict: zero-arg callable returning the current user state pytree.
+            Called lazily by the checkpoint server while the heal window is
+            open.
+        min_replica_size: minimum number of live replica groups for a quorum
+            to be usable.
+        use_async_quorum: overlap the quorum round-trip with the forward pass
+            (reference ``manager.py:323-332``). Sync mode is only for tests
+            and debugging.
+        timeout_ms: default RPC timeout for quorum/commit barriers.
+        rank / world_size: this process's rank within its replica group and
+            the group's local world size (on TPU: process index / process
+            count of the slice).
+        replica_id: stable name of this replica group; a uuid suffix is added
+            so a restarted group is a fresh quorum member (reference
+            ``manager.py:152-154``).
+        store_addr: ``host:port`` of the group's KV store. Rank 0 starts one
+            when omitted; other ranks then require it (env
+            ``TORCHFT_STORE_ADDR``).
+        lighthouse_addr: global lighthouse address (env ``TORCHFT_LIGHTHOUSE``).
+        world_size_mode: see :class:`WorldSizeMode`.
+        checkpoint_transport: optional override for the healing transport;
+            defaults to a fresh :class:`CheckpointServer`.
+    """
+
+    def __init__(
+        self,
+        comm: Communicator,
+        load_state_dict: Callable[[T], None],
+        state_dict: Callable[[], T],
+        min_replica_size: int,
+        use_async_quorum: bool = True,
+        timeout_ms: int = 60_000,
+        quorum_timeout_ms: int = 60_000,
+        rank: Optional[int] = None,
+        world_size: Optional[int] = None,
+        replica_id: Optional[str] = None,
+        store_addr: Optional[str] = None,
+        lighthouse_addr: Optional[str] = None,
+        world_size_mode: WorldSizeMode = WorldSizeMode.DYNAMIC,
+        heartbeat_ms: int = 100,
+        manager_bind: str = "0.0.0.0:0",
+        checkpoint_transport: Optional[CheckpointServer] = None,
+        _manager_client: Optional[ManagerClient] = None,
+    ) -> None:
+        self._comm = comm
+        self._user_load_state_dict = load_state_dict
+        self._user_state_dict = state_dict
+        self._min_replica_size = min_replica_size
+        self._use_async_quorum = use_async_quorum
+        self._timeout_ms = timeout_ms
+        self._quorum_timeout_ms = quorum_timeout_ms
+        self._world_size_mode = world_size_mode
+
+        self._rank = rank if rank is not None else int(os.environ.get("RANK", 0))
+        self._world_size = (
+            world_size
+            if world_size is not None
+            else int(os.environ.get("WORLD_SIZE", 1))
+        )
+
+        # --- per-step protocol state -------------------------------------
+        self._step = 0
+        self._batches_committed = 0
+        self._should_step = True
+        self._errored: Optional[Exception] = None
+        self._healing = False
+        self._quorum_id = -1
+        self._participating_rank: Optional[int] = 0
+        self._participating_world_size: int = 0
+        self._pending_state_dict: Optional[Dict[str, Any]] = None
+        self._pending_work: list[Future] = []
+        self._quorum_future: Optional[Future] = None
+        # One thread: quorum rounds are strictly ordered per rank (reference
+        # manager.py:134).
+        self._executor = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="async_quorum"
+        )
+
+        # --- checkpoint transport (component 8) --------------------------
+        self._ckpt_server = checkpoint_transport or CheckpointServer(
+            self._manager_state_dict
+        )
+
+        if _manager_client is not None:
+            # Test hook: fully wired externally (mirrors patching
+            # torchft.manager.ManagerClient in reference manager_test.py:28).
+            self._store: Optional[StoreClient] = None
+            self._store_server: Optional[Store] = None
+            self._manager_server: Optional[ManagerServer] = None
+            self._client = _manager_client
+            self._replica_id = replica_id or "test"
+            return
+
+        # --- bootstrap: store rendezvous + manager server ----------------
+        # (reference manager.py:137-167 / SURVEY.md §3.3)
+        store_addr = store_addr or os.environ.get("TORCHFT_STORE_ADDR")
+        self._store_server = None
+        if self._rank == 0 and store_addr is None:
+            self._store_server = Store()
+            store_addr = self._store_server.address()
+        if store_addr is None:
+            raise ValueError(
+                "store_addr (or TORCHFT_STORE_ADDR) required for rank != 0"
+            )
+        self._store_addr = store_addr
+        self._store = StoreClient(store_addr, connect_timeout_ms=timeout_ms)
+
+        self._manager_server = None
+        if self._rank == 0:
+            lighthouse_addr = lighthouse_addr or os.environ.get(
+                "TORCHFT_LIGHTHOUSE", f"{advertise_host()}:29510"
+            )
+            base_id = replica_id if replica_id is not None else socket.gethostname()
+            # uuid suffix: a restarted group must be a *new* quorum member
+            self._replica_id = f"{base_id}:{uuid.uuid4()}"
+            self._manager_server = ManagerServer(
+                replica_id=self._replica_id,
+                lighthouse_addr=lighthouse_addr,
+                store_addr=store_addr,
+                bind=manager_bind,
+                world_size=self._world_size,
+                heartbeat_ms=heartbeat_ms,
+            )
+            self._store.set(MANAGER_ADDR_KEY, self._manager_server.address())
+        else:
+            self._replica_id = replica_id or ""
+
+        addr = self._store.get(MANAGER_ADDR_KEY, timeout_ms=timeout_ms).decode()
+        self._client = ManagerClient(addr, connect_timeout_ms=timeout_ms)
+
+    # ------------------------------------------------------------------ step
+
+    def step(self) -> None:
+        """Begin a new training step (reference ``manager.py:301-332``).
+
+        Bumps the step counter when the previous step committed, re-opens the
+        heal window, and kicks the quorum round off the critical path so it
+        overlaps the forward pass.
+        """
+        if self._should_step:
+            self._step += 1
+            # Committed batches advance by how many groups contributed last
+            # step (reference manager.py:312-314).
+            self._batches_committed += self.num_participants()
+
+        self._errored = None
+        self._healing = False
+        self._pending_state_dict = None
+        self._ckpt_server.allow_checkpoint(self._step)
+
+        self._quorum_future = self._executor.submit(self._async_quorum)
+        if not self._use_async_quorum:
+            self._quorum_future.result()
+            if self._healing:
+                # Sync mode: state is restored *before* compute, so the
+                # healer participates immediately (reference manager.py:328-332).
+                self._apply_pending_state_dict()
+                self._healing = False
+
+    # start_quorum is the name later torchft revisions settled on; provide it
+    # as an alias so either spelling of the loop works.
+    start_quorum = step
+
+    def _async_quorum(self) -> None:
+        """Quorum round-trip + membership reaction (reference
+        ``manager.py:334-396``). Runs on the single quorum thread."""
+        q = self._client.quorum(
+            rank=self._rank,
+            step=self._step,
+            checkpoint_server_addr=self._ckpt_server.address(),
+            timeout_ms=self._quorum_timeout_ms,
+        )
+
+        if self._use_async_quorum:
+            # Healers are not at max_step, so they sit out this step
+            # (max_rank is None) and contribute zero grads.
+            self._participating_rank = q.max_rank
+            self._participating_world_size = q.max_world_size
+        else:
+            self._participating_rank = q.replica_rank
+            self._participating_world_size = q.replica_world_size
+
+        if self._world_size_mode == WorldSizeMode.FIXED_WITH_SPARES:
+            # Clamp the arithmetic world; surplus groups become warm spares
+            # with zeroed contributions (reference manager.py:362-370).
+            self._participating_world_size = min(
+                self._participating_world_size, self._min_replica_size
+            )
+            if (
+                self._participating_rank is not None
+                and self._participating_rank >= self._min_replica_size
+            ):
+                self._participating_rank = None
+
+        if q.quorum_id != self._quorum_id:
+            # Membership changed: rebuild the cross-group communicator from a
+            # store prefix unique to (quorum, local rank) so stragglers from
+            # an old quorum cannot cross-talk (reference manager.py:372-377).
+            store_prefixed = (
+                f"{q.store_address}/torchft/{q.quorum_id}/{self._rank}"
+            )
+            logger.info(
+                "%s reconfiguring communicator: quorum_id=%d rank=%d world=%d",
+                self._replica_id, q.quorum_id, q.replica_rank,
+                q.replica_world_size,
+            )
+            self._comm.configure(
+                store_prefixed, q.replica_rank, q.replica_world_size
+            )
+            self._quorum_id = q.quorum_id
+
+        if q.heal:
+            # We are lagging (or a fresh step-1 non-primary): fetch the
+            # primary's live weights (reference manager.py:380-396).
+            self._healing = True
+            logger.info(
+                "%s healing from %s at step %d",
+                self._replica_id, q.recover_manager_address, q.max_step,
+            )
+            primary = ManagerClient(
+                q.recover_manager_address, connect_timeout_ms=self._timeout_ms
+            )
+            ckpt_addr = primary.checkpoint_address(
+                self._rank, timeout_ms=self._timeout_ms
+            )
+            target = self._manager_state_dict()
+            state = cast(
+                Dict[str, Any],
+                CheckpointServer.load_from_address(ckpt_addr, target),
+            )
+            # Manager metadata restores immediately on this thread; the user
+            # pytree is staged and applied on the main thread at commit
+            # (reference manager.py:391-396).
+            self.load_state_dict(state["torchft"])
+            self._pending_state_dict = state
+
+    def _apply_pending_state_dict(self) -> None:
+        assert self._pending_state_dict is not None, "no staged state"
+        logger.info("%s applying healed user state", self._replica_id)
+        self._user_load_state_dict(self._pending_state_dict["user"])
+        self._pending_state_dict = None
+
+    # ------------------------------------------------------------- allreduce
+
+    def allreduce(self, tree: Any) -> Future:
+        """Average a gradient pytree across participating replica groups.
+
+        Joins the quorum thread, zeroes the contribution when this group is
+        healing or a spare, issues the cross-group sum, and normalizes by the
+        *current* number of participants — 1/n must track membership, not the
+        static world size (reference ``manager.py:189-248``).
+
+        Returns a Future resolving to the averaged pytree (host numpy
+        leaves). Errors are swallowed into the input tree and latched via
+        :meth:`report_error`, so every rank keeps an identical step structure
+        and the failure surfaces in the commit vote instead of a crash.
+        """
+        if self._errored is not None:
+            return _instant(tree)
+
+        try:
+            assert self._quorum_future is not None, "call step() first"
+            self._quorum_future.result()
+
+            leaves, treedef = jax.tree_util.tree_flatten(tree)
+            host = [np.asarray(x) for x in jax.device_get(leaves)]
+            if not self.is_participating():
+                # Healing/spare: contribute zeros (reference manager.py:215-216).
+                host = [np.zeros_like(a) for a in host]
+            host_tree = jax.tree_util.tree_unflatten(treedef, host)
+
+            fut = self._comm.allreduce(host_tree, op="sum")
+            n = max(self.num_participants(), 1)
+
+            def scale(summed: Any) -> Any:
+                return jax.tree_util.tree_map(
+                    lambda a: (a / n).astype(a.dtype)
+                    if np.issubdtype(np.asarray(a).dtype, np.inexact)
+                    else a // n,
+                    summed,
+                )
+
+            return self.wrap_future(_chain(fut, scale), default=host_tree)
+        except Exception as e:  # noqa: BLE001
+            logger.exception("allreduce failed")
+            self.report_error(e)
+            return _instant(tree)
+
+    # alias matching the reference's gradient-specific spelling
+    allreduce_grad = allreduce
+
+    def wrap_future(self, fut: Future, default: Any) -> Future:
+        """Error-swallow ``fut`` into ``default`` + latch via
+        :meth:`report_error`; track it for the commit drain (reference
+        ``manager.py:271-299``)."""
+        out: Future = Future()
+
+        def relay(f: Future) -> None:
+            e = f.exception()
+            if e is None:
+                out.set_result(f.result())
+            else:
+                self.report_error(e)
+                out.set_result(default)
+
+        fut.add_done_callback(relay)
+        self._pending_work.append(out)
+        return out
+
+    # ---------------------------------------------------------------- commit
+
+    def should_commit(self, timeout_ms: Optional[int] = None) -> bool:
+        """Distributed commit gate (reference ``manager.py:410-458``).
+
+        Drains in-flight collectives, applies staged heal state on the main
+        thread, then votes: the step commits iff *every* rank of *every*
+        participating group succeeded and the quorum was large enough.
+        """
+        # The quorum must have resolved before we can vote (or heal): join it
+        # here even if the caller never issued a collective this step.
+        if self._quorum_future is not None:
+            try:
+                self._quorum_future.result()
+            except Exception as e:  # noqa: BLE001
+                self.report_error(e)
+
+        for work in self._pending_work:
+            work.result()  # errors already swallowed into defaults
+        self._pending_work = []
+
+        if self._healing and self._pending_state_dict is not None:
+            self._apply_pending_state_dict()
+
+        enough = self._participating_world_size >= self._min_replica_size
+        local_ok = self._errored is None and enough
+
+        decision = self._client.should_commit(
+            rank=self._rank,
+            step=self._step,
+            should_commit=local_ok,
+            timeout_ms=timeout_ms or self._timeout_ms,
+        )
+        logger.info(
+            "%s step=%d should_commit=%s (local=%s enough=%s errored=%s)",
+            self._replica_id, self._step, decision, local_ok, enough,
+            self._errored,
+        )
+
+        # Shut the heal window before the caller mutates state (reference
+        # manager.py:453, checkpointing.py:123-144).
+        self._ckpt_server.disallow_checkpoint()
+        self._should_step = decision
+        return decision
+
+    # ---------------------------------------------------------------- errors
+
+    def report_error(self, e: Exception) -> None:
+        """Latch a step-local error; the step will abstain from committing
+        (reference ``manager.py:250-269``)."""
+        if self._errored is None:
+            self._errored = e
+
+    def errored(self) -> Optional[Exception]:
+        return self._errored
+
+    # ----------------------------------------------------------- state dicts
+
+    def _manager_state_dict(self) -> Dict[str, Any]:
+        return {"user": self._user_state_dict(), "torchft": self.state_dict()}
+
+    def state_dict(self) -> Dict[str, int]:
+        """Manager metadata that must ride along with user checkpoints to
+        keep step counters in sync (reference ``manager.py:460-482``)."""
+        return {
+            "step": self._step,
+            "batches_committed": self._batches_committed,
+        }
+
+    def load_state_dict(self, state_dict: Dict[str, int]) -> None:
+        self._step = int(state_dict["step"])
+        self._batches_committed = int(state_dict["batches_committed"])
+
+    # ------------------------------------------------------------- accessors
+
+    def num_participants(self) -> int:
+        """Groups contributing real gradients this step (reference
+        ``manager.py:508-518``)."""
+        return self._participating_world_size
+
+    def is_participating(self) -> bool:
+        """False while healing (async) or benched as a spare (reference
+        ``manager.py:520-532``)."""
+        if self._participating_rank is None:
+            return False
+        if self._healing:
+            assert self._use_async_quorum
+            return False
+        return True
+
+    def is_healing(self) -> bool:
+        return self._healing
+
+    def current_step(self) -> int:
+        return self._step
+
+    def batches_committed(self) -> int:
+        return self._batches_committed
+
+    def replica_id(self) -> str:
+        return self._replica_id
+
+    def store_address(self) -> str:
+        return getattr(self, "_store_addr", "")
+
+    def shutdown(self) -> None:
+        self._ckpt_server.shutdown()
+        self._executor.shutdown(wait=False, cancel_futures=True)
+        self._comm.shutdown()
+        if self._manager_server is not None:
+            self._manager_server.shutdown()
+        if self._store_server is not None:
+            self._store_server.shutdown()
+
+
+def _instant(value: Any) -> Future:
+    f: Future = Future()
+    f.set_result(value)
+    return f
+
+
+def _chain(fut: Future, fn: Callable[[Any], Any]) -> Future:
+    out: Future = Future()
+
+    def relay(f: Future) -> None:
+        e = f.exception()
+        if e is not None:
+            out.set_exception(e)
+        else:
+            try:
+                out.set_result(fn(f.result()))
+            except Exception as e2:  # noqa: BLE001
+                out.set_exception(e2)
+
+    fut.add_done_callback(relay)
+    return out
