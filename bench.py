@@ -1,0 +1,123 @@
+"""Benchmark: fault-tolerant training throughput vs raw (no-FT) throughput.
+
+The reference publishes no numbers (BASELINE.md), so the headline metric is
+the one its design claims and the north star targets: FT efficiency —
+steps/sec with the full per-step fault-tolerance protocol (lighthouse
+quorum, commit vote, checkpoint window, cross-group communicator) as a
+fraction of raw jitted steps/sec on the same chip. North star: >= 0.90.
+
+Prints ONE JSON line:
+    {"metric": "ft_efficiency", "value": <ft steps/s>, "unit": "steps/s",
+     "vs_baseline": <ft/raw ratio vs the 0.90 target>}
+
+Runs on whatever jax.devices()[0] is (real TPU under the driver; CPU works
+too, smaller shapes).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+
+def main() -> None:
+    on_tpu = jax.devices()[0].platform == "tpu"
+    # ResNet-18/CIFAR-10 — BASELINE.md config 1.
+    from torchft_tpu import HostCommunicator, Lighthouse, Manager
+    from torchft_tpu.models import ResNet18
+    from torchft_tpu.parallel import FTTrainer
+
+    batch = 256 if on_tpu else 32
+    steps = 30 if on_tpu else 8
+    model = ResNet18(num_classes=10)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(batch, 32, 32, 3)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 10, size=(batch,)), jnp.int32)
+
+    def loss_fn(variables, batch_):
+        logits, _ = model.apply(
+            variables, batch_["x"], train=True,
+            mutable=["batch_stats"])
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, batch_["y"]).mean()
+
+    params = model.init(jax.random.key(0), x, train=True)
+    tx = optax.sgd(0.1, momentum=0.9)
+
+    # ---- raw: plain jitted train step, no FT protocol ----
+    def raw_step(p, o, b):
+        loss, grads = jax.value_and_grad(loss_fn)(p, b)
+        updates, o = tx.update(grads, o, p)
+        return optax.apply_updates(p, updates), o, loss
+
+    raw = jax.jit(raw_step, donate_argnums=(0, 1))
+    # private copy: the raw loop donates its buffers
+    p = jax.tree_util.tree_map(jnp.copy, params)
+    o = tx.init(p)
+    b = {"x": x, "y": y}
+    def materialize(tree) -> float:
+        """Force execution: fetch one scalar derived from the tree (a bare
+        block_until_ready can return early through device tunnels)."""
+        leaf = jax.tree_util.tree_leaves(tree)[0]
+        return float(jnp.sum(leaf))
+
+    p, o, l0 = raw(p, o, b)  # compile
+    materialize(p)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        p, o, l0 = raw(p, o, b)
+    materialize(p)
+    raw_sps = steps / (time.perf_counter() - t0)
+
+    # ---- ft: full per-step protocol (single replica group) ----
+    lh = Lighthouse(bind="127.0.0.1:0", min_replicas=1,
+                    join_timeout_ms=100, quorum_tick_ms=10)
+    trainer = FTTrainer(
+        loss_fn=loss_fn,
+        tx=tx,
+        params=params,
+        manager_factory=lambda load, save: Manager(
+            comm=HostCommunicator(timeout_sec=30),
+            load_state_dict=load,
+            state_dict=save,
+            min_replica_size=1,
+            replica_id="bench",
+            lighthouse_addr=lh.address(),
+            rank=0,
+            world_size=1,
+        ),
+    )
+    trainer.train_step(b)  # compile + first quorum
+    materialize(trainer.params)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        _, committed = trainer.train_step(b)
+        assert committed
+    materialize(trainer.params)
+    ft_sps = steps / (time.perf_counter() - t0)
+    trainer.shutdown()
+    lh.shutdown()
+
+    efficiency = ft_sps / raw_sps
+    # Baseline = the north-star bar: >=90% of healthy throughput with FT on
+    # (BASELINE.json north_star; reference publishes no numbers).
+    print(json.dumps({
+        "metric": "ft_efficiency",
+        "value": round(ft_sps, 3),
+        "unit": "steps/s",
+        "vs_baseline": round(efficiency / 0.90, 4),
+    }))
+    print(f"# raw={raw_sps:.3f} steps/s ft={ft_sps:.3f} steps/s "
+          f"efficiency={efficiency:.3f} platform="
+          f"{jax.devices()[0].platform} batch={batch}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

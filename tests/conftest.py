@@ -9,9 +9,18 @@ trick, mirroring the reference's thread-based integration tests,
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU even when the environment pre-sets a TPU platform (e.g. a
+# tunneled chip): unit tests need the 8-device virtual host platform. The
+# env var alone is not enough — a sitecustomize may import jax at
+# interpreter start, freezing jax.config from the original environment, so
+# override via jax.config after import.
+os.environ["JAX_PLATFORMS"] = "cpu"
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
         xla_flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,160 @@
+"""Multi-replica-group integration tests on one host.
+
+The reference's key trick (/root/reference/torchft/manager_integ_test.py):
+each replica group is a *thread* in one process, the lighthouse is embedded,
+groups talk over localhost TCP, failures are injected deterministically, and
+the oracle is bitwise equality of final parameter pytrees across groups.
+"""
+
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from torchft_tpu import HostCommunicator, Lighthouse, Manager
+from torchft_tpu.data import DistributedSampler
+from torchft_tpu.models import MLP
+from torchft_tpu.parallel import FTTrainer
+
+
+class InjectedFailure(Exception):
+    pass
+
+
+class FailureInjector:
+    """Deterministic failure injection (reference manager_integ_test.py:33-47)."""
+
+    def __init__(self) -> None:
+        self._failures = set()
+        self.count = 0
+        self._lock = threading.Lock()
+
+    def fail_at(self, step: int) -> "FailureInjector":
+        with self._lock:
+            self._failures.add(step)
+        return self
+
+    def check(self, step: int) -> None:
+        with self._lock:
+            if step in self._failures:
+                self._failures.remove(step)
+                self.count += 1
+                raise InjectedFailure(f"injected failure at step {step}")
+
+
+def make_data(seed: int = 0, n: int = 64):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, 8)).astype(np.float32)
+    y = (x.sum(axis=1) > 0).astype(np.int32)
+    return x, y
+
+
+def run_group(
+    group: int,
+    num_groups: int,
+    lighthouse_addr: str,
+    total_steps: int,
+    injector: FailureInjector,
+    min_replica_size: int = 1,
+    attempts: int = 3,
+):
+    """One replica group's training job, restarted on injected crashes
+    (reference worker_manager retry, manager_integ_test.py:50-68)."""
+    x, y = make_data()
+    model = MLP(features=(16,), num_classes=2)
+
+    def loss_fn(params, batch):
+        logits = model.apply(params, batch["x"])
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, batch["y"]).mean()
+
+    last_exc = None
+    for attempt in range(attempts):
+        params = model.init(jax.random.key(42), jnp.zeros((1, 8)))
+        trainer = FTTrainer(
+            loss_fn=loss_fn,
+            tx=optax.sgd(0.05),
+            params=params,
+            manager_factory=lambda load, save: Manager(
+                comm=HostCommunicator(timeout_sec=15),
+                load_state_dict=load,
+                state_dict=save,
+                min_replica_size=min_replica_size,
+                replica_id=f"group{group}",
+                lighthouse_addr=lighthouse_addr,
+                rank=0,
+                world_size=1,
+                timeout_ms=15_000,
+                quorum_timeout_ms=15_000,
+            ),
+            jit_fwd=True,
+        )
+        try:
+            sampler = DistributedSampler(
+                len(x), group, num_groups, batch_size=8, seed=1)
+            batches = iter([])
+            while trainer.manager.current_step() < total_steps:
+                try:
+                    idx = next(batches)
+                except StopIteration:
+                    sampler.set_epoch(sampler.epoch + 1)
+                    batches = iter(sampler)
+                    idx = next(batches)
+                injector.check(trainer.manager.current_step() + 1)
+                trainer.train_step({"x": x[idx], "y": y[idx]})
+            return {
+                "params": jax.device_get(trainer.params),
+                "step": trainer.manager.current_step(),
+                "batches_committed": trainer.manager.batches_committed(),
+            }
+        except InjectedFailure as e:
+            last_exc = e
+        finally:
+            trainer.shutdown()
+    raise RuntimeError(f"group {group} exhausted retries: {last_exc}")
+
+
+@pytest.mark.integration
+class TestIntegration:
+    def test_two_groups_converge(self):
+        lh = Lighthouse(bind="127.0.0.1:0", min_replicas=2,
+                        join_timeout_ms=1000, quorum_tick_ms=50)
+        try:
+            with ThreadPoolExecutor(max_workers=2) as pool:
+                futs = [
+                    pool.submit(run_group, g, 2, lh.address(), 4,
+                                FailureInjector(), 2)
+                    for g in range(2)
+                ]
+                results = [f.result(timeout=120) for f in futs]
+        finally:
+            lh.shutdown()
+        assert results[0]["step"] == results[1]["step"] == 4
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_array_equal(a, b),
+            results[0]["params"], results[1]["params"])
+
+    def test_replica_death_and_recovery(self):
+        lh = Lighthouse(bind="127.0.0.1:0", min_replicas=1,
+                        join_timeout_ms=1000, quorum_tick_ms=50)
+        injector = FailureInjector().fail_at(3)
+        try:
+            with ThreadPoolExecutor(max_workers=2) as pool:
+                futs = [
+                    pool.submit(run_group, 0, 2, lh.address(), 6,
+                                FailureInjector(), 1),
+                    pool.submit(run_group, 1, 2, lh.address(), 6,
+                                injector, 1),
+                ]
+                results = [f.result(timeout=180) for f in futs]
+        finally:
+            lh.shutdown()
+        assert injector.count == 1, "failure was not injected"
+        assert results[0]["step"] == results[1]["step"] == 6
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_array_equal(a, b),
+            results[0]["params"], results[1]["params"])

@@ -1,0 +1,134 @@
+"""Optimizer/data wrapper tests (reference optim_test.py / ddp_test.py
+shape: real wrapper, mocked manager)."""
+
+from unittest.mock import MagicMock
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from torchft_tpu.data import BatchIterator, DistributedSampler
+from torchft_tpu.optim import FTOptimizer, OptimizerWrapper
+
+
+class _Holder:
+    def __init__(self, params, opt_state):
+        self.params = params
+        self.opt_state = opt_state
+
+
+class TestFTOptimizer:
+    def test_commit_applies_update(self):
+        manager = MagicMock()
+        manager.should_commit.return_value = True
+        opt = FTOptimizer(manager, optax.sgd(0.5), jit=False)
+        h = _Holder({"w": jnp.ones(3)}, None)
+        h.opt_state = opt.init(h.params)
+        assert opt.apply(h, {"w": jnp.full(3, 2.0)})
+        np.testing.assert_allclose(h.params["w"], np.zeros(3))
+
+    def test_abort_leaves_holder_unchanged(self):
+        manager = MagicMock()
+        manager.should_commit.return_value = False
+        opt = FTOptimizer(manager, optax.sgd(0.5), jit=False)
+        params = {"w": jnp.ones(3)}
+        h = _Holder(params, opt.init(params))
+        state = h.opt_state
+        assert not opt.apply(h, {"w": jnp.full(3, 2.0)})
+        assert h.params is params
+        assert h.opt_state is state
+
+    def test_heal_during_vote_uses_restored_params(self):
+        """The vote may restore healed state into the holder; the update
+        must read the holder AFTER the vote (regression: stale-snapshot
+        update diverged healed replicas)."""
+        manager = MagicMock()
+        opt = FTOptimizer(manager, optax.sgd(1.0), jit=False)
+        h = _Holder({"w": jnp.zeros(2)}, None)
+        h.opt_state = opt.init(h.params)
+
+        def vote_and_heal():
+            h.params = {"w": jnp.full(2, 10.0)}  # healed state arrives
+            return True
+
+        manager.should_commit.side_effect = vote_and_heal
+        opt.apply(h, {"w": jnp.ones(2)})
+        np.testing.assert_allclose(h.params["w"], np.full(2, 9.0))
+
+    def test_begin_step_calls_manager(self):
+        manager = MagicMock()
+        opt = FTOptimizer(manager, optax.sgd(0.1), jit=False)
+        opt.begin_step()
+        manager.step.assert_called_once()
+
+
+class TestOptimizerWrapper:
+    def test_reference_loop_shape(self):
+        manager = MagicMock()
+        manager.should_commit.return_value = True
+        w = OptimizerWrapper(manager, optax.sgd(1.0), {"w": jnp.ones(2)})
+        w.zero_grad()
+        manager.step.assert_called_once()
+        w.grads = {"w": jnp.ones(2)}
+        assert w.step()
+        np.testing.assert_allclose(w.params["w"], np.zeros(2))
+        sd = w.state_dict()
+        w.load_state_dict(sd)
+        np.testing.assert_allclose(w.params["w"], np.zeros(2))
+
+
+class TestDistributedSampler:
+    def test_2d_grid_flattening(self):
+        # reference data.py:68-77: global_rank = rank + num_replicas * group
+        s = DistributedSampler(dataset_size=100, replica_group=1,
+                               num_replica_groups=3, rank=1, num_replicas=2,
+                               batch_size=4, shuffle=False)
+        assert s.global_rank == 1 + 2 * 1
+        assert s.global_world_size == 6
+        first = next(iter(s))
+        np.testing.assert_array_equal(first, [3, 9, 15, 21])
+
+    def test_partition_disjoint_and_complete(self):
+        n, groups, ranks = 64, 2, 2
+        seen = []
+        for g in range(groups):
+            for r in range(ranks):
+                s = DistributedSampler(n, g, groups, r, ranks, batch_size=4,
+                                       shuffle=True, seed=7)
+                for b in s:
+                    seen.extend(b.tolist())
+        assert sorted(seen) == list(range(n))
+
+    def test_shuffle_deterministic_per_epoch(self):
+        a = DistributedSampler(50, 0, 1, batch_size=5, seed=3)
+        b = DistributedSampler(50, 0, 1, batch_size=5, seed=3)
+        assert [x.tolist() for x in a] == [x.tolist() for x in b]
+        a.set_epoch(1)
+        b.set_epoch(0)
+        assert [x.tolist() for x in a] != [x.tolist() for x in b]
+
+    def test_resume_state(self):
+        s = DistributedSampler(40, 0, 1, batch_size=4, seed=1)
+        it = iter(s)
+        first_two = [next(it).tolist(), next(it).tolist()]
+        state = s.state_dict()
+
+        s2 = DistributedSampler(40, 0, 1, batch_size=4, seed=999)
+        s2.load_state_dict(state)
+        rest = [b.tolist() for b in s2]
+        full = DistributedSampler(40, 0, 1, batch_size=4, seed=1)
+        assert first_two + rest == [b.tolist() for b in full]
+
+    def test_batch_iterator_epochs(self):
+        data = {"x": np.arange(8, dtype=np.float32)}
+        s = DistributedSampler(8, 0, 1, batch_size=4, shuffle=False)
+        it = BatchIterator(data, s)
+        batches = [next(it)["x"].tolist() for _ in range(4)]
+        assert batches[0] == batches[2]  # epoch wrapped
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            DistributedSampler(10, replica_group=3, num_replica_groups=2)
+        with pytest.raises(ValueError):
+            DistributedSampler(10, 0, 1, rank=5, num_replicas=2)

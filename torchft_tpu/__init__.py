@@ -1,0 +1,55 @@
+"""torchft_tpu: TPU-native per-step fault tolerance for JAX training.
+
+A brand-new framework with the capabilities of torchft (reference
+/root/reference, PyTorch's "Easy Per Step Fault Tolerance"): replica groups
+that survive whole-group failures with at most one lost step, via a global
+lighthouse quorum, per-group C++ manager servers, resizable host-side
+cross-group collectives, and live-weight healing — re-designed TPU-first
+(package layout mirrors SURVEY.md §7; exports mirror the reference's
+``torchft/__init__.py:7-20``).
+"""
+
+from torchft_tpu._native import (
+    Lighthouse,
+    ManagerClient,
+    ManagerServer,
+    QuorumResult,
+    Store,
+    StoreClient,
+)
+from torchft_tpu.checkpointing import CheckpointServer
+from torchft_tpu.communicator import (
+    Communicator,
+    CommunicatorError,
+    DummyCommunicator,
+    ErrorSwallowingCommunicator,
+    ManagedCommunicator,
+)
+from torchft_tpu.backends.host import HostCommunicator
+from torchft_tpu.data import BatchIterator, DistributedSampler
+from torchft_tpu.manager import Manager, WorldSizeMode
+from torchft_tpu.optim import FTOptimizer, OptimizerWrapper
+
+__all__ = [
+    "BatchIterator",
+    "CheckpointServer",
+    "Communicator",
+    "CommunicatorError",
+    "DistributedSampler",
+    "DummyCommunicator",
+    "ErrorSwallowingCommunicator",
+    "FTOptimizer",
+    "HostCommunicator",
+    "Lighthouse",
+    "ManagedCommunicator",
+    "Manager",
+    "ManagerClient",
+    "ManagerServer",
+    "OptimizerWrapper",
+    "QuorumResult",
+    "Store",
+    "StoreClient",
+    "WorldSizeMode",
+]
+
+__version__ = "0.1.0"

@@ -204,6 +204,7 @@ int tft_manager_client_quorum(void* h, int64_t rank, int64_t step,
   req.set_rank(rank);
   req.set_step(step);
   req.set_checkpoint_server_addr(checkpoint_server_addr);
+  req.set_call_seq(((RpcClient*)h)->next_seq());
   std::string resp, e;
   if (!((RpcClient*)h)
            ->call(kManagerQuorum, req.SerializeAsString(), &resp, &e,
@@ -248,6 +249,7 @@ int tft_manager_client_should_commit(void* h, int64_t rank, int64_t step,
   req.set_rank(rank);
   req.set_step(step);
   req.set_should_commit(should_commit != 0);
+  req.set_call_seq(((RpcClient*)h)->next_seq());
   std::string resp, e;
   if (!((RpcClient*)h)
            ->call(kManagerShouldCommit, req.SerializeAsString(), &resp, &e,

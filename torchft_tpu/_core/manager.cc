@@ -126,14 +126,19 @@ bool ManagerServer::handle_quorum(const ManagerQuorumRequest& r,
   std::unique_lock<std::mutex> lk(mu_);
   auto& slot = quorum_rounds_[r.step()];
   if (!slot) slot = std::make_shared<QuorumRound>();
-  // A rank that already consumed this round's result and is back at the same
-  // step is *retrying the step* (its commit failed, so Manager.step() did not
-  // bump the step counter). It needs a FRESH lighthouse round — replaying the
-  // stale quorum would keep a dead peer in the membership forever and the
-  // group would never reconfigure. Mirrors the reference's per-round reset
+  // A rank re-arriving at a done round with a HIGHER call_seq is *retrying
+  // the step* (its commit failed, so Manager.step() did not bump the step
+  // counter) and needs a FRESH lighthouse round — replaying the stale
+  // quorum would keep a dead peer in the membership forever. Same seq means
+  // the transport re-sent a request whose response was lost: idempotent
+  // replay (rpc.cc relies on this). Mirrors the reference's per-round reset
   // (src/manager.rs:328-355).
-  if (slot->done && slot->served.count(r.rank())) {
-    slot = std::make_shared<QuorumRound>();
+  {
+    auto it = slot->served_seq.find(r.rank());
+    if (slot->done && it != slot->served_seq.end() &&
+        r.call_seq() > it->second) {
+      slot = std::make_shared<QuorumRound>();
+    }
   }
   auto round = slot;
   // Drop stale rounds so retries of long-gone steps can't pile up state.
@@ -224,7 +229,7 @@ bool ManagerServer::handle_quorum(const ManagerQuorumRequest& r,
     }
   }
 
-  round->served.insert(r.rank());
+  round->served_seq[r.rank()] = r.call_seq();
   if (!round->error.empty()) {
     *err = round->error;
     return false;
@@ -286,11 +291,16 @@ bool ManagerServer::handle_should_commit(const ShouldCommitRequest& r,
   std::unique_lock<std::mutex> lk(mu_);
   auto& slot = commit_rounds_[r.step()];
   if (!slot) slot = std::make_shared<CommitRound>();
-  // Same fresh-round rule as handle_quorum: a served rank re-voting at the
-  // same step means the step is being retried after a failed commit; a new
-  // vote round must run (replaying the old "false" would livelock forever).
-  if (slot->done && slot->served.count(r.rank())) {
-    slot = std::make_shared<CommitRound>();
+  // Same seq-gated fresh-round rule as handle_quorum: a higher call_seq
+  // from a served rank means the step is being retried after a failed
+  // commit and a new vote round must run (replaying the old "false" would
+  // livelock); an equal seq is a transport retry and replays the decision.
+  {
+    auto it = slot->served_seq.find(r.rank());
+    if (slot->done && it != slot->served_seq.end() &&
+        r.call_seq() > it->second) {
+      slot = std::make_shared<CommitRound>();
+    }
   }
   auto round = slot;
   commit_rounds_.erase(commit_rounds_.begin(),
@@ -314,7 +324,7 @@ bool ManagerServer::handle_should_commit(const ShouldCommitRequest& r,
       return false;
     }
   }
-  round->served.insert(r.rank());
+  round->served_seq[r.rank()] = r.call_seq();
   out->set_should_commit(round->decision);
   return true;
 }

@@ -71,7 +71,10 @@ class ManagerServer {
   // instead of double-joining the next round's barrier.
   struct QuorumRound {
     std::map<int64_t, std::string> joined;  // rank -> checkpoint server addr
-    std::set<int64_t> served;  // ranks that consumed this round's result
+    // rank -> call_seq of the invocation this round served. A done round
+    // replays for the same seq (transport retry of a lost response) and
+    // resets for a higher seq (genuine step retry after a failed commit).
+    std::map<int64_t, int64_t> served_seq;
     bool in_flight = false;  // lighthouse RPC running
     bool done = false;
     Quorum quorum;
@@ -85,7 +88,7 @@ class ManagerServer {
 
   struct CommitRound {
     std::map<int64_t, bool> votes;  // rank -> local should_commit
-    std::set<int64_t> served;  // ranks that consumed this round's decision
+    std::map<int64_t, int64_t> served_seq;  // see QuorumRound::served_seq
     bool done = false;
     bool decision = false;
   };

@@ -15,6 +15,7 @@
 // (src/lighthouse.rs:257-263 accept_http1).
 #pragma once
 
+#include <atomic>
 #include <condition_variable>
 #include <cstdint>
 #include <functional>
@@ -95,6 +96,13 @@ class RpcClient {
 
   const std::string& address() const { return address_; }
 
+  // Per-client monotonic call sequence. Stamped into request payloads by
+  // callers that need the server to distinguish a NEW invocation from a
+  // transport-level retry of a lost response: call() re-sends the *same*
+  // serialized payload on retry, so same seq = replay-safe retry, higher
+  // seq = fresh round.
+  int64_t next_seq() { return ++seq_; }
+
  private:
   bool reconnect(std::string* err);
   bool check_cancelled(std::string* err);
@@ -106,6 +114,7 @@ class RpcClient {
   // cancel() cannot take it).
   std::mutex fd_mu_;
   bool cancelled_ = false;
+  std::atomic<int64_t> seq_{0};
 };
 
 // --- small net utils (shared with the checkpoint/http bits) ---

@@ -1,0 +1,137 @@
+"""Data sharding across the (local rank × replica group) grid.
+
+The reference's ``DistributedSampler`` (/root/reference/torchft/data.py:24-77)
+shards a dataset over a 2D grid by flattening it:
+``global_rank = rank + num_replicas * replica_group`` with
+``global_world_size = num_replicas * num_replica_groups``. Sharding is
+*lossy by design* on rejoin or group death — a recovered group resumes from
+its restored step counter, not from an exact sample position
+(``data.py:33-36``); exact resume is delegated to dataloader checkpointing.
+
+This JAX version keeps the same grid but is an index sampler + stateful
+iterator instead of a torch Sampler: it yields index batches suitable for
+array slicing / grain-style loaders, with ``state_dict``/``load_state_dict``
+for the dataloader-checkpoint role torchdata's StatefulDataLoader plays in
+the reference example (``train_ddp.py:53-57``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterator, Optional
+
+import numpy as np
+
+
+class DistributedSampler:
+    """Deterministic, shuffled, 2D-sharded index batches.
+
+    Args:
+        dataset_size: number of examples.
+        replica_group: this replica group's index (0-based).
+        num_replica_groups: total replica groups.
+        rank / num_replicas: local rank / local world size within the group.
+        batch_size: per-rank batch size (the *local* batch; the effective
+            global batch is ``batch_size * num_replicas * num_participants``).
+        shuffle: reshuffle each epoch with a seed derived from (seed, epoch).
+        drop_last: drop the trailing partial batch.
+    """
+
+    def __init__(
+        self,
+        dataset_size: int,
+        replica_group: int,
+        num_replica_groups: int,
+        rank: int = 0,
+        num_replicas: int = 1,
+        batch_size: int = 1,
+        shuffle: bool = True,
+        drop_last: bool = True,
+        seed: int = 0,
+    ) -> None:
+        if not 0 <= replica_group < num_replica_groups:
+            raise ValueError("replica_group out of range")
+        if not 0 <= rank < num_replicas:
+            raise ValueError("rank out of range")
+        self.dataset_size = dataset_size
+        # The flattened grid (reference data.py:68-77).
+        self.global_rank = rank + num_replicas * replica_group
+        self.global_world_size = num_replicas * num_replica_groups
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+        self.seed = seed
+        self.epoch = 0
+        self._batch_idx = 0  # position within the epoch, for resume
+
+    # ------------------------------------------------------------- epoch API
+
+    def set_epoch(self, epoch: int) -> None:
+        self.epoch = epoch
+        self._batch_idx = 0
+
+    def _epoch_indices(self) -> np.ndarray:
+        idx = np.arange(self.dataset_size, dtype=np.int64)
+        if self.shuffle:
+            rng = np.random.default_rng((self.seed, self.epoch))
+            rng.shuffle(idx)
+        # Contiguous stride-sharding over the flattened grid.
+        shard = idx[self.global_rank::self.global_world_size]
+        per_rank = len(shard)
+        n_batches = (per_rank // self.batch_size if self.drop_last
+                     else -(-per_rank // self.batch_size))
+        if self.drop_last:
+            shard = shard[: n_batches * self.batch_size]
+        return shard, n_batches
+
+    def __len__(self) -> int:
+        per_rank = len(
+            range(self.global_rank, self.dataset_size, self.global_world_size)
+        )
+        return (per_rank // self.batch_size if self.drop_last
+                else -(-per_rank // self.batch_size))
+
+    def __iter__(self) -> Iterator[np.ndarray]:
+        shard, n_batches = self._epoch_indices()
+        for b in range(self._batch_idx, n_batches):
+            self._batch_idx = b + 1
+            yield shard[b * self.batch_size:(b + 1) * self.batch_size]
+
+    # --------------------------------------------------- resume (stateful)
+
+    def state_dict(self) -> Dict[str, int]:
+        """Exact-position resume state (the StatefulDataLoader role)."""
+        return {"epoch": self.epoch, "batch_idx": self._batch_idx,
+                "seed": self.seed}
+
+    def load_state_dict(self, state: Dict[str, int]) -> None:
+        self.epoch = int(state["epoch"])
+        self._batch_idx = int(state["batch_idx"])
+        self.seed = int(state["seed"])
+
+
+class BatchIterator:
+    """Infinite batch stream over in-memory arrays using a
+    :class:`DistributedSampler`, auto-advancing epochs — convenience for
+    examples and benchmarks."""
+
+    def __init__(self, arrays: Any, sampler: DistributedSampler) -> None:
+        self.arrays = arrays
+        self.sampler = sampler
+        self._it: Optional[Iterator[np.ndarray]] = None
+
+    def __iter__(self) -> "BatchIterator":
+        return self
+
+    def __next__(self) -> Any:
+        import jax
+
+        while True:
+            if self._it is None:
+                self._it = iter(self.sampler)
+            try:
+                idx = next(self._it)
+                break
+            except StopIteration:
+                self.sampler.set_epoch(self.sampler.epoch + 1)
+                self._it = None
+        return jax.tree_util.tree_map(lambda a: a[idx], self.arrays)

@@ -335,10 +335,12 @@ class Manager:
         *current* number of participants — 1/n must track membership, not the
         static world size (reference ``manager.py:189-248``).
 
-        Returns a Future resolving to the averaged pytree (host numpy
-        leaves). Errors are swallowed into the input tree and latched via
-        :meth:`report_error`, so every rank keeps an identical step structure
-        and the failure surfaces in the commit vote instead of a crash.
+        Returns a Future resolving to the averaged pytree with leaves
+        *placed like the inputs* (device arrays in → device arrays on the
+        same sharding out; host arrays stay host). Errors are swallowed into
+        the input tree and latched via :meth:`report_error`, so every rank
+        keeps an identical step structure and the failure surfaces in the
+        commit vote instead of a crash.
         """
         if self._errored is not None:
             return _instant(tree)
@@ -347,25 +349,51 @@ class Manager:
             assert self._quorum_future is not None, "call step() first"
             self._quorum_future.result()
 
+            # Single-group fast path: sum-over-one is identity; skip the
+            # device->host round trip entirely (grads stay on device — on a
+            # tunneled/remote TPU that transfer costs more than the step).
+            if (
+                self._comm.size() <= 1
+                and self.num_participants() <= 1
+                and self.is_participating()
+            ):
+                return _instant(tree)
+
             leaves, treedef = jax.tree_util.tree_flatten(tree)
-            host = [np.asarray(x) for x in jax.device_get(leaves)]
-            if not self.is_participating():
-                # Healing/spare: contribute zeros (reference manager.py:215-216).
-                host = [np.zeros_like(a) for a in host]
+            if self.is_participating():
+                host = [np.asarray(x) for x in jax.device_get(leaves)]
+            else:
+                # Healing/spare: contribute zeros (reference
+                # manager.py:215-216) — built from metadata, no
+                # device->host transfer for data we would discard.
+                host = [
+                    np.zeros(np.shape(x),
+                             getattr(x, "dtype", None) or np.asarray(x).dtype)
+                    for x in leaves
+                ]
             host_tree = jax.tree_util.tree_unflatten(treedef, host)
 
             fut = self._comm.allreduce(host_tree, op="sum")
             n = max(self.num_participants(), 1)
 
-            def scale(summed: Any) -> Any:
-                return jax.tree_util.tree_map(
-                    lambda a: (a / n).astype(a.dtype)
-                    if np.issubdtype(np.asarray(a).dtype, np.inexact)
-                    else a // n,
-                    summed,
-                )
+            def scale_and_place(summed: Any) -> Any:
+                out_leaves = jax.tree_util.tree_leaves(summed)
+                placed = []
+                for inp, a in zip(leaves, out_leaves):
+                    if np.issubdtype(np.asarray(a).dtype, np.inexact):
+                        a = (a / n).astype(a.dtype)
+                    else:
+                        a = a // n
+                    # Leaves come back placed like the inputs: device arrays
+                    # return to their sharding (the update consumes them
+                    # on-device anyway), host arrays stay host.
+                    if isinstance(inp, jax.Array):
+                        a = jax.device_put(a, inp.sharding)
+                    placed.append(a)
+                return jax.tree_util.tree_unflatten(treedef, placed)
 
-            return self.wrap_future(_chain(fut, scale), default=host_tree)
+            return self.wrap_future(
+                _chain(fut, scale_and_place), default=host_tree)
         except Exception as e:  # noqa: BLE001
             logger.exception("allreduce failed")
             self.report_error(e)

@@ -1,0 +1,20 @@
+from torchft_tpu.models.mlp import MLP
+from torchft_tpu.models.resnet import ResNet, ResNet18, ResNet34, ResNet50
+from torchft_tpu.models.transformer import (
+    Transformer,
+    TransformerConfig,
+    causal_lm_loss,
+    tp_rules,
+)
+
+__all__ = [
+    "MLP",
+    "ResNet",
+    "ResNet18",
+    "ResNet34",
+    "ResNet50",
+    "Transformer",
+    "TransformerConfig",
+    "causal_lm_loss",
+    "tp_rules",
+]

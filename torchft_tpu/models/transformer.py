@@ -1,0 +1,196 @@
+"""Decoder-only transformer (Llama-style) — the flagship distributed model
+(BASELINE.md config 3: shard-within-group + replicate-across-groups).
+
+TPU-first design:
+- bfloat16 activations/matmuls (MXU-native), float32 params and softmax.
+- RMSNorm + rotary positions + SwiGLU (the Llama recipe), head_dim and
+  hidden sizes kept MXU-tile friendly (multiples of 128).
+- No python-level branching on data inside ``__call__`` — trace-once,
+  static shapes, fused by XLA.
+- TP/SP-aware: :func:`tp_rules` gives the tensor-parallel PartitionSpecs
+  (megatron column/row split pairs); attention can route through the ring
+  primitive in :mod:`torchft_tpu.parallel.ring_attention` for sequence
+  parallelism over long contexts.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32_000
+    num_layers: int = 4
+    embed_dim: int = 512
+    num_heads: int = 8
+    num_kv_heads: Optional[int] = None  # GQA; None = MHA
+    hidden_dim: Optional[int] = None    # None → ~8/3 * embed, rounded to 128
+    max_seq_len: int = 2048
+    rope_theta: float = 10_000.0
+    dtype: Any = jnp.bfloat16
+    # attention impl: None → plain softmax attention; otherwise a callable
+    # (q, k, v, causal) -> out, e.g. ring attention under shard_map.
+    attention_fn: Optional[Callable] = None
+
+    @property
+    def kv_heads(self) -> int:
+        return self.num_kv_heads or self.num_heads
+
+    @property
+    def head_dim(self) -> int:
+        return self.embed_dim // self.num_heads
+
+    @property
+    def mlp_dim(self) -> int:
+        if self.hidden_dim is not None:
+            return self.hidden_dim
+        h = int(self.embed_dim * 8 / 3)
+        return (h + 127) // 128 * 128
+
+
+class RMSNorm(nn.Module):
+    eps: float = 1e-5
+
+    @nn.compact
+    def __call__(self, x):
+        scale = self.param("scale", nn.initializers.ones, (x.shape[-1],))
+        x32 = x.astype(jnp.float32)
+        norm = x32 * jax.lax.rsqrt(
+            jnp.mean(x32 * x32, axis=-1, keepdims=True) + self.eps)
+        return (norm * scale).astype(x.dtype)
+
+
+def rotary(x: jnp.ndarray, positions: jnp.ndarray,
+           theta: float) -> jnp.ndarray:
+    """Apply rotary position embedding. x: [B, S, H, D]."""
+    d = x.shape[-1]
+    freqs = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [B,S,D/2]
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin],
+                          axis=-1)
+    return out.astype(x.dtype)
+
+
+def plain_attention(q, k, v, causal: bool = True):
+    """Reference softmax attention; q,k,v: [B, S, H, D] (f32 softmax)."""
+    scale = q.shape[-1] ** -0.5
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        s_q, s_k = q.shape[1], k.shape[1]
+        mask = jnp.tril(jnp.ones((s_q, s_k), dtype=bool), k=s_k - s_q)
+        logits = jnp.where(mask, logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+class Attention(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, positions):
+        cfg = self.cfg
+        B, S, _ = x.shape
+        dense = lambda feats, name: nn.DenseGeneral(  # noqa: E731
+            feats, axis=-1, use_bias=False, dtype=cfg.dtype, name=name)
+        q = dense((cfg.num_heads, cfg.head_dim), "q")(x)
+        k = dense((cfg.kv_heads, cfg.head_dim), "k")(x)
+        v = dense((cfg.kv_heads, cfg.head_dim), "v")(x)
+        q = rotary(q, positions, cfg.rope_theta)
+        k = rotary(k, positions, cfg.rope_theta)
+        if cfg.kv_heads != cfg.num_heads:  # GQA: repeat kv heads
+            rep = cfg.num_heads // cfg.kv_heads
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
+        attn = cfg.attention_fn or plain_attention
+        out = attn(q, k, v, True)
+        out = out.reshape(B, S, cfg.num_heads * cfg.head_dim)
+        return nn.DenseGeneral(cfg.embed_dim, use_bias=False,
+                               dtype=cfg.dtype, name="o")(out)
+
+
+class MLPBlock(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        gate = nn.Dense(cfg.mlp_dim, use_bias=False, dtype=cfg.dtype,
+                        name="gate")(x)
+        up = nn.Dense(cfg.mlp_dim, use_bias=False, dtype=cfg.dtype,
+                      name="up")(x)
+        return nn.Dense(cfg.embed_dim, use_bias=False, dtype=cfg.dtype,
+                        name="down")(nn.silu(gate) * up)
+
+
+class DecoderLayer(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, positions):
+        x = x + Attention(self.cfg, name="attn")(
+            RMSNorm(name="attn_norm")(x), positions)
+        x = x + MLPBlock(self.cfg, name="mlp")(
+            RMSNorm(name="mlp_norm")(x))
+        return x
+
+
+class Transformer(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, tokens):
+        cfg = self.cfg
+        x = nn.Embed(cfg.vocab_size, cfg.embed_dim,
+                     dtype=cfg.dtype, name="embed")(tokens)
+        positions = jnp.broadcast_to(
+            jnp.arange(tokens.shape[1]), tokens.shape)
+        for i in range(cfg.num_layers):
+            x = DecoderLayer(cfg, name=f"layer_{i}")(x, positions)
+        x = RMSNorm(name="final_norm")(x)
+        # tied-untied head in f32 for stable loss
+        return nn.Dense(cfg.vocab_size, use_bias=False, dtype=jnp.float32,
+                        name="lm_head")(x)
+
+
+def tp_rules() -> list:
+    """Megatron-style tensor-parallel PartitionSpecs for
+    :func:`torchft_tpu.parallel.sharding.apply_rules`.
+
+    Column-split the q/k/v/gate/up projections (output dim over ``tp``),
+    row-split o/down (input dim over ``tp``) so each pair needs a single
+    psum, which XLA inserts from the shardings. Embedding and lm_head shard
+    the embed/vocab dim.
+    """
+    return [
+        (r"attn/[qkv]/kernel", P(None, "tp", None)),
+        (r"attn/o/kernel", P("tp", None)),
+        (r"mlp/(gate|up)/kernel", P(None, "tp")),
+        (r"mlp/down/kernel", P("tp", None)),
+        (r"embed/embedding", P(None, "tp")),
+        (r"lm_head/kernel", P(None, "tp")),
+    ]
+
+
+def fsdp_extra_rules() -> list:
+    """Rules for combined fsdp+tp: norm scales replicated explicitly."""
+    return [(r"(norm|scale)", P())]
+
+
+def causal_lm_loss(logits: jnp.ndarray, tokens: jnp.ndarray) -> jnp.ndarray:
+    """Next-token cross-entropy, mean over all positions."""
+    targets = tokens[:, 1:]
+    logits = logits[:, :-1]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
+    return jnp.mean(nll)

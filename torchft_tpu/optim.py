@@ -1,0 +1,142 @@
+"""Commit-gated optimizer wrappers.
+
+The reference hides the whole fault-tolerance protocol inside an unchanged
+4-line torch loop via ``OptimizerWrapper``
+(/root/reference/torchft/optim.py:23-54): ``zero_grad()`` starts the step
+(quorum), ``step()`` applies the update only if the distributed commit vote
+passed.
+
+JAX is functional, which makes the commit gate *structurally* safe: "don't
+commit" simply means the caller keeps the old ``(params, opt_state)`` pytree
+— there is no zero_grad / half-applied-optimizer subtlety to undo. Two
+idioms are offered:
+
+:class:`FTOptimizer`
+    The JAX-native shape. The canonical loop::
+
+        opt = FTOptimizer(manager, optax.adamw(3e-4))
+        opt_state = opt.init(params)
+        for batch in data:
+            opt.begin_step()                       # quorum, async
+            grads = grad_fn(params, batch)         # jitted, overlaps quorum
+            grads = manager.allreduce(grads).result()
+            params, opt_state, ok = opt.apply(params, opt_state, grads)
+
+    ``apply`` runs the commit vote; on False it returns the inputs
+    unchanged (one step of progress lost at most, exactly the reference's
+    guarantee).
+
+:class:`OptimizerWrapper`
+    Imperative adapter with the reference's exact method names
+    (``zero_grad``/``step``/``state_dict``/``load_state_dict``) for porting
+    torch-shaped training loops; holds ``(params, opt_state)`` internally.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import optax
+
+from torchft_tpu.manager import Manager
+
+
+class FTOptimizer:
+    """Fault-tolerant optax wrapper: updates apply only on a committed step.
+
+    Args:
+        manager: the per-step FT manager.
+        tx: any :mod:`optax` gradient transformation.
+        jit: jit-compile the update function (donating the old pytrees so
+            XLA can update buffers in place on TPU).
+    """
+
+    def __init__(self, manager: Manager, tx: optax.GradientTransformation,
+                 jit: bool = True) -> None:
+        self.manager = manager
+        self.tx = tx
+
+        def update(params: Any, opt_state: Any, grads: Any):
+            updates, new_state = tx.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), new_state
+
+        # Donation: on commit the old params/opt_state are dead — letting
+        # XLA alias them halves peak HBM for the update.
+        self._update: Callable = (
+            jax.jit(update, donate_argnums=(0, 1)) if jit else update
+        )
+
+    def init(self, params: Any) -> Any:
+        return self.tx.init(params)
+
+    def begin_step(self) -> None:
+        """Start the FT step (kicks the async quorum). Call before the
+        forward pass — the reference's ``zero_grad`` hook (optim.py:47-49)."""
+        self.manager.step()
+
+    def apply(self, holder: Any, grads: Any) -> bool:
+        """Commit vote + conditional in-place update of ``holder``.
+
+        ``holder`` is any object with ``.params`` / ``.opt_state``
+        attributes (:class:`~torchft_tpu.parallel.step.FTTrainer`,
+        :class:`OptimizerWrapper`, or your own state object). The holder is
+        read *after* the vote — ordering that matters: when this replica is
+        healing, ``should_commit()`` restores the peer's state into the
+        holder on this thread (reference ``manager.py:441-442``), and the
+        update must apply to the *restored* params, not a stale snapshot.
+
+        Healers included: a healing replica's ``grads`` (from
+        ``manager.allreduce``) are the *received* average of the
+        participants' gradients, and its params were just restored to the
+        primary's pre-step state — applying the same update lands it
+        bitwise-identical to the primary's post-step state. That is the heal
+        convergence mechanism; do not gate this on ``is_participating()``.
+
+        Returns ``committed``; on False the holder is left untouched
+        (reference optim.py:51-54).
+        """
+        committed = self.manager.should_commit()
+        if committed:
+            holder.params, holder.opt_state = self._update(
+                holder.params, holder.opt_state, grads)
+        return committed
+
+    def update(self, params: Any, opt_state: Any, grads: Any,
+               ) -> Tuple[Any, Any]:
+        """The bare (jitted) optimizer update, no vote."""
+        return self._update(params, opt_state, grads)
+
+
+class OptimizerWrapper:
+    """Imperative adapter with the reference's method surface
+    (/root/reference/torchft/optim.py:23-54) for torch-shaped loops.
+
+    Owns the ``(params, opt_state)`` pair; ``.grads`` must be set (usually
+    to the result of ``manager.allreduce``) before ``step()``.
+    """
+
+    def __init__(self, manager: Manager, tx: optax.GradientTransformation,
+                 params: Any) -> None:
+        self._ft = FTOptimizer(manager, tx)
+        self.manager = manager
+        self.params = params
+        self.opt_state = self._ft.init(params)
+        self.grads: Optional[Any] = None
+
+    def zero_grad(self) -> None:
+        self.grads = None
+        self._ft.begin_step()
+
+    def step(self) -> bool:
+        assert self.grads is not None, "set .grads before step()"
+        committed = self._ft.apply(self, self.grads)
+        self.grads = None
+        return committed
+
+    def state_dict(self) -> Any:
+        return {"params": self.params, "opt_state": self.opt_state}
+
+    def load_state_dict(self, state: Any) -> None:
+        self.params = state["params"]
+        self.opt_state = state["opt_state"]

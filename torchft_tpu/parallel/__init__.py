@@ -1,0 +1,21 @@
+from torchft_tpu.parallel.mesh import make_mesh
+from torchft_tpu.parallel.sharding import (
+    apply_rules,
+    batch_spec,
+    infer_fsdp_sharding,
+    list_shardings,
+    replicated,
+    shard_tree,
+)
+from torchft_tpu.parallel.step import FTTrainer
+
+__all__ = [
+    "FTTrainer",
+    "apply_rules",
+    "batch_spec",
+    "infer_fsdp_sharding",
+    "list_shardings",
+    "make_mesh",
+    "replicated",
+    "shard_tree",
+]

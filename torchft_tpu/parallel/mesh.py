@@ -1,0 +1,77 @@
+"""Device-mesh construction for the replica-group slice.
+
+In the reference, parallelism *within* a replica group is delegated to
+torch DDP/FSDP over NCCL (/root/reference/torchft/manager.py:23-25,
+``train_ddp.py:49-50``). The TPU-native equivalent is a
+:class:`jax.sharding.Mesh` over the slice's chips: XLA emits the ICI
+collectives for whatever axes the shardings use — there is no wrapper class
+to port (SURVEY.md §7).
+
+Axis vocabulary used across the framework:
+
+- ``dp``   — data parallel (batch-sharded, params replicated)
+- ``fsdp`` — fully-sharded data parallel (batch *and* params sharded)
+- ``tp``   — tensor parallel (activation/weight sharding inside layers)
+- ``sp``   — sequence/context parallel (ring attention,
+  :mod:`torchft_tpu.parallel.ring_attention`)
+
+Cross-replica-group traffic never appears on this mesh — it rides the
+host-side resizable communicator, which is what makes per-step membership
+changes possible.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+
+def make_mesh(
+    shape: Optional[Dict[str, int]] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a named mesh over this replica group's devices.
+
+    Args:
+        shape: ordered ``{axis_name: size}``; sizes must multiply to the
+            device count. A size of ``-1`` (at most one) is inferred.
+            Default: ``{"dp": n_devices}``.
+        devices: defaults to ``jax.devices()`` (the slice's chips).
+
+    The axis order matters for ICI locality: put the most
+    communication-hungry axis last (fastest-varying = nearest neighbors on
+    the torus) — e.g. ``{"fsdp": 2, "tp": 4}`` keeps tensor-parallel
+    collectives on adjacent chips.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if shape is None:
+        shape = {"dp": n}
+    sizes = dict(shape)
+    unknown = [k for k, v in sizes.items() if v == -1]
+    if len(unknown) > 1:
+        raise ValueError("at most one axis may be -1")
+    if unknown:
+        known = int(np.prod([v for v in sizes.values() if v != -1]))
+        if n % known:
+            raise ValueError(f"{n} devices not divisible by {known}")
+        sizes[unknown[0]] = n // known
+    total = int(np.prod(list(sizes.values())))
+    if total != n:
+        raise ValueError(
+            f"mesh shape {sizes} needs {total} devices, have {n}")
+    arr = np.asarray(devices).reshape(*sizes.values())
+    return Mesh(arr, tuple(sizes.keys()))
+
+
+def local_device_count() -> int:
+    return jax.local_device_count()
+
+
+def host_mesh_flags(n: int) -> str:
+    """The XLA flag string that fakes an ``n``-device CPU host platform —
+    test/dry-run topologies (SURVEY.md §4 tier 3)."""
+    return f"--xla_force_host_platform_device_count={n}"

@@ -1,0 +1,181 @@
+"""Sharding rules: how parameter and batch pytrees map onto the mesh.
+
+Two mechanisms, usable together:
+
+- :func:`apply_rules` — explicit per-parameter ``PartitionSpec`` rules keyed
+  by path regex (the t5x/flax-partitioning idiom), for TP/expert layouts
+  where placement is architectural.
+- :func:`infer_fsdp_sharding` — automatic FSDP: shard each parameter's
+  largest divisible axis over the ``fsdp`` mesh axis, replicate the rest.
+  This is the role FSDP plays inside a reference replica group, expressed
+  as shardings instead of a wrapper module.
+
+``device_put``-ing params with these shardings + jitting the step function
+is all that is needed — XLA inserts the all-gathers/reduce-scatters over
+ICI.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+Rules = Sequence[Tuple[str, PartitionSpec]]
+
+
+def path_str(path: Any) -> str:
+    """Flattened key path → "a/b/0/c" string for rule matching."""
+    parts = []
+    for p in path:
+        if hasattr(p, "key"):
+            parts.append(str(p.key))
+        elif hasattr(p, "idx"):
+            parts.append(str(p.idx))
+        elif hasattr(p, "name"):
+            parts.append(str(p.name))
+        else:
+            parts.append(str(p))
+    return "/".join(parts)
+
+
+def apply_rules(
+    tree: Any,
+    mesh: Mesh,
+    rules: Rules,
+    default: Optional[PartitionSpec] = None,
+) -> Any:
+    """Map each leaf to a :class:`NamedSharding` by first-matching rule.
+
+    ``rules`` entries are ``(path_regex, PartitionSpec)``; a spec axis that
+    does not divide the corresponding dim raises (loudly, not silently
+    replicating — a wrong TP rule should fail fast).
+    """
+    default = default if default is not None else PartitionSpec()
+
+    def assign(path, leaf):
+        p = path_str(path)
+        for pat, spec in rules:
+            if re.search(pat, p):
+                _check_divisible(leaf, mesh, spec, p)
+                return NamedSharding(mesh, spec)
+        return NamedSharding(mesh, default)
+
+    return jax.tree_util.tree_map_with_path(assign, tree)
+
+
+def _check_divisible(leaf: Any, mesh: Mesh, spec: PartitionSpec,
+                     path: str) -> None:
+    shape = np.shape(leaf)
+    if len(spec) > len(shape):
+        raise ValueError(
+            f"param '{path}' rank {len(shape)} < spec rank {len(spec)}")
+    for dim, axis in enumerate(spec):
+        if axis is None:
+            continue
+        axes = (axis,) if isinstance(axis, str) else tuple(axis)
+        factor = int(np.prod([mesh.shape[a] for a in axes]))
+        if dim >= len(shape) or shape[dim] % factor:
+            raise ValueError(
+                f"param '{path}' shape {shape} dim {dim} not divisible by "
+                f"mesh axes {axes} (={factor})")
+
+
+def infer_fsdp_sharding(
+    tree: Any,
+    mesh: Mesh,
+    axis: str = "fsdp",
+    min_size: int = 1024,
+) -> Any:
+    """Automatic FSDP layout: shard the largest divisible dim of each big
+    parameter along ``axis``; small params stay replicated.
+
+    ``min_size``: parameters with fewer elements are replicated (sharding
+    tiny biases wastes collective latency for no memory win).
+    """
+    n = mesh.shape[axis]
+
+    def assign(leaf):
+        shape = np.shape(leaf)
+        if int(np.prod(shape or (1,))) < min_size:
+            return NamedSharding(mesh, PartitionSpec())
+        # largest dim divisible by the axis size
+        best = -1
+        for d in np.argsort(shape)[::-1]:
+            if shape[d] % n == 0:
+                best = int(d)
+                break
+        if best < 0:
+            return NamedSharding(mesh, PartitionSpec())
+        spec = [None] * len(shape)
+        spec[best] = axis
+        return NamedSharding(mesh, PartitionSpec(*spec))
+
+    return jax.tree_util.tree_map(assign, tree)
+
+
+def combined_shardings(
+    tree: Any,
+    mesh: Mesh,
+    rules: Rules = (),
+    fsdp_axis: str = "fsdp",
+    min_size: int = 1024,
+) -> Any:
+    """TP rules where they match, automatic FSDP everywhere else — the
+    standard 3D (dp × fsdp × tp) parameter layout. A leaf matched by a rule
+    keeps the rule's spec; unmatched leaves get
+    :func:`infer_fsdp_sharding`'s placement (or replication when the mesh
+    has no ``fsdp`` axis)."""
+    unmatched = object()  # sentinel (None would vanish from the pytree)
+
+    def mark(path, leaf):
+        p = path_str(path)
+        for pat, spec in rules:
+            if re.search(pat, p):
+                _check_divisible(leaf, mesh, spec, p)
+                return NamedSharding(mesh, spec)
+        return unmatched
+
+    ruled = jax.tree_util.tree_map_with_path(mark, tree)
+    if fsdp_axis in mesh.axis_names and mesh.shape[fsdp_axis] > 1:
+        fsdp = infer_fsdp_sharding(tree, mesh, fsdp_axis, min_size)
+    else:
+        fsdp = jax.tree_util.tree_map(
+            lambda _: NamedSharding(mesh, PartitionSpec()), tree)
+    return jax.tree_util.tree_map(
+        lambda r, f: f if r is unmatched else r, ruled, fsdp)
+
+
+def batch_spec(mesh: Mesh, data_axes: Sequence[str] = ("dp", "fsdp"),
+               seq_axis: Optional[str] = None) -> PartitionSpec:
+    """PartitionSpec for a [batch, ...] input: batch dim sharded over every
+    data-ish axis present in the mesh; optional sequence dim over
+    ``seq_axis`` (sequence parallelism)."""
+    present = [a for a in data_axes if a in mesh.axis_names
+               and mesh.shape[a] > 1]
+    batch_axis = tuple(present) if present else None
+    if seq_axis and seq_axis in mesh.axis_names:
+        return PartitionSpec(batch_axis, seq_axis)
+    return PartitionSpec(batch_axis)
+
+
+def shard_tree(tree: Any, shardings: Any) -> Any:
+    """``device_put`` a pytree onto its shardings (initial placement or
+    post-heal restore)."""
+    return jax.device_put(tree, shardings)
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def list_shardings(tree: Any) -> List[str]:
+    """Debug helper: 'path: spec' lines for a sharded pytree."""
+    out = []
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        s = getattr(leaf, "sharding", None)
+        out.append(f"{path_str(path)}: {getattr(s, 'spec', s)}")
+    return out
