@@ -41,38 +41,44 @@ def main() -> None:
     x = jnp.asarray(rng.normal(size=(batch, 32, 32, 3)), jnp.float32)
     y = jnp.asarray(rng.integers(0, 10, size=(batch,)), jnp.int32)
 
-    def loss_fn(variables, batch_):
-        logits, _ = model.apply(
-            variables, batch_["x"], train=True,
+    def loss_fn(params, model_state, batch_):
+        logits, new_state = model.apply(
+            {"params": params, **model_state}, batch_["x"], train=True,
             mutable=["batch_stats"])
-        return optax.softmax_cross_entropy_with_integer_labels(
+        loss = optax.softmax_cross_entropy_with_integer_labels(
             logits, batch_["y"]).mean()
+        return loss, new_state
 
-    params = model.init(jax.random.key(0), x, train=True)
+    variables = model.init(jax.random.key(0), x, train=True)
+    params = variables["params"]
+    bn_state = {"batch_stats": variables["batch_stats"]}
     tx = optax.sgd(0.1, momentum=0.9)
 
     # ---- raw: plain jitted train step, no FT protocol ----
-    def raw_step(p, o, b):
-        loss, grads = jax.value_and_grad(loss_fn)(p, b)
+    def raw_step(p, st, o, b):
+        (loss, st), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            p, st, b)
         updates, o = tx.update(grads, o, p)
-        return optax.apply_updates(p, updates), o, loss
+        return optax.apply_updates(p, updates), st, o, loss
 
-    raw = jax.jit(raw_step, donate_argnums=(0, 1))
-    # private copy: the raw loop donates its buffers
+    raw = jax.jit(raw_step, donate_argnums=(0, 1, 2))
+    # private copies: the raw loop donates its buffers
     p = jax.tree_util.tree_map(jnp.copy, params)
+    st = jax.tree_util.tree_map(jnp.copy, bn_state)
     o = tx.init(p)
     b = {"x": x, "y": y}
+
     def materialize(tree) -> float:
         """Force execution: fetch one scalar derived from the tree (a bare
         block_until_ready can return early through device tunnels)."""
         leaf = jax.tree_util.tree_leaves(tree)[0]
         return float(jnp.sum(leaf))
 
-    p, o, l0 = raw(p, o, b)  # compile
+    p, st, o, l0 = raw(p, st, o, b)  # compile
     materialize(p)
     t0 = time.perf_counter()
     for _ in range(steps):
-        p, o, l0 = raw(p, o, b)
+        p, st, o, l0 = raw(p, st, o, b)
     materialize(p)
     raw_sps = steps / (time.perf_counter() - t0)
 
@@ -83,6 +89,7 @@ def main() -> None:
         loss_fn=loss_fn,
         tx=tx,
         params=params,
+        model_state=bn_state,
         manager_factory=lambda load, save: Manager(
             comm=HostCommunicator(timeout_sec=30),
             load_state_dict=load,

@@ -1,0 +1,12 @@
+#!/usr/bin/env bash
+# Local test runner, mirroring CI (reference scripts/test.sh: cargo test +
+# pytest; here: cmake/ninja C++ tests + pytest).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+cmake -B torchft_tpu/_core/build -S torchft_tpu/_core -G Ninja \
+    -DCMAKE_BUILD_TYPE=Release >/dev/null
+ninja -C torchft_tpu/_core/build
+./torchft_tpu/_core/build/core_test
+
+python -m pytest tests/ -q

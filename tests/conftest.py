@@ -10,10 +10,9 @@ trick, mirroring the reference's thread-based integration tests,
 import os
 
 # Force CPU even when the environment pre-sets a TPU platform (e.g. a
-# tunneled chip): unit tests need the 8-device virtual host platform. The
-# env var alone is not enough — a sitecustomize may import jax at
-# interpreter start, freezing jax.config from the original environment, so
-# override via jax.config after import.
+# tunneled chip pinned by a sitecustomize that imports jax at interpreter
+# start, freezing jax.config): rebuild the backend as an 8-device virtual
+# CPU platform. Env vars are still set for any subprocesses tests spawn.
 os.environ["JAX_PLATFORMS"] = "cpu"
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
@@ -21,6 +20,6 @@ if "xla_force_host_platform_device_count" not in xla_flags:
         xla_flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
-import jax  # noqa: E402
+from torchft_tpu.utils import force_cpu_devices  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+force_cpu_devices(8)

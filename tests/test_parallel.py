@@ -168,3 +168,96 @@ class TestShardedTraining:
         # grads inherit the fsdp layout
         gleaf = grads["params"]["Dense_0"]["kernel"]
         assert "fsdp" in str(gleaf.sharding.spec)
+
+
+class TestFTTrainerModelState:
+    def test_batch_stats_advance_on_commit(self):
+        """Mutable collections (BN stats) must be adopted on committed
+        steps (regression: stats were computed and silently discarded)."""
+        from concurrent.futures import Future
+        from unittest.mock import MagicMock
+
+        import flax.linen as nn
+        import optax
+
+        from torchft_tpu.parallel.step import FTTrainer
+
+        class BNModel(nn.Module):
+            @nn.compact
+            def __call__(self, x, train=True):
+                x = nn.BatchNorm(use_running_average=not train,
+                                 momentum=0.5)(x)
+                return nn.Dense(1)(x)
+
+        model = BNModel()
+        x = jnp.asarray(np.random.default_rng(0).normal(
+            size=(8, 4)) * 5 + 3, jnp.float32)
+        variables = model.init(jax.random.key(0), x)
+
+        def loss_fn(params, model_state, batch):
+            out, new_state = model.apply(
+                {"params": params, **model_state}, batch,
+                mutable=["batch_stats"])
+            return jnp.mean(out ** 2), new_state
+
+        manager = MagicMock()
+        manager.should_commit.return_value = True
+        manager.is_healing.return_value = False
+
+        def fake_allreduce(tree):
+            f = Future()
+            f.set_result(tree)
+            return f
+
+        manager.allreduce.side_effect = fake_allreduce
+
+        trainer = FTTrainer(
+            loss_fn=loss_fn, tx=optax.sgd(0.01),
+            params=variables["params"],
+            model_state={"batch_stats": variables["batch_stats"]},
+            manager_factory=lambda load, save: manager,
+            jit_fwd=False,
+        )
+        before = jax.device_get(
+            trainer.model_state["batch_stats"]["BatchNorm_0"]["mean"])
+        trainer.train_step(x)
+        after = jax.device_get(
+            trainer.model_state["batch_stats"]["BatchNorm_0"]["mean"])
+        assert not np.allclose(before, after), "BN stats did not advance"
+        # state_dict round-trips the mutable collection
+        sd = trainer.state_dict()
+        assert "model_state" in sd
+        trainer.load_state_dict(sd)
+
+    def test_abort_keeps_old_stats(self):
+        from concurrent.futures import Future
+        from unittest.mock import MagicMock
+
+        import optax
+
+        from torchft_tpu.parallel.step import FTTrainer
+
+        def loss_fn(params, model_state, batch):
+            return jnp.sum(params["w"] * batch), {"s": model_state["s"] + 1}
+
+        manager = MagicMock()
+        manager.should_commit.return_value = False
+        manager.is_healing.return_value = False
+        f = Future()
+
+        def fake_allreduce(tree):
+            f2 = Future()
+            f2.set_result(tree)
+            return f2
+
+        manager.allreduce.side_effect = fake_allreduce
+        trainer = FTTrainer(
+            loss_fn=loss_fn, tx=optax.sgd(0.1),
+            params={"w": jnp.ones(2)},
+            model_state={"s": jnp.zeros(())},
+            manager_factory=lambda load, save: manager,
+            jit_fwd=False,
+        )
+        _, committed = trainer.train_step(jnp.ones(2))
+        assert not committed
+        assert float(trainer.model_state["s"]) == 0.0

@@ -1,0 +1,51 @@
+"""Standalone lighthouse server CLI.
+
+The reference ships a ``torchft_lighthouse`` console binary
+(/root/reference/src/bin/lighthouse.rs, wired via pyproject
+``[project.scripts]``). Same surface here:
+
+    python -m torchft_tpu.lighthouse --bind 0.0.0.0:29510 \
+        --min-replicas 2 --join-timeout-ms 60000 --quorum-tick-ms 100
+
+Serves the quorum RPC and the HTML dashboard (quorum age, per-member step
+with recovering highlight, heartbeat staleness, kill buttons) on one port.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import threading
+
+from torchft_tpu._native import Lighthouse
+
+
+def main(argv: list[str] | None = None) -> None:
+    parser = argparse.ArgumentParser(
+        description="torchft_tpu lighthouse: global quorum server")
+    # Defaults mirror the reference binary (src/lighthouse.rs:64-79).
+    parser.add_argument("--bind", default="0.0.0.0:29510")
+    parser.add_argument("--min-replicas", type=int, default=1)
+    parser.add_argument("--join-timeout-ms", type=int, default=60_000)
+    parser.add_argument("--quorum-tick-ms", type=int, default=100)
+    args = parser.parse_args(argv)
+
+    logging.basicConfig(level=logging.INFO)
+    lh = Lighthouse(
+        bind=args.bind,
+        min_replicas=args.min_replicas,
+        join_timeout_ms=args.join_timeout_ms,
+        quorum_tick_ms=args.quorum_tick_ms,
+    )
+    logging.info("lighthouse listening on %s (dashboard: http://%s/)",
+                 lh.address(), lh.address())
+    stop = threading.Event()
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    stop.wait()
+    lh.shutdown()
+
+
+if __name__ == "__main__":
+    main()

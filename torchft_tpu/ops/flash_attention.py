@@ -1,0 +1,158 @@
+"""Pallas flash attention (TPU kernel) — the hot op of the transformer.
+
+Blockwise-online-softmax attention that never materializes the [S, S]
+score matrix: O(block) VMEM instead of O(S^2) HBM, MXU-shaped matmuls, f32
+accumulators with bf16 inputs. This is new scope relative to the reference
+(which has no kernels at all — SURVEY.md §2 "no CUDA kernels"); it exists
+because long-context is first-class in the TPU build and the plain
+attention in :mod:`torchft_tpu.models.transformer` is HBM-bound at long S.
+
+Kernel structure: grid (batch*heads, q_blocks, k_blocks). The innermost
+(k) grid dimension is sequential on a TPU core, so the running
+(max, sum, acc) statistics live in VMEM scratch that persists across k
+steps — each program instance sees one [block_q, d] q tile and one
+[block_k, d] k/v tile, so VMEM usage is O(block) regardless of S and the
+pipeline streams K/V tiles from HBM while the MXU works.
+
+Forward is the Pallas kernel; backward recomputes attention with the
+pure-jnp reference implementation (flash-style recompute trades FLOPs for
+the O(S^2) residuals). Layouts: q/k/v are [B, S, H, D].
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
+                causal: bool, scale: float, nkb: int):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    bq = q_ref.shape[1]
+    bk = k_ref.shape[1]
+
+    @pl.when(ki == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # Causal: blocks strictly above the diagonal contribute nothing.
+    diag_ok = jnp.logical_or(not causal,
+                             qi * bq + bq - 1 >= ki * bk)
+
+    @pl.when(diag_ok)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32) * scale          # [bq, d]
+        k = k_ref[0].astype(jnp.float32)                  # [bk, d]
+        v = v_ref[0].astype(jnp.float32)                  # [bk, d]
+        logits = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+        if causal:
+            q_pos = qi * bq + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, bk), 0)
+            k_pos = ki * bk + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, bk), 1)
+            logits = jnp.where(q_pos >= k_pos, logits, NEG_INF)
+        m_prev = m_ref[:]
+        m_new = jnp.maximum(m_prev,
+                            jnp.max(logits, axis=-1, keepdims=True))
+        p = jnp.exp(logits - m_new)
+        corr = jnp.exp(m_prev - m_new)
+        m_ref[:] = m_new
+        l_ref[:] = l_ref[:] * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * corr + jnp.dot(
+            p, v, preferred_element_type=jnp.float32)
+
+    @pl.when(ki == nkb - 1)
+    def _finalize():
+        o_ref[0] = (acc_ref[:] /
+                    jnp.maximum(l_ref[:], 1e-30)).astype(o_ref.dtype)
+
+
+def _flash_fwd(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+               causal: bool, block_q: int, block_k: int,
+               interpret: bool) -> jnp.ndarray:
+    b, s, h, d = q.shape
+    scale = d ** -0.5
+
+    def to_bh(x):
+        return x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
+
+    qh, kh, vh = to_bh(q), to_bh(k), to_bh(v)
+    sk = kh.shape[1]
+    block_q = min(block_q, s)
+    block_k = min(block_k, sk)
+    assert s % block_q == 0 and sk % block_k == 0, (
+        "flash_attention requires seq divisible by block sizes; "
+        f"got s={s}, sk={sk}, block_q={block_q}, block_k={block_k}")
+    nkb = sk // block_k
+
+    grid = (b * h, s // block_q, nkb)
+    out = pl.pallas_call(
+        functools.partial(_fwd_kernel, causal=causal, scale=scale,
+                          nkb=nkb),
+        out_shape=jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),   # running max
+            pltpu.VMEM((block_q, 1), jnp.float32),   # running sum
+            pltpu.VMEM((block_q, d), jnp.float32),   # output accumulator
+        ],
+        interpret=interpret,
+    )(qh, kh, vh)
+    return out.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+
+
+def _reference(q, k, v, causal):
+    scale = q.shape[-1] ** -0.5
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        s_q, s_k = q.shape[1], k.shape[1]
+        mask = jnp.tril(jnp.ones((s_q, s_k), dtype=bool), k=s_k - s_q)
+        logits = jnp.where(mask, logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                    causal: bool = True, block_q: int = 256,
+                    block_k: int = 256,
+                    interpret: Optional[bool] = None) -> jnp.ndarray:
+    """Flash attention. q/k/v: [B, S, H, D] (same H — repeat GQA kv heads
+    first). ``interpret=None`` auto-selects interpreter mode off-TPU."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return _flash_fwd(q, k, v, causal, block_q, block_k, interpret)
+
+
+def _fwd_rule(q, k, v, causal, block_q, block_k, interpret):
+    out = flash_attention(q, k, v, causal, block_q, block_k, interpret)
+    return out, (q, k, v)
+
+
+def _bwd_rule(causal, block_q, block_k, interpret, res, g):
+    # Flash-style recompute: no O(S^2) residuals; backward re-derives the
+    # attention matrix via the reference formulation under jax.vjp.
+    q, k, v = res
+    _, vjp = jax.vjp(lambda q_, k_, v_: _reference(q_, k_, v_, causal),
+                     q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_fwd_rule, _bwd_rule)

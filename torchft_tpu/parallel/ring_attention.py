@@ -1,0 +1,117 @@
+"""Ring attention: sequence/context parallelism over a mesh axis.
+
+Long-context scaling, first-class in the TPU build (new scope vs the
+reference, which has no sequence parallelism — SURVEY.md §2). The sequence
+dimension is sharded over the ``sp`` mesh axis; each device holds one
+query block permanently and streams the K/V blocks around the ring with
+``lax.ppermute`` (ICI neighbor traffic, bandwidth-optimal), accumulating
+the softmax online — attention over sequence length S costs O(S/n) memory
+per device and never materializes an [S, S] matrix, while the K/V transfer
+overlaps the per-block compute under XLA's scheduler.
+
+Pure lax ops inside ``shard_map`` → differentiable (shard_map transposes
+ppermute), so this drops straight into training.
+
+Use with the transformer::
+
+    ring = make_ring_attention(mesh, axis="sp")
+    cfg = TransformerConfig(..., attention_fn=ring)
+    # shard tokens with batch_spec(mesh, seq_axis="sp"): [B, S] → (dp, sp)
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+NEG_INF = -1e30
+
+
+def _ring_body(q, k, v, axis: str, causal: bool):
+    """Local computation inside shard_map. q/k/v: [B, S_local, H, D]."""
+    n = jax.lax.psum(1, axis)
+    my = jax.lax.axis_index(axis)
+    scale = q.shape[-1] ** -0.5
+    qf = q.astype(jnp.float32) * scale
+
+    b, s_loc, h, d = q.shape
+    m = jnp.full((b, h, s_loc, 1), NEG_INF, jnp.float32)
+    l = jnp.zeros((b, h, s_loc, 1), jnp.float32)
+    acc = jnp.zeros((b, s_loc, h, d), jnp.float32)
+
+    # Block t holds K/V originating from device (my - t) mod n.
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(t, carry):
+        k_t, v_t, m, l, acc = carry
+        src = (my - t) % n
+        logits = jnp.einsum("bqhd,bkhd->bhqk", qf,
+                            k_t.astype(jnp.float32),
+                            preferred_element_type=jnp.float32)
+        if causal:
+            # Global block ordering: src > my → entirely in the future;
+            # src == my → the diagonal block, causal within.
+            q_pos = jax.lax.broadcasted_iota(jnp.int32,
+                                             (1, 1, s_loc, s_loc), 2)
+            k_pos = jax.lax.broadcasted_iota(jnp.int32,
+                                             (1, 1, s_loc, s_loc), 3)
+            diag_mask = q_pos >= k_pos
+            block_mask = jnp.where(
+                src == my, diag_mask,
+                jnp.where(src < my, jnp.ones_like(diag_mask),
+                          jnp.zeros_like(diag_mask)))
+            logits = jnp.where(block_mask, logits, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(logits, axis=-1, keepdims=True))
+        p = jnp.exp(logits - m_new)  # [b,h,q,k]
+        corr = jnp.exp(m - m_new)
+        l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jnp.einsum("bhqk,bkhd->bqhd", p,
+                        v_t.astype(jnp.float32),
+                        preferred_element_type=jnp.float32)
+        acc = acc * corr.transpose(0, 2, 1, 3) + pv
+        # Rotate K/V to the next device. (The final rotation restores the
+        # original placement; keeping it unconditional avoids a collective
+        # inside lax.cond, which XLA cannot partition correctly.)
+        k_t = jax.lax.ppermute(k_t, axis, perm)
+        v_t = jax.lax.ppermute(v_t, axis, perm)
+        return k_t, v_t, m_new, l, acc
+
+    _, _, m, l, acc = jax.lax.fori_loop(
+        0, n, step, (k, v, m, l, acc), unroll=True)
+    out = acc / jnp.maximum(l, 1e-30).transpose(0, 2, 1, 3)
+    return out.astype(q.dtype)
+
+
+def make_ring_attention(
+    mesh: Mesh,
+    axis: str = "sp",
+    batch_axes=("dp", "fsdp"),
+) -> Callable:
+    """Build a ring-attention callable matching the transformer's
+    ``attention_fn`` signature: ``fn(q, k, v, causal) -> out`` with
+    [B, S, H, D] tensors whose S dim is sharded over ``axis``."""
+    present = tuple(a for a in batch_axes
+                    if a in mesh.axis_names and mesh.shape[a] > 1)
+    bspec = present if present else None
+    spec = P(bspec, axis, None, None)
+
+    def attention(q, k, v, causal: bool = True):
+        if mesh.shape[axis] == 1:
+            from torchft_tpu.models.transformer import plain_attention
+
+            return plain_attention(q, k, v, causal)
+        fn = shard_map(
+            functools.partial(_ring_body, axis=axis, causal=causal),
+            mesh=mesh,
+            in_specs=(spec, spec, spec),
+            out_specs=spec,
+            check_vma=False,
+        )
+        return fn(q, k, v)
+
+    return attention

@@ -54,22 +54,37 @@ class FTTrainer:
 
     def __init__(
         self,
-        loss_fn: Callable[[Any, Any], Any],
+        loss_fn: Callable[..., Any],
         tx: optax.GradientTransformation,
         params: Any,
         manager_factory: Callable[..., Manager],
+        model_state: Any = None,
         param_shardings: Any = None,
         batch_sharding: Any = None,
         jit_fwd: bool = True,
     ) -> None:
+        """``model_state`` holds non-trainable, per-step-mutated collections
+        (e.g. flax batch_stats). When given, ``loss_fn`` must have signature
+        ``loss_fn(params, model_state, batch) -> (loss, new_model_state)``;
+        the new state is adopted only on committed, non-healing steps (like
+        params, it is healed from the primary's checkpoint)."""
         if param_shardings is not None:
             params = jax.device_put(params, param_shardings)
         self.params = params
+        self.model_state = model_state
+        self._has_state = model_state is not None
         self.opt_state = tx.init(params)
         self._batch_sharding = batch_sharding
 
-        def fwd_bwd(p: Any, batch: Any) -> Tuple[Any, Any]:
-            return jax.value_and_grad(loss_fn)(p, batch)
+        if self._has_state:
+            def fwd_bwd(p: Any, st: Any, batch: Any):
+                (loss, new_st), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True)(p, st, batch)
+                return loss, new_st, grads
+        else:
+            def fwd_bwd(p: Any, st: Any, batch: Any):
+                loss, grads = jax.value_and_grad(loss_fn)(p, batch)
+                return loss, None, grads
 
         self._fwd_bwd = jax.jit(fwd_bwd) if jit_fwd else fwd_bwd
 
@@ -92,24 +107,36 @@ class FTTrainer:
         self.manager.step()
         if self._batch_sharding is not None:
             batch = jax.device_put(batch, self._batch_sharding)
-        loss, grads = self._fwd_bwd(self.params, batch)
+        loss, new_state, grads = self._fwd_bwd(
+            self.params, self.model_state, batch)
         avg = self.manager.allreduce(grads).result()
         # The vote inside apply() may restore healed state into this trainer
         # before the update reads it — hence the holder indirection.
         committed = self._opt.apply(self, avg)
+        if (committed and self._has_state
+                and not self.manager.is_healing()):
+            # Mutable collections (BN stats) advance only on committed
+            # steps; a healer keeps the restored state, not stats computed
+            # from its stale pre-heal params.
+            self.model_state = new_state
         self.last_loss = loss
         return loss, committed
 
     # ------------------------------------------------- state (for healing)
 
     def state_dict(self) -> Any:
-        return {"params": self.params, "opt_state": self.opt_state}
+        sd = {"params": self.params, "opt_state": self.opt_state}
+        if self._has_state:
+            sd["model_state"] = self.model_state
+        return sd
 
     def load_state_dict(self, state: Any) -> None:
         # Restored leaves were already device_put onto our shardings by the
         # checkpoint loader (serialization.device_put_like).
         self.params = state["params"]
         self.opt_state = state["opt_state"]
+        if self._has_state:
+            self.model_state = state["model_state"]
 
     def shutdown(self) -> None:
         self.manager.shutdown()

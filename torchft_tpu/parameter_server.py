@@ -1,0 +1,177 @@
+"""Fault-tolerant parameter server — the lighthouse-free topology.
+
+The reference's second architecture (/root/reference/torchft/
+parameter_server.py:31-195, README.md:119-120): no global quorum at all;
+fault tolerance comes purely from *reconfigurable communicators* created
+per client session. A server exposes ``GET /new_session``; each session
+spins up a fresh two-member communicator world (server rank 0, client
+rank 1) over a per-session store prefix, so any client (or the link) dying
+affects only that session — the server just drops it and serves the next.
+
+TPU-native differences: sessions exchange JAX pytrees over the host
+communicator (weights down via ``broadcast``, updates back via
+``allreduce``), and the server's pytree lives on its devices; the model of
+use is a DiLoCo-ish outer loop or async SGD where workers fetch params,
+compute locally, and push deltas.
+
+Subclass and implement :meth:`new_communicator` / :meth:`forward`, mirroring
+the reference ABC surface (``new_process_group``/``forward``).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import socket
+import threading
+import uuid
+from abc import ABC, abstractmethod
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any
+from urllib.request import urlopen
+
+from torchft_tpu._native import Store
+from torchft_tpu.communicator import Communicator
+from torchft_tpu.utils import advertise_host
+
+logger: logging.Logger = logging.getLogger(__name__)
+
+
+class _PSServer(ThreadingHTTPServer):
+    daemon_threads = True
+    address_family = socket.AF_INET
+
+
+class ParameterServer(ABC):
+    """Session-based fault-tolerant parameter server.
+
+    Server side: ``ps = MyPS(...); ps.address()`` → hand the address to
+    clients. Each ``GET /new_session`` hijacks its handler thread to run
+    :meth:`forward` against a fresh per-session communicator (reference
+    ``parameter_server.py:54-102``).
+
+    Client side: ``comm = MyPS.new_session(addr)`` → a configured
+    :class:`Communicator` (rank 1 of a 2-member world) ready for
+    broadcast/allreduce against the server.
+    """
+
+    def __init__(self, port: int = 0) -> None:
+        self._store = Store()
+        self._store_addr = self._store.address()
+        ps = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                logger.debug("ps http: " + fmt, *args)
+
+            def do_GET(self) -> None:
+                if self.path != "/new_session":
+                    self.send_error(404)
+                    return
+                session_id = str(uuid.uuid4())
+                body = json.dumps({
+                    "session_id": session_id,
+                    "store_addr": ps._store_addr,
+                }).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                # Hijack this handler thread for the session (reference
+                # parameter_server.py:96-97): the per-session world is
+                # (server=0, client=1).
+                try:
+                    ps._handle_session(session_id)
+                except Exception:  # noqa: BLE001  session dies alone
+                    logger.exception("session %s failed", session_id)
+
+        self._server = _PSServer(("0.0.0.0", port), Handler)
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name="parameter-server")
+        self._thread.start()
+
+    def address(self) -> str:
+        port = self._server.server_address[1]
+        return f"http://{advertise_host()}:{port}/new_session"
+
+    def _handle_session(self, session_id: str) -> None:
+        comm = self.new_communicator()
+        try:
+            comm.configure(f"{self._store_addr}/session/{session_id}",
+                           rank=0, world_size=2)
+            self.forward(session_id, comm)
+        finally:
+            comm.shutdown()
+
+    def shutdown(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        self._store.shutdown()
+
+    # ------------------------------------------------------------ client API
+
+    @classmethod
+    def new_session(cls, address: str, timeout_sec: float = 30.0,
+                    communicator: Communicator | None = None) -> Communicator:
+        """Open a session: returns a communicator configured as rank 1 of
+        the session's 2-member world (reference
+        ``parameter_server.py:149-168``)."""
+        with urlopen(address, timeout=timeout_sec) as resp:
+            meta = json.loads(resp.read())
+        comm = communicator
+        if comm is None:
+            # default transport, imported here to avoid a hard dependency
+            from torchft_tpu.backends.host import HostCommunicator
+
+            comm = HostCommunicator(timeout_sec=timeout_sec)
+        comm.configure(
+            f"{meta['store_addr']}/session/{meta['session_id']}",
+            rank=1, world_size=2)
+        return comm
+
+    # ----------------------------------------------------------- user hooks
+
+    @abstractmethod
+    def new_communicator(self) -> Communicator:
+        """Fresh communicator for one session (reference
+        ``new_process_group``)."""
+
+    @abstractmethod
+    def forward(self, session_id: str, comm: Communicator) -> None:
+        """Session body, server side: run collectives against the client
+        until done (or raise to kill just this session)."""
+
+
+__all__ = ["ParameterServer"]
+
+
+def _self_check() -> None:  # pragma: no cover - manual smoke hook
+    import numpy as np
+
+    from torchft_tpu.backends.host import HostCommunicator
+
+    class EchoPS(ParameterServer):
+        def __init__(self):
+            super().__init__()
+            self.weights = {"w": np.arange(4.0)}
+
+        def new_communicator(self):
+            return HostCommunicator(timeout_sec=10)
+
+        def forward(self, session_id, comm):
+            comm.broadcast(self.weights, root=0).result()
+            self.weights = comm.allreduce(self.weights, op="mean").result()
+
+    ps = EchoPS()
+    comm = EchoPS.new_session(ps.address())
+    got = comm.broadcast({"w": np.zeros(4)}, root=0).result()
+    comm.allreduce({"w": got["w"] + 1}, op="mean").result()
+    print("ps roundtrip ok:", got)
+    comm.shutdown()
+    ps.shutdown()
+
+
+if __name__ == "__main__":  # pragma: no cover
+    _self_check()

@@ -2,7 +2,36 @@
 
 from __future__ import annotations
 
+import os
 import socket
+
+
+def force_cpu_devices(n: int) -> None:
+    """Rebuild JAX on an ``n``-device virtual CPU platform.
+
+    Robust against site plugins that pin ``jax_platforms`` (or initialize
+    backends) at interpreter start, where the ``JAX_PLATFORMS``/``XLA_FLAGS``
+    env vars alone are ineffective: drops any initialized backends and
+    re-creates the CPU client with ``jax_num_cpu_devices=n``. Used by the
+    test suite and the multi-chip dry run."""
+    import jax
+    from jax.extend.backend import clear_backends
+
+    clear_backends()
+    jax.config.update("jax_num_cpu_devices", n)
+    jax.config.update("jax_platforms", "cpu")
+
+
+def apply_platform_env() -> None:
+    """Honor ``TORCHFT_PLATFORM`` (e.g. ``cpu``, ``tpu``) via jax.config.
+
+    Needed because site plugins may pin ``jax_platforms`` at interpreter
+    start, which makes the plain ``JAX_PLATFORMS`` env var ineffective."""
+    platform = os.environ.get("TORCHFT_PLATFORM")
+    if platform:
+        import jax
+
+        jax.config.update("jax_platforms", platform)
 
 
 def advertise_host() -> str:
