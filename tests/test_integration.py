@@ -158,3 +158,85 @@ class TestIntegration:
         jax.tree_util.tree_map(
             lambda a, b: np.testing.assert_array_equal(a, b),
             results[0]["params"], results[1]["params"])
+
+
+@pytest.mark.integration
+class TestHSDPIntegration:
+    """HSDP: FSDP-sharded params inside each replica group + FT replication
+    across groups (BASELINE.md config 3's shape), including healing of
+    *sharded* arrays via device_put with the healer's shardings."""
+
+    def test_sharded_death_and_recovery(self):
+        from torchft_tpu.parallel import (
+            batch_spec, infer_fsdp_sharding, make_mesh)
+        from jax.sharding import NamedSharding
+
+        lh = Lighthouse(bind="127.0.0.1:0", min_replicas=1,
+                        join_timeout_ms=1000, quorum_tick_ms=50)
+        x, y = make_data()
+        model = MLP(features=(64,), num_classes=2)
+        mesh = make_mesh({"fsdp": 8})
+
+        def loss_fn(params, batch):
+            logits = model.apply(params, batch["x"])
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, batch["y"]).mean()
+
+        def run_group(group, injector):
+            last = None
+            for attempt in range(3):
+                params = model.init(jax.random.key(7), jnp.zeros((1, 8)))
+                shardings = infer_fsdp_sharding(params, mesh, min_size=64)
+                trainer = FTTrainer(
+                    loss_fn=loss_fn,
+                    tx=optax.sgd(0.05),
+                    params=params,
+                    param_shardings=shardings,
+                    batch_sharding=NamedSharding(
+                        mesh, batch_spec(mesh, data_axes=("fsdp",))),
+                    manager_factory=lambda load, save: Manager(
+                        comm=HostCommunicator(timeout_sec=15),
+                        load_state_dict=load,
+                        state_dict=save,
+                        min_replica_size=1,
+                        replica_id=f"hsdp{group}",
+                        lighthouse_addr=lh.address(),
+                        rank=0, world_size=1,
+                        timeout_ms=15_000, quorum_timeout_ms=15_000,
+                    ),
+                )
+                try:
+                    sampler = DistributedSampler(len(x), group, 2,
+                                                 batch_size=8, seed=1)
+                    batches = iter([])
+                    while trainer.manager.current_step() < 5:
+                        try:
+                            idx = next(batches)
+                        except StopIteration:
+                            sampler.set_epoch(sampler.epoch + 1)
+                            batches = iter(sampler)
+                            idx = next(batches)
+                        injector.check(trainer.manager.current_step() + 1)
+                        trainer.train_step({"x": x[idx], "y": y[idx]})
+                    # params still sharded after train/heal
+                    leaf = trainer.params["params"]["Dense_0"]["kernel"]
+                    assert "fsdp" in str(leaf.sharding.spec)
+                    return jax.device_get(trainer.params)
+                except InjectedFailure as e:
+                    last = e
+                finally:
+                    trainer.shutdown()
+            raise RuntimeError(f"group {group} exhausted retries: {last}")
+
+        injector = FailureInjector().fail_at(3)
+        try:
+            with ThreadPoolExecutor(max_workers=2) as pool:
+                futs = [pool.submit(run_group, 0, FailureInjector()),
+                        pool.submit(run_group, 1, injector)]
+                results = [f.result(timeout=180) for f in futs]
+        finally:
+            lh.shutdown()
+        assert injector.count == 1
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_array_equal(a, b),
+            results[0], results[1])

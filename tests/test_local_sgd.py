@@ -1,0 +1,137 @@
+"""DiLoCo local-SGD tests: unit (mocked manager) + 2-group integration."""
+
+from concurrent.futures import Future, ThreadPoolExecutor
+from unittest.mock import MagicMock
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from torchft_tpu import HostCommunicator, Lighthouse, Manager
+from torchft_tpu.local_sgd import DiLoCoTrainer
+
+
+def echo_allreduce(tree):
+    f: Future = Future()
+    f.set_result(tree)
+    return f
+
+
+def make_trainer(manager, sync_every=4):
+    def loss_fn(params, batch):
+        return jnp.mean((params["w"] - batch) ** 2)
+
+    return DiLoCoTrainer(
+        loss_fn=loss_fn,
+        inner_tx=optax.sgd(0.1),
+        params={"w": jnp.zeros(4)},
+        manager_factory=lambda load, save: manager,
+        sync_every=sync_every,
+        jit=False,
+    )
+
+
+class TestDiLoCoUnit:
+    def test_outer_round_cadence(self):
+        manager = MagicMock()
+        manager.should_commit.return_value = True
+        manager.allreduce.side_effect = echo_allreduce
+        t = make_trainer(manager, sync_every=4)
+        target = jnp.full(4, 1.0)
+        for i in range(4):
+            _, committed = t.train_step(target)
+            assert committed is (True if (i + 1) % 4 == 0 else None)
+        assert manager.step.call_count == 1
+        # right after the round, local params reset to the new anchor
+        np.testing.assert_allclose(np.asarray(t.params["w"]),
+                                   np.asarray(t.anchor["w"]))
+        for i in range(3):
+            _, committed = t.train_step(target)
+            assert committed is None
+        assert manager.step.call_count == 1  # still one outer round
+        # inner steps moved local params off the anchor
+        assert not np.allclose(np.asarray(t.params["w"]),
+                               np.asarray(t.anchor["w"]))
+
+    def test_inner_steps_do_not_communicate(self):
+        manager = MagicMock()
+        manager.allreduce.side_effect = echo_allreduce
+        manager.should_commit.return_value = True
+        t = make_trainer(manager, sync_every=100)
+        for _ in range(50):
+            t.train_step(jnp.ones(4))
+        manager.step.assert_not_called()
+        manager.allreduce.assert_not_called()
+
+    def test_failed_round_keeps_local_progress(self):
+        manager = MagicMock()
+        manager.allreduce.side_effect = echo_allreduce
+        manager.should_commit.return_value = False
+        t = make_trainer(manager, sync_every=2)
+        t.train_step(jnp.ones(4))
+        params_before = np.asarray(t.params["w"])
+        anchor_before = np.asarray(t.anchor["w"])
+        _, committed = t.train_step(jnp.ones(4))
+        assert committed is False
+        # anchor untouched; local params kept training (≠ reset)
+        np.testing.assert_allclose(np.asarray(t.anchor["w"]), anchor_before)
+        assert not np.allclose(np.asarray(t.params["w"]), anchor_before)
+        assert not np.allclose(np.asarray(t.params["w"]), params_before)
+
+    def test_outer_applies_averaged_delta(self):
+        manager = MagicMock()
+        manager.should_commit.return_value = True
+        # pretend the other group moved twice as far: average given back
+        manager.allreduce.side_effect = echo_allreduce
+        t = make_trainer(manager, sync_every=1)
+        _, committed = t.train_step(jnp.full(4, 10.0))
+        assert committed
+        # outer sgd(0.7, nesterov 0.9): anchor moved toward params
+        assert 0 < float(np.asarray(t.anchor["w"]).mean())
+
+
+@pytest.mark.integration
+class TestDiLoCoIntegration:
+    def test_two_groups_converge_identically(self):
+        lh = Lighthouse(bind="127.0.0.1:0", min_replicas=2,
+                        join_timeout_ms=1000, quorum_tick_ms=50)
+
+        def run_group(group):
+            def loss_fn(params, batch):
+                return jnp.mean((params["w"] - batch) ** 2)
+
+            t = DiLoCoTrainer(
+                loss_fn=loss_fn,
+                inner_tx=optax.sgd(0.05),
+                params={"w": jnp.zeros(4)},
+                manager_factory=lambda load, save: Manager(
+                    comm=HostCommunicator(timeout_sec=15),
+                    load_state_dict=load,
+                    state_dict=save,
+                    min_replica_size=2,
+                    replica_id=f"diloco{group}",
+                    lighthouse_addr=lh.address(),
+                    rank=0, world_size=1,
+                    timeout_ms=15_000, quorum_timeout_ms=15_000,
+                ),
+                sync_every=3,
+            )
+            # groups chase different targets; outer rounds reconcile
+            target = jnp.full(4, float(group + 1))
+            try:
+                while t.manager.current_step() < 3:  # 3 outer rounds
+                    t.train_step(target)
+                return jax.device_get(t.anchor)
+            finally:
+                t.shutdown()
+
+        try:
+            with ThreadPoolExecutor(max_workers=2) as pool:
+                futs = [pool.submit(run_group, g) for g in range(2)]
+                results = [f.result(timeout=120) for f in futs]
+        finally:
+            lh.shutdown()
+        np.testing.assert_array_equal(results[0]["w"], results[1]["w"])
+        assert float(results[0]["w"].mean()) > 0  # moved off init

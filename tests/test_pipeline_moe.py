@@ -1,0 +1,163 @@
+"""Pipeline parallelism + MoE/expert parallelism tests (8-device CPU mesh)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from torchft_tpu.models import Transformer, TransformerConfig, causal_lm_loss
+from torchft_tpu.models.moe import ep_rules
+from torchft_tpu.models.transformer import moe_lm_loss
+from torchft_tpu.parallel import apply_rules, make_mesh, shard_tree
+from torchft_tpu.parallel.pipeline import (
+    pipeline_apply,
+    pipeline_spec,
+    stack_layer_params,
+    transformer_pipeline_forward,
+)
+
+
+def small_cfg(**kw):
+    base = dict(vocab_size=128, num_layers=4, embed_dim=64, num_heads=4,
+                hidden_dim=128, max_seq_len=32, dtype=jnp.float32)
+    base.update(kw)
+    return TransformerConfig(**base)
+
+
+class TestPipeline:
+    def test_matches_sequential(self):
+        """Pipelined forward == plain forward, bitwise-close."""
+        cfg = small_cfg()
+        model = Transformer(cfg)
+        tokens = jax.random.randint(jax.random.key(1), (8, 16), 0, 128)
+        params = model.init(jax.random.key(0), tokens)
+        ref = model.apply(params, tokens)
+
+        mesh = make_mesh({"pp": 4, "dp": 2})
+        with mesh:
+            out = jax.jit(lambda p, t: transformer_pipeline_forward(
+                cfg, p, t, mesh, n_micro=4))(params, tokens)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-4, rtol=2e-3)
+
+    def test_differentiable(self):
+        cfg = small_cfg(num_layers=2)
+        model = Transformer(cfg)
+        # B/n_micro must divide the dp axis (microbatches shard over dp)
+        tokens = jax.random.randint(jax.random.key(1), (16, 8), 0, 128)
+        params = model.init(jax.random.key(0), tokens)
+        mesh = make_mesh({"pp": 2, "dp": 4})
+
+        def loss_pp(p, t):
+            return causal_lm_loss(
+                transformer_pipeline_forward(cfg, p, t, mesh, n_micro=2), t)
+
+        def loss_ref(p, t):
+            return causal_lm_loss(model.apply(p, t), t)
+
+        with mesh:
+            g_pp = jax.jit(jax.grad(loss_pp))(params, tokens)
+        g_ref = jax.grad(loss_ref)(params, tokens)
+        for a, b in zip(jax.tree_util.tree_leaves(g_pp),
+                        jax.tree_util.tree_leaves(g_ref)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=3e-4, rtol=3e-3)
+
+    def test_stage_params_are_sharded(self):
+        """Stacked layer weights actually live distributed over pp."""
+        cfg = small_cfg()
+        model = Transformer(cfg)
+        tokens = jnp.ones((4, 8), jnp.int32)
+        params = model.init(jax.random.key(0), tokens)
+        mesh = make_mesh({"pp": 4, "dp": 2})
+        _, stacked = stack_layer_params(params, cfg.num_layers, 4)
+        placed = jax.device_put(stacked, pipeline_spec(stacked, mesh))
+        leaf = jax.tree_util.tree_leaves(placed)[0]
+        assert leaf.sharding.spec[0] == "pp"
+        # per-device shard holds 1 stage of 1 layer
+        assert leaf.addressable_shards[0].data.shape[0] == 1
+
+    def test_pipeline_apply_identity_stages(self):
+        mesh = make_mesh({"pp": 4, "dp": 2})
+        x = jnp.arange(32.0).reshape(8, 4)
+        stacked = {"b": jnp.zeros((4, 1, 4))}  # 4 stages, zero bias
+
+        def stage_fn(sp, h):
+            return h + sp["b"][0]
+
+        out = pipeline_apply(stage_fn, stacked, x, n_micro=4, mesh=mesh)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(x))
+
+
+class TestMoE:
+    def test_moe_forward_shapes_and_loss(self):
+        cfg = small_cfg(moe_experts=8, moe_top_k=2)
+        model = Transformer(cfg)
+        tokens = jax.random.randint(jax.random.key(1), (2, 16), 0, 128)
+        params = model.init(jax.random.key(0), tokens)
+        logits = model.apply(params, tokens)
+        assert logits.shape == (2, 16, 128)
+        loss = moe_lm_loss(model, params, tokens)
+        plain = causal_lm_loss(logits, tokens)
+        # aux loss strictly adds
+        assert float(loss) > float(plain)
+
+    def test_top1_is_single_expert_mix(self):
+        """top_k=1: output must equal the argmax expert's MLP applied to x
+        (verifies the dense-dispatch combine einsum end to end)."""
+        import flax.linen as nn
+
+        from torchft_tpu.models.moe import MoEMLP
+
+        m = MoEMLP(num_experts=4, mlp_dim=32, top_k=1, dtype=jnp.float32)
+        x = jax.random.normal(jax.random.key(0), (2, 8, 16))
+        variables = m.init(jax.random.key(1), x)
+        out = m.apply(variables, x)
+        assert out.shape == x.shape
+
+        p = variables["params"]
+        logits = x @ p["router"]["kernel"]
+        top = np.asarray(jnp.argmax(logits, axis=-1))  # [2, 8]
+        expected = np.zeros_like(np.asarray(x))
+        for b in range(x.shape[0]):
+            for s in range(x.shape[1]):
+                e = top[b, s]
+                h = np.asarray(
+                    nn.silu(x[b, s] @ p["wi_gate"][e])
+                    * (x[b, s] @ p["wi_up"][e]))
+                expected[b, s] = h @ np.asarray(p["wo"][e])
+        np.testing.assert_allclose(np.asarray(out), expected,
+                                   atol=1e-5, rtol=1e-5)
+
+    def test_ep_sharded_training_step(self):
+        """Expert dim sharded over ep; one jitted train step runs."""
+        mesh = make_mesh({"dp": 2, "ep": 4})
+        cfg = small_cfg(moe_experts=8, num_layers=2)
+        model = Transformer(cfg)
+        tokens = jnp.ones((4, 16), jnp.int32)
+        params = model.init(jax.random.key(0), tokens)
+        shardings = apply_rules(params, mesh, ep_rules())
+        params = shard_tree(params, shardings)
+        tokens = jax.device_put(
+            tokens, NamedSharding(mesh, P(("dp",))))
+
+        # expert stacks actually sharded
+        leaf = params["params"]["layer_0"]["moe"]["wi_gate"]
+        assert leaf.sharding.spec[0] == "ep"
+
+        tx = optax.sgd(0.1)
+        opt = tx.init(params)
+
+        @jax.jit
+        def step(p, o, t):
+            loss, grads = jax.value_and_grad(
+                lambda pp: moe_lm_loss(model, pp, t))(p)
+            updates, o = tx.update(grads, o, p)
+            return optax.apply_updates(p, updates), o, loss
+
+        p1, o1, l1 = step(params, opt, tokens)
+        p2, _, l2 = step(p1, o1, tokens)
+        assert float(l2) < float(l1)
+        assert p2["params"]["layer_0"]["moe"]["wi_gate"].sharding.spec[0] \
+            == "ep"

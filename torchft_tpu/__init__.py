@@ -27,6 +27,7 @@ from torchft_tpu.communicator import (
 )
 from torchft_tpu.backends.host import HostCommunicator
 from torchft_tpu.data import BatchIterator, DistributedSampler
+from torchft_tpu.local_sgd import DiLoCoTrainer, diloco_outer_optimizer
 from torchft_tpu.manager import Manager, WorldSizeMode
 from torchft_tpu.optim import FTOptimizer, OptimizerWrapper
 
@@ -35,7 +36,9 @@ __all__ = [
     "CheckpointServer",
     "Communicator",
     "CommunicatorError",
+    "DiLoCoTrainer",
     "DistributedSampler",
+    "diloco_outer_optimizer",
     "DummyCommunicator",
     "ErrorSwallowingCommunicator",
     "FTOptimizer",

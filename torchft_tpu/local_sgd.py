@@ -1,0 +1,143 @@
+"""DiLoCo-style fault-tolerant local SGD (BASELINE.md config 5).
+
+Each replica group trains *locally* for ``sync_every`` inner steps (no
+cross-group traffic at all — the DCN is idle), then runs one **outer
+round**: the groups quorum, average their parameter deltas since the last
+synchronized anchor, and apply an outer optimizer (SGD with Nesterov
+momentum, the DiLoCo recipe) to the anchor. Communication drops by a
+factor of ``sync_every`` versus per-step DDP, which is exactly what makes
+cross-region / cheap-interconnect training viable.
+
+Fault tolerance composes cleanly at outer-round granularity: the quorum,
+1/n averaging, commit vote, and live-weight healing all operate on rounds
+instead of steps — a killed group costs at most one *outer round* of its
+own progress, and a healed group restores ``(anchor, params, optimizer
+states)`` from a peer then applies the same averaged outer update,
+landing bit-identical (the same convergence mechanism as
+:class:`~torchft_tpu.parallel.step.FTTrainer`).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import optax
+
+from torchft_tpu.manager import Manager
+
+logger = logging.getLogger(__name__)
+
+
+def diloco_outer_optimizer(lr: float = 0.7, momentum: float = 0.9,
+                           ) -> optax.GradientTransformation:
+    """The DiLoCo outer optimizer: Nesterov momentum SGD."""
+    return optax.sgd(lr, momentum=momentum, nesterov=True)
+
+
+class DiLoCoTrainer:
+    """Owns ``(params, anchor, inner/outer optimizer state)`` and runs the
+    two-level schedule.
+
+    Args:
+        loss_fn: ``loss_fn(params, batch) -> loss`` (traced once).
+        inner_tx: the per-step local optimizer (e.g. AdamW).
+        outer_tx: the cross-group outer optimizer; default
+            :func:`diloco_outer_optimizer`.
+        sync_every: inner steps per outer round.
+        manager_factory: as in FTTrainer — wires healing to live pytrees.
+    """
+
+    def __init__(
+        self,
+        loss_fn: Callable[[Any, Any], Any],
+        inner_tx: optax.GradientTransformation,
+        params: Any,
+        manager_factory: Callable[..., Manager],
+        outer_tx: Optional[optax.GradientTransformation] = None,
+        sync_every: int = 16,
+        jit: bool = True,
+    ) -> None:
+        self.sync_every = sync_every
+        self._inner_tx = inner_tx
+        self._outer_tx = outer_tx or diloco_outer_optimizer()
+
+        self.params = params
+        self.anchor = params  # last globally-synchronized params
+        self.inner_state = inner_tx.init(params)
+        self.outer_state = self._outer_tx.init(params)
+        self.local_steps = 0
+
+        def inner_step(p, st, batch):
+            loss, grads = jax.value_and_grad(loss_fn)(p, batch)
+            updates, st = inner_tx.update(grads, st, p)
+            return optax.apply_updates(p, updates), st, loss
+
+        def outer_update(anchor, ostate, avg_delta):
+            updates, ostate = self._outer_tx.update(avg_delta, ostate,
+                                                    anchor)
+            return optax.apply_updates(anchor, updates), ostate
+
+        def delta(anchor, p):
+            return jax.tree_util.tree_map(lambda a, b: a - b, anchor, p)
+
+        self._inner_step = jax.jit(inner_step) if jit else inner_step
+        self._outer_update = jax.jit(outer_update) if jit else outer_update
+        self._delta = jax.jit(delta) if jit else delta
+
+        self.manager: Manager = manager_factory(
+            self.load_state_dict, self.state_dict)
+
+    # ------------------------------------------------------------------ api
+
+    def train_step(self, batch: Any) -> Tuple[Any, Optional[bool]]:
+        """One inner step; every ``sync_every``-th call also runs the outer
+        round. Returns ``(loss, outer_committed)`` — ``None`` when no outer
+        round ran this call."""
+        self.params, self.inner_state, loss = self._inner_step(
+            self.params, self.inner_state, batch)
+        self.local_steps += 1
+        committed: Optional[bool] = None
+        if self.local_steps % self.sync_every == 0:
+            committed = self.outer_round()
+        return loss, committed
+
+    def outer_round(self) -> bool:
+        """Quorum + averaged-delta outer update (the FT protocol at round
+        granularity)."""
+        m = self.manager
+        m.step()
+        # Pseudo-gradient: how far this group moved from the shared anchor.
+        pseudo_grad = self._delta(self.anchor, self.params)
+        avg = m.allreduce(pseudo_grad).result()
+        committed = m.should_commit()  # may heal this holder in-place
+        if committed:
+            # Healers included: restored anchor/outer_state + same averaged
+            # delta → identical post-round params everywhere.
+            self.anchor, self.outer_state = self._outer_update(
+                self.anchor, self.outer_state, avg)
+            self.params = self.anchor
+        else:
+            logger.warning("outer round %d aborted; continuing locally",
+                           m.current_step())
+        return committed
+
+    # ------------------------------------------------- state (for healing)
+
+    def state_dict(self) -> Any:
+        return {
+            "params": self.params,
+            "anchor": self.anchor,
+            "inner_state": self.inner_state,
+            "outer_state": self.outer_state,
+        }
+
+    def load_state_dict(self, state: Any) -> None:
+        self.params = state["params"]
+        self.anchor = state["anchor"]
+        self.inner_state = state["inner_state"]
+        self.outer_state = state["outer_state"]
+
+    def shutdown(self) -> None:
+        self.manager.shutdown()

@@ -1,14 +1,19 @@
 from torchft_tpu.models.mlp import MLP
+from torchft_tpu.models.moe import MoEMLP, ep_rules
 from torchft_tpu.models.resnet import ResNet, ResNet18, ResNet34, ResNet50
 from torchft_tpu.models.transformer import (
     Transformer,
     TransformerConfig,
     causal_lm_loss,
+    moe_lm_loss,
     tp_rules,
 )
 
 __all__ = [
     "MLP",
+    "MoEMLP",
+    "ep_rules",
+    "moe_lm_loss",
     "ResNet",
     "ResNet18",
     "ResNet34",

@@ -1,0 +1,102 @@
+"""Mixture-of-experts layers with expert parallelism (the ``ep`` mesh axis).
+
+New scope vs the reference (SURVEY.md §2: no EP anywhere); built because
+expert parallelism is a first-class sharding axis of the TPU framework.
+
+TPU-first design: **dense dispatch**. Tokens are combined with the routing
+weights via einsums over the full expert dimension instead of gather/
+scatter — data-dependent shapes would defeat XLA, while dense einsums map
+straight onto the MXU and shard cleanly: with the expert dimension of the
+weight stacks sharded over ``ep`` (:func:`ep_rules`), XLA partitions the
+expert einsums across the axis and inserts the combine reduction (the
+role all-to-all plays in gather-based MoE frameworks). Capacity-free: no
+token dropping, deterministic shapes.
+
+Router: top-k softmax gating (renormalized over the selected experts) with
+the standard load-balancing auxiliary loss (Switch/GShard style), returned
+via a flax ``aux_loss`` collection so any trainer can pull it.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+class MoEMLP(nn.Module):
+    """Top-k routed SwiGLU expert MLP. Input [B, S, D] → [B, S, D].
+
+    Attributes:
+        num_experts: E, ideally a multiple of the ``ep`` axis size.
+        top_k: experts per token (1 = Switch, 2 = GShard-ish).
+        mlp_dim: per-expert hidden width (MXU-friendly multiples of 128).
+        aux_loss_weight: weight for the load-balance loss (sown into the
+            ``aux_loss`` collection as ``moe_aux``).
+    """
+
+    num_experts: int
+    mlp_dim: int
+    top_k: int = 2
+    dtype: Any = jnp.bfloat16
+    aux_loss_weight: float = 0.01
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        d = x.shape[-1]
+        e, h = self.num_experts, self.mlp_dim
+
+        router = nn.Dense(e, use_bias=False, dtype=jnp.float32,
+                          name="router")
+        # Expert weight stacks: leading expert dim shards over "ep".
+        wi_gate = self.param("wi_gate", nn.initializers.lecun_normal(),
+                             (e, d, h))
+        wi_up = self.param("wi_up", nn.initializers.lecun_normal(),
+                           (e, d, h))
+        wo = self.param("wo", nn.initializers.lecun_normal(), (e, h, d))
+
+        logits = router(x.astype(jnp.float32))          # [B,S,E]
+        probs = jax.nn.softmax(logits, axis=-1)
+
+        top_w, top_idx = jax.lax.top_k(probs, self.top_k)   # [B,S,K]
+        top_w = top_w / jnp.sum(top_w, axis=-1, keepdims=True)
+        # Dense combine weights: sum of renormalized top-k one-hots [B,S,E].
+        combine = jnp.sum(
+            jax.nn.one_hot(top_idx, e, dtype=jnp.float32)
+            * top_w[..., None],
+            axis=2,
+        )
+
+        # Load-balance aux loss (Switch: E * sum_e fraction_e * prob_e).
+        token_frac = jnp.mean(
+            jnp.sum(jax.nn.one_hot(top_idx, e, dtype=jnp.float32), axis=2),
+            axis=(0, 1)) / self.top_k
+        prob_frac = jnp.mean(probs, axis=(0, 1))
+        aux = self.aux_loss_weight * e * jnp.sum(token_frac * prob_frac)
+        self.sow("aux_loss", "moe_aux", aux)
+
+        # Dense expert compute: every expert sees every token; the combine
+        # weight zeroes non-routed contributions. O(E/topk) extra FLOPs
+        # traded for static shapes + clean ep sharding — the standard
+        # small-E TPU tradeoff.
+        xc = x.astype(self.dtype)
+        gate = jnp.einsum("bsd,edh->ebsh", xc, wi_gate.astype(self.dtype))
+        up = jnp.einsum("bsd,edh->ebsh", xc, wi_up.astype(self.dtype))
+        act = nn.silu(gate) * up
+        out = jnp.einsum("ebsh,ehd->ebsd", act, wo.astype(self.dtype))
+        mixed = jnp.einsum("ebsd,bse->bsd",
+                           out.astype(jnp.float32),
+                           combine)
+        return mixed.astype(x.dtype)
+
+
+def ep_rules() -> list:
+    """Expert-parallel PartitionSpecs for ``apply_rules``: shard the expert
+    stacks' leading dim over ``ep``; router stays replicated."""
+    return [
+        (r"wi_gate$|wi_up$", P("ep", None, None)),
+        (r"/wo$", P("ep", None, None)),
+    ]

@@ -38,6 +38,10 @@ class TransformerConfig:
     # attention impl: None → plain softmax attention; otherwise a callable
     # (q, k, v, causal) -> out, e.g. ring attention under shard_map.
     attention_fn: Optional[Callable] = None
+    # Mixture-of-experts: num_experts > 0 replaces the dense MLP with a
+    # routed MoEMLP (expert dim shards over the "ep" mesh axis).
+    moe_experts: int = 0
+    moe_top_k: int = 2
 
     @property
     def kv_heads(self) -> int:
@@ -138,10 +142,18 @@ class DecoderLayer(nn.Module):
 
     @nn.compact
     def __call__(self, x, positions):
-        x = x + Attention(self.cfg, name="attn")(
+        cfg = self.cfg
+        x = x + Attention(cfg, name="attn")(
             RMSNorm(name="attn_norm")(x), positions)
-        x = x + MLPBlock(self.cfg, name="mlp")(
-            RMSNorm(name="mlp_norm")(x))
+        if cfg.moe_experts > 0:
+            from torchft_tpu.models.moe import MoEMLP
+
+            mlp = MoEMLP(num_experts=cfg.moe_experts,
+                         mlp_dim=cfg.mlp_dim, top_k=cfg.moe_top_k,
+                         dtype=cfg.dtype, name="moe")
+        else:
+            mlp = MLPBlock(cfg, name="mlp")
+        x = x + mlp(RMSNorm(name="mlp_norm")(x))
         return x
 
 
@@ -194,3 +206,23 @@ def causal_lm_loss(logits: jnp.ndarray, tokens: jnp.ndarray) -> jnp.ndarray:
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
     return jnp.mean(nll)
+
+
+def moe_lm_loss(model: "Transformer", params: Any,
+                tokens: jnp.ndarray) -> jnp.ndarray:
+    """LM loss + accumulated MoE load-balance aux losses (from the
+    ``aux_loss`` collection sown by :class:`~torchft_tpu.models.moe.MoEMLP`).
+
+    Only the ``params`` collection is passed into apply: ``init`` on an MoE
+    config also returns a stale init-time ``aux_loss`` collection, and
+    feeding it back would double-count the aux values and turn them into
+    trainable leaves with constant gradient 1. Callers can hand in either
+    the full ``init`` output or just its ``params``."""
+    variables = {
+        "params": params["params"] if "params" in params else params
+    }
+    logits, aux = model.apply(variables, tokens, mutable=["aux_loss"])
+    loss = causal_lm_loss(logits, tokens)
+    for leaf in jax.tree_util.tree_leaves(aux):
+        loss = loss + jnp.sum(leaf)
+    return loss

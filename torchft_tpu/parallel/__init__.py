@@ -7,10 +7,22 @@ from torchft_tpu.parallel.sharding import (
     replicated,
     shard_tree,
 )
+from torchft_tpu.parallel.pipeline import (
+    pipeline_apply,
+    pipeline_spec,
+    stack_layer_params,
+    transformer_pipeline_forward,
+)
+from torchft_tpu.parallel.ring_attention import make_ring_attention
 from torchft_tpu.parallel.step import FTTrainer
 
 __all__ = [
     "FTTrainer",
+    "make_ring_attention",
+    "pipeline_apply",
+    "pipeline_spec",
+    "stack_layer_params",
+    "transformer_pipeline_forward",
     "apply_rules",
     "batch_spec",
     "infer_fsdp_sharding",
