@@ -155,6 +155,17 @@ class Manager:
         self._pending_state_dict: Optional[Dict[str, Any]] = None
         self._pending_work: list[Future] = []
         self._quorum_future: Optional[Future] = None
+        # Lightweight observability: counters + cumulative timings (ms).
+        # The reference exposes only current_step/batches_committed
+        # (manager.py:484-506); these cover the SRE questions its dashboard
+        # can't answer (how long do quorums take, how often do we heal).
+        self._metrics: Dict[str, float] = {
+            "quorum_count": 0, "quorum_ms_total": 0.0, "quorum_ms_last": 0.0,
+            "reconfigure_count": 0, "heal_count": 0,
+            "allreduce_count": 0, "allreduce_ms_total": 0.0,
+            "commit_count": 0, "commit_ms_total": 0.0,
+            "committed_steps": 0, "aborted_steps": 0,
+        }
         # One thread: quorum rounds are strictly ordered per rank (reference
         # manager.py:134).
         self._executor = ThreadPoolExecutor(
@@ -249,6 +260,7 @@ class Manager:
     def _async_quorum(self) -> None:
         """Quorum round-trip + membership reaction (reference
         ``manager.py:334-396``). Runs on the single quorum thread."""
+        t0 = time.perf_counter()
         q = self._client.quorum(
             rank=self._rank,
             step=self._step,
