@@ -34,6 +34,27 @@ class TestFlashAttention:
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    atol=2e-5, rtol=2e-5)
 
+    def test_causal_cross_shape_end_aligned(self):
+        # s_q != s_k (decode-style): queries are the LAST s_q positions.
+        # Forward and backward must use the same end-aligned mask
+        # (round-1 ADVICE: the kernel was start-aligned, the vjp end-aligned).
+        b, h, d = 2, 4, 16
+        ks = jax.random.split(jax.random.key(3), 3)
+        q = jax.random.normal(ks[0], (b, 8, h, d))
+        k = jax.random.normal(ks[1], (b, 32, h, d))
+        v = jax.random.normal(ks[2], (b, 32, h, d))
+        ref = plain_attention(q, k, v, True)
+        out = flash_attention(q, k, v, True, 8, 8)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+        gf = jax.grad(lambda q, k, v: jnp.sum(
+            flash_attention(q, k, v, True, 8, 8) ** 2), (0, 1, 2))(q, k, v)
+        gr = jax.grad(lambda q, k, v: jnp.sum(
+            plain_attention(q, k, v, True) ** 2), (0, 1, 2))(q, k, v)
+        for a, b_ in zip(gf, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                       atol=2e-4, rtol=2e-4)
+
     def test_grads_match_reference(self):
         q, k, v = qkv(s=16)
 

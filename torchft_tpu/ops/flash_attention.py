@@ -33,7 +33,7 @@ NEG_INF = -1e30
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
-                causal: bool, scale: float, nkb: int):
+                causal: bool, scale: float, nkb: int, offset: int):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     bq = q_ref.shape[1]
@@ -46,8 +46,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
     # Causal: blocks strictly above the diagonal contribute nothing.
+    # ``offset = s_k - s_q`` end-aligns queries to the last s_q key
+    # positions (decode convention; matches _reference's tril(k=s_k-s_q)).
     diag_ok = jnp.logical_or(not causal,
-                             qi * bq + bq - 1 >= ki * bk)
+                             qi * bq + bq - 1 + offset >= ki * bk)
 
     @pl.when(diag_ok)
     def _compute():
@@ -56,7 +58,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
         v = v_ref[0].astype(jnp.float32)                  # [bk, d]
         logits = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
         if causal:
-            q_pos = qi * bq + jax.lax.broadcasted_iota(
+            q_pos = offset + qi * bq + jax.lax.broadcasted_iota(
                 jnp.int32, (bq, bk), 0)
             k_pos = ki * bk + jax.lax.broadcasted_iota(
                 jnp.int32, (bq, bk), 1)
@@ -88,6 +90,9 @@ def _flash_fwd(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
 
     qh, kh, vh = to_bh(q), to_bh(k), to_bh(v)
     sk = kh.shape[1]
+    assert not causal or sk >= s, (
+        "causal flash_attention requires s_k >= s_q (queries are the last "
+        f"s_q positions, decode convention); got s_q={s}, s_k={sk}")
     block_q = min(block_q, s)
     block_k = min(block_k, sk)
     assert s % block_q == 0 and sk % block_k == 0, (
@@ -98,7 +103,7 @@ def _flash_fwd(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     grid = (b * h, s // block_q, nkb)
     out = pl.pallas_call(
         functools.partial(_fwd_kernel, causal=causal, scale=scale,
-                          nkb=nkb),
+                          nkb=nkb, offset=sk - s),
         out_shape=jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
         grid=grid,
         in_specs=[

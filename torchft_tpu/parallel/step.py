@@ -62,12 +62,22 @@ class FTTrainer:
         param_shardings: Any = None,
         batch_sharding: Any = None,
         jit_fwd: bool = True,
+        strict_commit: bool = False,
     ) -> None:
         """``model_state`` holds non-trainable, per-step-mutated collections
         (e.g. flax batch_stats). When given, ``loss_fn`` must have signature
         ``loss_fn(params, model_state, batch) -> (loss, new_model_state)``;
         the new state is adopted only on committed, non-healing steps (like
-        params, it is healed from the primary's checkpoint)."""
+        params, it is healed from the primary's checkpoint).
+
+        ``strict_commit``: synchronize the device before every commit vote so
+        an asynchronously-failing step can never be voted committed. Costs a
+        full device round-trip per step (ruinous through a tunneled chip;
+        measured >10x on remote TPU). Off by default: like the reference
+        (whose CUDA compute is equally async at vote time), a device failure
+        after the vote surfaces next step, latches, and the quorum + healing
+        path recovers the group — the rare-failure window is covered by the
+        FT protocol itself rather than a per-step sync tax."""
         if param_shardings is not None:
             params = jax.device_put(params, param_shardings)
         self.params = params
@@ -75,6 +85,7 @@ class FTTrainer:
         self._has_state = model_state is not None
         self.opt_state = tx.init(params)
         self._batch_sharding = batch_sharding
+        self._strict_commit = strict_commit
 
         if self._has_state:
             def fwd_bwd(p: Any, st: Any, batch: Any):
@@ -88,11 +99,37 @@ class FTTrainer:
 
         self._fwd_bwd = jax.jit(fwd_bwd) if jit_fwd else fwd_bwd
 
+        # Speculative fused step for steps with no cross-group traffic
+        # (Manager.single_group_step): forward, backward AND optimizer
+        # update in ONE compiled program, so XLA fuses the update into the
+        # backward instead of round-tripping a grads pytree through HBM and
+        # paying a second dispatch (measured ~1.5x step time on ResNet-18).
+        # Deliberately NOT donated: if the commit vote fails, the caller
+        # keeps the old pytrees — "don't commit" stays free. Costs one extra
+        # params+opt_state copy of HBM while the step runs, same transient
+        # peak as the donated raw loop.
+        def fused(p: Any, st: Any, o: Any, batch: Any):
+            loss, new_st, grads = fwd_bwd(p, st, batch)
+            updates, new_o = tx.update(grads, o, p)
+            return loss, new_st, optax.apply_updates(p, updates), new_o
+
+        self._fused = jax.jit(fused) if jit_fwd else fused
+
         self.manager: Manager = manager_factory(
             self.load_state_dict, self.state_dict
         )
         self._opt = FTOptimizer(self.manager, tx, jit=jit_fwd)
         self.last_loss: Optional[float] = None
+        # Sticky predictor for the fused-vs-split dispatch choice: the step
+        # shape only changes on membership changes, so last step's answer is
+        # right in both steady states and the quorum round-trip stays fully
+        # overlapped with device execution. None = not yet known; the first
+        # step joins its quorum *before* dispatching so the right program is
+        # compiled from the start (multi-group runs never pay the fused
+        # compile, single-group runs never pay the split one). Later
+        # mispredictions cost one recompute (fused->split) or one
+        # slower-but-correct step (split->fused next step).
+        self._predict_single: Optional[bool] = None
 
     # ---------------------------------------------------------------- step
 
@@ -107,9 +144,37 @@ class FTTrainer:
         self.manager.step()
         if self._batch_sharding is not None:
             batch = jax.device_put(batch, self._batch_sharding)
+
+        if self._predict_single is None:
+            # First step: learn the shape before compiling anything.
+            self.manager.wait_quorum()
+            self._predict_single = self.manager.single_group_step()
+
+        if self._predict_single:
+            # Fused speculative step dispatched immediately (overlaps the
+            # quorum); adopted below only if the quorum confirms the
+            # single-group shape AND the vote passes.
+            loss, new_state, new_p, new_o = self._fused(
+                self.params, self.model_state, self.opt_state, batch)
+            self.manager.wait_quorum()
+            if self.manager.single_group_step():
+                loss = self._strict_sync(loss)
+                committed = self.manager.should_commit()
+                if committed and not self.manager.is_healing():
+                    self.params, self.opt_state = new_p, new_o
+                    if self._has_state:
+                        self.model_state = new_state
+                self.last_loss = loss
+                return loss, committed
+            # Misprediction (membership grew / healing): discard the
+            # speculative result and rerun the split path this step.
+            self._predict_single = False
+
         loss, new_state, grads = self._fwd_bwd(
             self.params, self.model_state, batch)
         avg = self.manager.allreduce(grads).result()
+        loss = self._strict_sync(loss)
+        self._predict_single = self.manager.single_group_step()
         # The vote inside apply() may restore healed state into this trainer
         # before the update reads it — hence the holder indirection.
         committed = self._opt.apply(self, avg)
@@ -121,6 +186,21 @@ class FTTrainer:
             self.model_state = new_state
         self.last_loss = loss
         return loss, committed
+
+    def _strict_sync(self, loss: Any) -> Any:
+        """Under ``strict_commit``, surface an async device failure *before*
+        the vote. Blocking on the scalar loss is enough: the compiled
+        program completes or fails as a unit. Returns a safe NaN in place of
+        a poisoned loss array so callers who log it don't re-raise the
+        latched error."""
+        if not self._strict_commit:
+            return loss
+        try:
+            loss.block_until_ready()
+            return loss
+        except Exception as e:  # noqa: BLE001
+            self.manager.report_error(e)
+            return float("nan")
 
     # ------------------------------------------------- state (for healing)
 
