@@ -1,0 +1,14 @@
+#!/usr/bin/env bash
+# Fast commit gate (~15s): syntax-compile everything and run the protocol
+# unit tests. Exists because round 1 shipped a module-level NameError in its
+# final commit that broke the whole framework at HEAD — nothing ran before
+# `git commit`. Full suite: scripts/test.sh (C++ tests + all of pytest).
+#
+# Install:  ln -sf ../../scripts/precommit.sh .git/hooks/pre-commit
+set -euo pipefail
+cd "$(git rev-parse --show-toplevel)"
+
+python -m compileall -q torchft_tpu tests examples bench.py __graft_entry__.py
+JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_manager.py tests/test_communicator.py tests/test_wrappers.py \
+    -q --no-header -x
