@@ -1,25 +1,33 @@
-"""Benchmark: fault-tolerant training throughput vs raw (no-FT) throughput.
+"""Benchmarks: FT efficiency, absolute throughput/MFU, multi-group traffic,
+and recovery latency.
 
 The reference publishes no numbers (BASELINE.md), so the headline metric is
-the one its design claims and the north star targets: FT efficiency —
+the one its design claims and the north star targets: **FT efficiency** —
 steps/sec with the full per-step fault-tolerance protocol (lighthouse
 quorum, commit vote, checkpoint window, cross-group communicator) as a
 fraction of raw jitted steps/sec on the same chip. North star: >= 0.90.
 
-Prints ONE JSON line:
+Prints ONE JSON line on stdout:
     {"metric": "ft_efficiency", "value": <ft steps/s>, "unit": "steps/s",
-     "vs_baseline": <ft/raw ratio vs the 0.90 target>}
+     "vs_baseline": <ft/raw efficiency vs the 0.90 target>}
 
-Runs on whatever jax.devices()[0] is (real TPU under the driver; CPU works
-too, smaller shapes).
+Everything else (absolute img/s, achieved TFLOP/s + MFU, 2-replica-group
+throughput with real cross-group HostCommunicator traffic, recovery steps
+lost and wall-clock-to-heal — BASELINE.md's stated metrics) goes to stderr
+as secondary JSON lines.
+
+The scenario functions are importable; tests/test_bench_scenarios.py runs
+them at tiny scale and asserts the recovery guarantees (<1 step lost).
 """
 
 from __future__ import annotations
 
 import json
-import os
+import statistics
 import sys
+import threading
 import time
+from typing import Any, Dict, Optional
 
 import jax
 import jax.numpy as jnp
@@ -27,19 +35,60 @@ import numpy as np
 import optax
 
 
-def main() -> None:
-    on_tpu = jax.devices()[0].platform == "tpu"
-    # ResNet-18/CIFAR-10 — BASELINE.md config 1.
+def _materialize(tree) -> float:
+    """Force execution: fetch one scalar derived from the tree (a bare
+    block_until_ready can return early through device tunnels)."""
+    leaf = jax.tree_util.tree_leaves(tree)[0]
+    return float(jnp.sum(leaf))
+
+
+def _emit(obj: Dict[str, Any]) -> None:
+    print(json.dumps(obj), file=sys.stderr)
+
+
+# Peak dense matmul throughput per chip, bf16 (f32 is ~half). Sources:
+# public TPU spec sheets. Used only for the advisory MFU line.
+_PEAK_BF16_TFLOPS = {
+    "TPU v4": 275.0,
+    "TPU v5e": 197.0,
+    "TPU v5 lite": 197.0,
+    "TPU v5p": 459.0,
+    "TPU v6e": 918.0,
+}
+
+
+def _peak_tflops() -> Optional[float]:
+    kind = jax.devices()[0].device_kind
+    for name, peak in _PEAK_BF16_TFLOPS.items():
+        if name.lower() in kind.lower():
+            return peak
+    return None
+
+
+# --------------------------------------------------------------- scenario 1
+
+def bench_single_group(steps: int = 20, segments: int = 3,
+                       batch: Optional[int] = None) -> Dict[str, float]:
+    """Raw fused step vs full-FT step on one replica group (BASELINE.md
+    config 1 shape: ResNet-18/CIFAR-10). Alternates raw/FT measurement
+    segments and takes medians — throughput through a tunneled chip drifts
+    minute to minute, and interleaving cancels the drift out of the ratio."""
     from torchft_tpu import HostCommunicator, Lighthouse, Manager
     from torchft_tpu.models import ResNet18
     from torchft_tpu.parallel import FTTrainer
 
-    batch = 256 if on_tpu else 32
-    steps = 30 if on_tpu else 8
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if batch is None:
+        batch = 256 if on_tpu else 32
+    if not on_tpu:
+        steps = min(steps, 6)
+        segments = min(segments, 2)
+
     model = ResNet18(num_classes=10)
     rng = np.random.default_rng(0)
     x = jnp.asarray(rng.normal(size=(batch, 32, 32, 3)), jnp.float32)
     y = jnp.asarray(rng.integers(0, 10, size=(batch,)), jnp.int32)
+    b = {"x": x, "y": y}
 
     def loss_fn(params, model_state, batch_):
         logits, new_state = model.apply(
@@ -54,7 +103,6 @@ def main() -> None:
     bn_state = {"batch_stats": variables["batch_stats"]}
     tx = optax.sgd(0.1, momentum=0.9)
 
-    # ---- raw: plain jitted train step, no FT protocol ----
     def raw_step(p, st, o, b):
         (loss, st), grads = jax.value_and_grad(loss_fn, has_aux=True)(
             p, st, b)
@@ -62,68 +110,282 @@ def main() -> None:
         return optax.apply_updates(p, updates), st, o, loss
 
     raw = jax.jit(raw_step, donate_argnums=(0, 1, 2))
-    # private copies: the raw loop donates its buffers
     p = jax.tree_util.tree_map(jnp.copy, params)
     st = jax.tree_util.tree_map(jnp.copy, bn_state)
     o = tx.init(p)
-    b = {"x": x, "y": y}
 
-    def materialize(tree) -> float:
-        """Force execution: fetch one scalar derived from the tree (a bare
-        block_until_ready can return early through device tunnels)."""
-        leaf = jax.tree_util.tree_leaves(tree)[0]
-        return float(jnp.sum(leaf))
+    # FLOPs of one step, from XLA's own cost model (for the MFU line).
+    try:
+        cost = raw.lower(p, st, o, b).compile().cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0]
+        step_flops = float(cost["flops"])
+    except Exception:  # noqa: BLE001
+        step_flops = None
 
-    p, st, o, l0 = raw(p, st, o, b)  # compile
-    materialize(p)
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        p, st, o, l0 = raw(p, st, o, b)
-    materialize(p)
-    raw_sps = steps / (time.perf_counter() - t0)
+    p, st, o, _ = raw(p, st, o, b)  # compile
+    _materialize(p)
 
-    # ---- ft: full per-step protocol (single replica group) ----
     lh = Lighthouse(bind="127.0.0.1:0", min_replicas=1,
                     join_timeout_ms=100, quorum_tick_ms=10)
     trainer = FTTrainer(
-        loss_fn=loss_fn,
-        tx=tx,
-        params=params,
-        model_state=bn_state,
+        loss_fn=loss_fn, tx=tx, params=params, model_state=bn_state,
         manager_factory=lambda load, save: Manager(
             comm=HostCommunicator(timeout_sec=30),
-            load_state_dict=load,
-            state_dict=save,
-            min_replica_size=1,
-            replica_id="bench",
-            lighthouse_addr=lh.address(),
-            rank=0,
-            world_size=1,
+            load_state_dict=load, state_dict=save, min_replica_size=1,
+            replica_id="bench", lighthouse_addr=lh.address(),
+            rank=0, world_size=1,
         ),
     )
     trainer.train_step(b)  # compile + first quorum
-    materialize(trainer.params)
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        _, committed = trainer.train_step(b)
-        assert committed
-    materialize(trainer.params)
-    ft_sps = steps / (time.perf_counter() - t0)
+    _materialize(trainer.params)
+
+    raw_sps, ft_sps = [], []
+    for _ in range(segments):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            p, st, o, _ = raw(p, st, o, b)
+        _materialize(p)
+        raw_sps.append(steps / (time.perf_counter() - t0))
+
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            _, committed = trainer.train_step(b)
+            assert committed
+        _materialize(trainer.params)
+        ft_sps.append(steps / (time.perf_counter() - t0))
+
     trainer.shutdown()
     lh.shutdown()
 
-    efficiency = ft_sps / raw_sps
-    # Baseline = the north-star bar: >=90% of healthy throughput with FT on
-    # (BASELINE.json north_star; reference publishes no numbers).
+    raw_med = statistics.median(raw_sps)
+    ft_med = statistics.median(ft_sps)
+    out = {
+        "raw_steps_per_s": raw_med,
+        "ft_steps_per_s": ft_med,
+        "efficiency": ft_med / raw_med,
+        "img_per_s": ft_med * batch,
+        "batch": batch,
+    }
+    if step_flops:
+        tflops = ft_med * step_flops / 1e12
+        out["achieved_tflops"] = tflops
+        peak = _peak_tflops()
+        if peak:
+            out["mfu_vs_bf16_peak"] = tflops / peak
+    return out
+
+
+# --------------------------------------------------------------- scenario 2
+
+def bench_multigroup(n_groups: int = 2, steps: int = 20,
+                     hidden: int = 512) -> Dict[str, float]:
+    """N replica groups as threads, real cross-group gradient traffic:
+    device_get -> HostCommunicator ring allreduce over localhost TCP ->
+    device_put (the path a single-group bench never touches — round-1
+    VERDICT weak #3)."""
+    from torchft_tpu import HostCommunicator, Lighthouse, Manager
+    from torchft_tpu.models import MLP
+    from torchft_tpu.parallel import FTTrainer
+
+    lh = Lighthouse(bind="127.0.0.1:0", min_replicas=n_groups,
+                    join_timeout_ms=2000, quorum_tick_ms=10)
+    model = MLP(features=(hidden, hidden), num_classes=10)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(64, 64)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 10, size=(64,)), jnp.int32)
+
+    def loss_fn(params, batch):
+        logits = model.apply(params, batch["x"])
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, batch["y"]).mean()
+
+    params0 = model.init(jax.random.key(0), x[:1])
+    n_params = sum(int(np.prod(np.shape(l)))
+                   for l in jax.tree_util.tree_leaves(params0))
+    results: Dict[str, Dict[str, float]] = {}
+
+    def worker(gid: str) -> None:
+        trainer = FTTrainer(
+            loss_fn=loss_fn, tx=optax.sgd(0.05), params=params0,
+            manager_factory=lambda load, save: Manager(
+                comm=HostCommunicator(timeout_sec=30), load_state_dict=load,
+                state_dict=save, min_replica_size=n_groups, replica_id=gid,
+                lighthouse_addr=lh.address(), rank=0, world_size=1,
+                quorum_timeout_ms=30_000,
+            ),
+        )
+        b = {"x": x, "y": y}
+        trainer.train_step(b)  # compile + join + first reconfigure
+        t0 = time.perf_counter()
+        done = 0
+        while done < steps:
+            _, committed = trainer.train_step(b)
+            if committed:
+                done += 1
+        _materialize(trainer.params)
+        dt = time.perf_counter() - t0
+        mx = trainer.manager.metrics()
+        results[gid] = {
+            "steps_per_s": steps / dt,
+            "allreduce_ms_avg":
+                mx["allreduce_ms_total"] / max(mx["allreduce_count"], 1),
+        }
+        trainer.shutdown()
+
+    threads = [threading.Thread(target=worker, args=(f"g{i}",))
+               for i in range(n_groups)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=600)
+    lh.shutdown()
+
+    sps = statistics.median(r["steps_per_s"] for r in results.values())
+    ar = statistics.median(r["allreduce_ms_avg"] for r in results.values())
+    return {
+        "n_groups": n_groups,
+        "steps_per_s": sps,
+        "allreduce_ms_avg": ar,
+        "grad_mbytes": n_params * 4 / 1e6,
+    }
+
+
+# --------------------------------------------------------------- scenario 3
+
+def bench_recovery(kill_at: int = 6, total_steps: int = 16,
+                   hidden: int = 64) -> Dict[str, float]:
+    """Kill one of two replica groups mid-run, restart it, and measure
+    BASELINE.md's stated metrics: steps of progress the survivor loses
+    (must be <= 1) and wall-clock from restart to the healed group's first
+    committed step."""
+    from torchft_tpu import HostCommunicator, Lighthouse, Manager
+    from torchft_tpu.models import MLP
+    from torchft_tpu.parallel import FTTrainer
+
+    lh = Lighthouse(bind="127.0.0.1:0", min_replicas=1,
+                    join_timeout_ms=400, quorum_tick_ms=10)
+    model = MLP(features=(hidden,), num_classes=2)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(32, 8)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 2, size=(32,)), jnp.int32)
+    b = {"x": x, "y": y}
+
+    def loss_fn(params, batch):
+        logits = model.apply(params, batch["x"])
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, batch["y"]).mean()
+
+    params0 = model.init(jax.random.key(0), x[:1])
+
+    def make_trainer(gid: str) -> FTTrainer:
+        return FTTrainer(
+            loss_fn=loss_fn, tx=optax.sgd(0.05), params=params0,
+            manager_factory=lambda load, save: Manager(
+                comm=HostCommunicator(timeout_sec=15), load_state_dict=load,
+                state_dict=save, min_replica_size=1, replica_id=gid,
+                lighthouse_addr=lh.address(), rank=0, world_size=1,
+                timeout_ms=15_000, quorum_timeout_ms=15_000,
+            ),
+        )
+
+    out: Dict[str, float] = {}
+    survivor_done = threading.Event()
+
+    def survivor() -> None:
+        trainer = make_trainer("gA")
+        while trainer.manager.current_step() < total_steps:
+            trainer.train_step(b)
+        mx = trainer.manager.metrics()
+        out["survivor_aborted_steps"] = mx["aborted_steps"]
+        out["survivor_committed_steps"] = mx["committed_steps"]
+        out["survivor_heals"] = mx["heal_count"]
+        survivor_done.set()
+        trainer.shutdown()
+
+    def victim() -> None:
+        # First life: run to kill_at, then "die" (shutdown, drop state).
+        trainer = make_trainer("gB")
+        while trainer.manager.current_step() < kill_at:
+            trainer.train_step(b)
+        trainer.shutdown()
+        # Restart: fresh trainer (fresh uuid replica member, params at
+        # init) — must rejoin, heal from gA, and commit.
+        t0 = time.perf_counter()
+        trainer = make_trainer("gB")
+        committed = 0
+        while committed < 1 and not survivor_done.is_set():
+            _, ok = trainer.train_step(b)
+            committed += bool(ok)
+        out["recovery_wall_clock_s"] = time.perf_counter() - t0
+        out["victim_recovered_at_step"] = trainer.manager.current_step()
+        # keep participating until the survivor finishes so quorums stay 2-wide
+        while not survivor_done.is_set():
+            trainer.train_step(b)
+        trainer.shutdown()
+
+    errors: list = []
+
+    def guarded(fn):
+        def run():
+            try:
+                fn()
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+                survivor_done.set()  # unblock the peer
+        return run
+
+    ts = [threading.Thread(target=guarded(survivor)),
+          threading.Thread(target=guarded(victim))]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=300)
+    lh.shutdown()
+    if errors:
+        raise errors[0]
+    return out
+
+
+# --------------------------------------------------------------------- main
+
+def main() -> None:
+    single = bench_single_group()
+    _emit({"metric": "img_per_s", "value": round(single["img_per_s"], 1),
+           "unit": "images/s", "batch": single["batch"]})
+    if "achieved_tflops" in single:
+        _emit({"metric": "achieved_tflops",
+               "value": round(single["achieved_tflops"], 2),
+               "unit": "TFLOP/s",
+               "mfu_vs_bf16_peak": round(single.get("mfu_vs_bf16_peak", 0.0),
+                                         4)})
+
+    mg = bench_multigroup()
+    _emit({"metric": "multigroup_steps_per_s",
+           "value": round(mg["steps_per_s"], 2), "unit": "steps/s",
+           "n_groups": mg["n_groups"],
+           "allreduce_ms_avg": round(mg["allreduce_ms_avg"], 2),
+           "grad_mbytes": round(mg["grad_mbytes"], 2)})
+
+    rec = bench_recovery()
+    _emit({"metric": "recovery_wall_clock_s",
+           "value": round(rec.get("recovery_wall_clock_s", -1.0), 3),
+           "unit": "s",
+           "survivor_aborted_steps": rec.get("survivor_aborted_steps"),
+           "survivor_heals": rec.get("survivor_heals")})
+
+    # Headline (stdout, exactly one line): FT efficiency vs the 0.90
+    # north-star bar (BASELINE.json; the reference publishes no numbers).
     print(json.dumps({
         "metric": "ft_efficiency",
-        "value": round(ft_sps, 3),
+        "value": round(single["ft_steps_per_s"], 3),
         "unit": "steps/s",
-        "vs_baseline": round(efficiency / 0.90, 4),
+        "vs_baseline": round(single["efficiency"] / 0.90, 4),
     }))
-    print(f"# raw={raw_sps:.3f} steps/s ft={ft_sps:.3f} steps/s "
-          f"efficiency={efficiency:.3f} platform="
-          f"{jax.devices()[0].platform} batch={batch}", file=sys.stderr)
+    print(f"# raw={single['raw_steps_per_s']:.3f} steps/s "
+          f"ft={single['ft_steps_per_s']:.3f} steps/s "
+          f"efficiency={single['efficiency']:.3f} "
+          f"platform={jax.devices()[0].platform}", file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,33 @@
+"""Small-scale runs of the bench scenarios, asserting BASELINE.md's stated
+recovery guarantees (<1 step of survivor progress lost per membership
+change; healed group rejoins at the survivor's step, not from scratch)."""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from bench import bench_multigroup, bench_recovery  # noqa: E402
+
+
+class TestBenchScenarios:
+    def test_multigroup_traffic(self):
+        out = bench_multigroup(n_groups=2, steps=3, hidden=32)
+        assert out["steps_per_s"] > 0
+        # Real cross-group traffic must have been measured.
+        assert out["allreduce_ms_avg"] > 0
+        assert out["grad_mbytes"] > 0
+
+    def test_recovery_guarantees(self):
+        kill_at = 3
+        out = bench_recovery(kill_at=kill_at, total_steps=12, hidden=16)
+        # Survivor: at most one aborted step per membership change (the
+        # victim leaving and rejoining = 2 changes), plus possibly its own
+        # step-1 heal round.
+        assert out["survivor_aborted_steps"] <= 3, out
+        assert out["survivor_committed_steps"] >= 9, out
+        # The restarted group healed to the survivor's current step instead
+        # of replaying from scratch...
+        assert out["victim_recovered_at_step"] > kill_at, out
+        # ...and did so in bounded wall-clock.
+        assert 0 < out["recovery_wall_clock_s"] < 60, out

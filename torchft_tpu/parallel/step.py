@@ -26,6 +26,7 @@ import logging
 from typing import Any, Callable, Optional, Tuple
 
 import jax
+import jax.numpy as jnp
 import optax
 
 from torchft_tpu.manager import Manager
@@ -80,7 +81,10 @@ class FTTrainer:
         FT protocol itself rather than a per-step sync tax."""
         if param_shardings is not None:
             params = jax.device_put(params, param_shardings)
-        self.params = params
+        # Private copy: the commit-gated update donates its inputs, and
+        # donating the *caller's* pytree would delete buffers the caller
+        # (or a second trainer built from the same init) still owns.
+        self.params = jax.tree_util.tree_map(jnp.copy, params)
         self.model_state = model_state
         self._has_state = model_state is not None
         self.opt_state = tx.init(params)
