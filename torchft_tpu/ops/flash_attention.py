@@ -14,9 +14,14 @@ steps — each program instance sees one [block_q, d] q tile and one
 [block_k, d] k/v tile, so VMEM usage is O(block) regardless of S and the
 pipeline streams K/V tiles from HBM while the MXU works.
 
-Forward is the Pallas kernel; backward recomputes attention with the
-pure-jnp reference implementation (flash-style recompute trades FLOPs for
-the O(S^2) residuals). Layouts: q/k/v are [B, S, H, D].
+Forward and backward are both Pallas kernels. The forward additionally
+saves the per-row logsumexp; the backward recomputes the probability tiles
+blockwise from (q, k, lse) — the flash-style recompute that trades FLOPs
+for the O(S^2) residuals — and accumulates dq (one kernel, k innermost)
+and dk/dv (one kernel, q innermost) in VMEM scratch. Training memory is
+O(S) residuals + O(block) workspace at any sequence length.
+Layouts: q/k/v are [B, S, H, D]; causal masks are end-aligned (queries are
+the last s_q key positions; s_k >= s_q enforced).
 """
 
 from __future__ import annotations
@@ -30,10 +35,11 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
+_LANES = 128  # TPU vector lane count
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
-                causal: bool, scale: float, nkb: int, offset: int):
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
+                *, causal: bool, scale: float, nkb: int, offset: int):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     bq = q_ref.shape[1]
@@ -77,6 +83,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
     def _finalize():
         o_ref[0] = (acc_ref[:] /
                     jnp.maximum(l_ref[:], 1e-30)).astype(o_ref.dtype)
+        # Per-row logsumexp of the scaled logits — the only residual the
+        # backward needs beyond (q, k, v, o).
+        lse = m_ref[:] + jnp.log(jnp.maximum(l_ref[:], 1e-30))
+        lse_ref[0] = jax.lax.broadcast_in_dim(
+            lse[:, 0], lse_ref.shape[1:], (0,))
 
 
 def _flash_fwd(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
@@ -101,17 +112,28 @@ def _flash_fwd(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     nkb = sk // block_k
 
     grid = (b * h, s // block_q, nkb)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, causal=causal, scale=scale,
                           nkb=nkb, offset=sk - s),
-        out_shape=jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
+            # Row stats ride in [bh, s, 128] with the value broadcast over
+            # the 128 lanes — the TPU-friendly layout for per-row scalars
+            # (same trick as jax.experimental.pallas.ops.tpu.flash_attention;
+            # a [bh, s] block or a flat 1D array violates Mosaic tiling).
+            jax.ShapeDtypeStruct((b * h, s, _LANES), jnp.float32),
+        ],
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
             pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((1, block_q, _LANES),
+                         lambda bh, i, j: (bh, i, 0)),
+        ],
         scratch_shapes=[
             pltpu.VMEM((block_q, 1), jnp.float32),   # running max
             pltpu.VMEM((block_q, 1), jnp.float32),   # running sum
@@ -119,7 +141,164 @@ def _flash_fwd(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         ],
         interpret=interpret,
     )(qh, kh, vh)
-    return out.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+    return out.reshape(b, h, s, d).transpose(0, 2, 1, 3), lse[:, :, 0]
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   acc_ref, *, causal: bool, scale: float, nkb: int,
+                   offset: int):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    bq = q_ref.shape[1]
+    bk = k_ref.shape[1]
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    diag_ok = jnp.logical_or(not causal,
+                             qi * bq + bq - 1 + offset >= ki * bk)
+
+    @pl.when(diag_ok)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)                  # [bq, d]
+        k = k_ref[0].astype(jnp.float32)                  # [bk, d]
+        v = v_ref[0].astype(jnp.float32)                  # [bk, d]
+        do = do_ref[0].astype(jnp.float32)                # [bq, d]
+        logits = jnp.dot(q, k.T,
+                         preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = offset + qi * bq + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, bk), 0)
+            k_pos = ki * bk + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, bk), 1)
+            logits = jnp.where(q_pos >= k_pos, logits, NEG_INF)
+        lse_row = jnp.max(lse_ref[0], axis=1, keepdims=True)
+        p = jnp.exp(logits - lse_row)                     # exact softmax
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        delta_row = jnp.max(delta_ref[0], axis=1, keepdims=True)
+        ds = p * (dp - delta_row)
+        acc_ref[:] += jnp.dot(ds, k,
+                              preferred_element_type=jnp.float32) * scale
+
+    @pl.when(ki == nkb - 1)
+    def _finalize():
+        dq_ref[0] = acc_ref[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                     dk_ref, dv_ref, dk_acc, dv_acc, *, causal: bool,
+                     scale: float, nqb: int, offset: int):
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+    bq = q_ref.shape[1]
+    bk = k_ref.shape[1]
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    diag_ok = jnp.logical_or(not causal,
+                             qi * bq + bq - 1 + offset >= ki * bk)
+
+    @pl.when(diag_ok)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)                  # [bq, d]
+        k = k_ref[0].astype(jnp.float32)                  # [bk, d]
+        v = v_ref[0].astype(jnp.float32)                  # [bk, d]
+        do = do_ref[0].astype(jnp.float32)                # [bq, d]
+        logits = jnp.dot(q, k.T,
+                         preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = offset + qi * bq + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, bk), 0)
+            k_pos = ki * bk + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, bk), 1)
+            logits = jnp.where(q_pos >= k_pos, logits, NEG_INF)
+        lse_row = jnp.max(lse_ref[0], axis=1, keepdims=True)
+        p = jnp.exp(logits - lse_row)                     # [bq, bk]
+        dv_acc[:] += jnp.dot(p.T, do,
+                             preferred_element_type=jnp.float32)
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        delta_row = jnp.max(delta_ref[0], axis=1, keepdims=True)
+        ds = p * (dp - delta_row)
+        dk_acc[:] += jnp.dot(ds.T, q,
+                             preferred_element_type=jnp.float32) * scale
+
+    @pl.when(qi == nqb - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd(q, k, v, out, lse, g, causal: bool, block_q: int,
+               block_k: int, interpret: bool):
+    b, s, h, d = q.shape
+    scale = d ** -0.5
+
+    def to_bh(x):
+        return x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
+
+    qh, kh, vh = to_bh(q), to_bh(k), to_bh(v)
+    doh, oh = to_bh(g), to_bh(out)
+    sk = kh.shape[1]
+    block_q = min(block_q, s)
+    block_k = min(block_k, sk)
+    nqb = s // block_q
+    nkb = sk // block_k
+    offset = sk - s
+
+    # delta_i = rowsum(dO_i * O_i) — the softmax-jacobian correction term;
+    # O(S) like the lse, computed once outside the kernels.
+    delta = jnp.sum(doh.astype(jnp.float32) * oh.astype(jnp.float32),
+                    axis=-1)                               # [bh, s]
+    # Lane-broadcast layout for per-row scalars (see _flash_fwd).
+    delta_l = jnp.broadcast_to(delta[:, :, None], (b * h, s, _LANES))
+    lse_l = jnp.broadcast_to(lse[:, :, None], (b * h, s, _LANES))
+
+    q_spec = pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0))
+    k_spec = pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0))
+    row_spec = pl.BlockSpec((1, block_q, _LANES),
+                            lambda bh, i, j: (bh, i, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, causal=causal, scale=scale,
+                          nkb=nkb, offset=offset),
+        out_shape=jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
+        grid=(b * h, nqb, nkb),
+        in_specs=[q_spec, k_spec, k_spec, q_spec, row_spec, row_spec],
+        out_specs=q_spec,
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(qh, kh, vh, doh, lse_l, delta_l)
+
+    # dk/dv: k-block outer, q-block innermost (sequential accumulation).
+    q_spec2 = pl.BlockSpec((1, block_q, d), lambda bh, j, i: (bh, i, 0))
+    k_spec2 = pl.BlockSpec((1, block_k, d), lambda bh, j, i: (bh, j, 0))
+    row_spec2 = pl.BlockSpec((1, block_q, _LANES),
+                             lambda bh, j, i: (bh, i, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkdv_kernel, causal=causal, scale=scale,
+                          nqb=nqb, offset=offset),
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h, sk, d), v.dtype),
+        ],
+        grid=(b * h, nkb, nqb),
+        in_specs=[q_spec2, k_spec2, k_spec2, q_spec2, row_spec2, row_spec2],
+        out_specs=[k_spec2, k_spec2],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qh, kh, vh, doh, lse_l, delta_l)
+
+    def from_bh(x, seq):
+        return x.reshape(b, h, seq, d).transpose(0, 2, 1, 3)
+
+    return from_bh(dq, s), from_bh(dk, sk), from_bh(dv, sk)
 
 
 def _reference(q, k, v, causal):
@@ -143,21 +322,25 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     first). ``interpret=None`` auto-selects interpreter mode off-TPU."""
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    return _flash_fwd(q, k, v, causal, block_q, block_k, interpret)
+    out, _ = _flash_fwd(q, k, v, causal, block_q, block_k, interpret)
+    return out
 
 
 def _fwd_rule(q, k, v, causal, block_q, block_k, interpret):
-    out = flash_attention(q, k, v, causal, block_q, block_k, interpret)
-    return out, (q, k, v)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    out, lse = _flash_fwd(q, k, v, causal, block_q, block_k, interpret)
+    return out, (q, k, v, out, lse)
 
 
 def _bwd_rule(causal, block_q, block_k, interpret, res, g):
-    # Flash-style recompute: no O(S^2) residuals; backward re-derives the
-    # attention matrix via the reference formulation under jax.vjp.
-    q, k, v = res
-    _, vjp = jax.vjp(lambda q_, k_, v_: _reference(q_, k_, v_, causal),
-                     q, k, v)
-    return vjp(g)
+    # Blockwise Pallas backward: recompute p tiles from (q, k, lse), no
+    # O(S^2) residuals or intermediates at any sequence length.
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    q, k, v, out, lse = res
+    return _flash_bwd(q, k, v, out, lse, g, causal, block_q, block_k,
+                      interpret)
 
 
 flash_attention.defvjp(_fwd_rule, _bwd_rule)
