@@ -2,6 +2,9 @@
 step gating, live lazy state, 400 on step mismatch —
 /root/reference/torchft/checkpointing.py)."""
 
+import io
+import subprocess
+import sys
 import threading
 import urllib.error
 import urllib.request
@@ -11,7 +14,13 @@ import numpy as np
 import pytest
 
 from torchft_tpu.checkpointing import CheckpointServer
-from torchft_tpu.serialization import load_pytree, save_pytree
+from torchft_tpu.serialization import (
+    iter_pytree_chunks,
+    load_pytree,
+    load_pytree_from,
+    plan_pytree,
+    save_pytree,
+)
 
 
 def tree_equal(a, b):
@@ -54,6 +63,108 @@ class TestSerialization:
     def test_bad_magic(self):
         with pytest.raises(ValueError, match="not a torchft_tpu"):
             load_pytree(b"garbage_bytes_here", {"a": np.ones(1)})
+
+    def test_truncated_stream_fails(self):
+        data = save_pytree({"a": np.ones(100, dtype=np.float64)})
+        with pytest.raises(ValueError, match="truncated"):
+            load_pytree(data[:-17], {"a": np.ones(100)})
+
+    def test_untrusted_header_rejected(self):
+        # The header comes from a peer: shape, dtype, and kind claims must
+        # all be validated against the target before any allocation, so a
+        # malicious/corrupt server can neither OOM the healer nor swap a
+        # weight tensor for a scalar.
+        import json
+
+        def forge(mutate):
+            data = bytearray(save_pytree({"w": np.ones(4, np.float32)}))
+            hdr_len = int.from_bytes(data[8:12], "little")
+            header = json.loads(bytes(data[12:12 + hdr_len]))
+            mutate(header["leaves"][0])
+            new_hdr = json.dumps(header).encode()
+            return (bytes(data[:8]) + len(new_hdr).to_bytes(4, "little")
+                    + new_hdr + bytes(data[12 + hdr_len:]))
+
+        target = {"w": np.ones(4, np.float32)}
+        with pytest.raises(ValueError, match="shape"):
+            load_pytree(forge(lambda e: e.update(shape=[10 ** 12])), target)
+        with pytest.raises(ValueError, match="dtype"):
+            load_pytree(forge(lambda e: e.update(dtype="complex128")), target)
+        with pytest.raises(ValueError, match="py value"):
+            load_pytree(
+                forge(lambda e: (e.clear(),
+                                 e.update(key="w", kind="py", value=0))),
+                target)
+        with pytest.raises(ValueError, match="implausibly large"):
+            from torchft_tpu.serialization import load_pytree_from
+            import io as _io
+            bad = b"TFTPTREE" + (0xFFFFFFFF).to_bytes(4, "little") + b"x"
+            load_pytree_from(_io.BytesIO(bad), target)
+
+
+class TestStreaming:
+    def test_chunks_concat_to_save_pytree(self):
+        tree = {
+            "w": jnp.arange(5000, dtype=jnp.float32).reshape(50, 100),
+            "b": jnp.ones((7,), dtype=jnp.bfloat16),
+            "step": 9,
+        }
+        chunks = list(iter_pytree_chunks(tree, chunk_bytes=1024))
+        assert len(chunks) > 5  # the big leaf really was split
+        data = b"".join(chunks)
+        _, total_len, _ = plan_pytree(tree)
+        assert len(data) == total_len  # Content-Length promise holds
+        restored = load_pytree_from(io.BytesIO(data), tree)
+        tree_equal(restored, tree)
+        assert restored["step"] == 9
+
+    def test_plan_fetches_no_data(self):
+        # plan_pytree must be metadata-only: an aval-backed tracer-free
+        # shape/dtype is enough. A jax array never leaves the device here.
+        tree = {"x": jnp.zeros((128, 128), dtype=jnp.bfloat16), "tag": "t"}
+        preamble, total_len, leaves = plan_pytree(tree)
+        assert total_len == len(preamble) + 128 * 128 * 2
+        assert len(leaves) == 1
+
+    def test_transfer_rss_bounded(self):
+        """Healing-path RAM ceiling: serving + fetching a checkpoint must
+        not buffer the full payload on either side (verdict #5). Runs in a
+        subprocess so the RSS high-water mark is clean, with the server and
+        the healer sharing the process: extra peak RSS over (state +
+        restored copy) must be a few leaves, not another full copy."""
+        total_mb = 256
+        script = f"""
+import resource, sys, numpy as np
+from torchft_tpu.checkpointing import CheckpointServer
+
+RSS_UNIT = 1 if sys.platform == "darwin" else 1024  # macOS: bytes, linux: KB
+
+LEAF = 8 * 1024 * 1024  # 8MB float32 leaves
+N = {total_mb} * 1024 * 1024 // (LEAF)
+state = {{f"w{{i}}": np.random.rand(LEAF // 8).astype(np.float64)
+         for i in range(N)}}
+total = sum(a.nbytes for a in state.values())
+base = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * RSS_UNIT
+server = CheckpointServer(lambda: state)
+server.allow_checkpoint(1)
+restored = CheckpointServer.load_from_address(
+    server.address(), state, device_put=False)
+server.shutdown()
+peak = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * RSS_UNIT
+delta = peak - base
+# restored copy is 1.0x total; allow 0.5x slack for chunk buffers and
+# allocator noise. A monolithic bytes round-trip needs >= 2.0x.
+assert delta < 1.5 * total, (
+    f"transfer peak RSS {{delta/1e6:.0f}}MB exceeds "
+    f"{{1.5 * total / 1e6:.0f}}MB ceiling for a {{total/1e6:.0f}}MB state")
+for k, v in state.items():
+    np.testing.assert_array_equal(restored[k], v)
+print(f"rss delta {{delta/1e6:.0f}}MB for {{total/1e6:.0f}}MB state")
+"""
+        proc = subprocess.run(
+            [sys.executable, "-c", script], capture_output=True, text=True,
+            timeout=300)
+        assert proc.returncode == 0, proc.stderr + proc.stdout
 
 
 class TestCheckpointServer:

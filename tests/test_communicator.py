@@ -248,3 +248,71 @@ class TestHostCommunicator:
         with pytest.raises(CommunicatorError):
             results[0].result(timeout=30)
         comms[0].shutdown()
+
+
+class _StubManager:
+    """Just enough Manager surface for ManagedCommunicator (the real
+    contract: errors feed report_error, size() is num_participants —
+    reference ManagedProcessGroup, process_group.py:443-468)."""
+
+    def __init__(self, comm, participants=3):
+        self._comm = comm
+        self._participants = participants
+        self._error = None
+
+    def report_error(self, e):
+        self._error = e
+
+    def errored(self):
+        return self._error
+
+    def num_participants(self):
+        return self._participants
+
+
+class TestManagedCommunicator:
+    def make(self, sync_raise=None, participants=3):
+        from torchft_tpu.communicator import ManagedCommunicator
+
+        comm = (DummyCommunicator(rank=1, world_size=5)
+                if sync_raise is None else _FailingComm(sync_raise))
+        mgr = _StubManager(comm, participants)
+        return ManagedCommunicator(mgr), mgr, comm
+
+    def test_size_is_num_participants_not_world(self):
+        mc, mgr, comm = self.make(participants=2)
+        # the underlying world is 5, but 1/n normalization must track the
+        # quorum's participant count
+        assert comm.size() == 5
+        assert mc.size() == 2
+        mgr._participants = 4
+        assert mc.size() == 4
+        assert mc.rank() == 1
+
+    def test_happy_path_delegates(self):
+        mc, mgr, comm = self.make()
+        tree = {"g": np.ones(3)}
+        assert mc.allreduce(tree).result() is tree
+        assert mc.broadcast(tree).result() is tree
+        assert mc.allgather(tree).result() == [tree] * 5
+        assert mgr.errored() is None
+        assert comm.allreduce_count == 1
+
+    @pytest.mark.parametrize("sync_raise", [True, False])
+    def test_error_reported_to_manager_vote(self, sync_raise):
+        mc, mgr, _ = self.make(sync_raise=sync_raise)
+        tree = {"g": np.ones(3)}
+        out = mc.allreduce(tree).result(timeout=5)
+        # error never propagates to the caller: the input is returned so
+        # every rank keeps an identical step structure...
+        assert out is tree
+        # ...and the failure reaches the manager, which will vote False
+        assert isinstance(mgr.errored(), CommunicatorError)
+
+    def test_skips_collectives_once_errored(self):
+        mc, mgr, comm = self.make()
+        mgr.report_error(CommunicatorError("prior failure"))
+        tree = {"g": np.ones(3)}
+        assert mc.allreduce(tree).result() is tree
+        assert comm.allreduce_count == 0  # underlying comm never touched
+        assert mc.allgather(tree).result() == [tree] * mc.size()

@@ -24,22 +24,30 @@ import os
 import tempfile
 from typing import Any, Optional, Tuple
 
-from torchft_tpu.serialization import device_put_like, load_pytree, save_pytree
+from torchft_tpu.serialization import (
+    device_put_like,
+    iter_pytree_chunks,
+    load_pytree_from,
+)
 
 
 def save(path: str, user_state: Any, manager_state: Optional[dict] = None,
          ) -> None:
-    """Atomically write ``{user, torchft}`` to ``path``."""
-    payload = save_pytree({
+    """Atomically write ``{user, torchft}`` to ``path``, streaming one leaf
+    at a time (no full in-memory copy of the checkpoint)."""
+    # Default matches load()'s torchft target so a checkpoint saved without
+    # a manager state still round-trips.
+    tree = {
         "user": user_state,
-        "torchft": manager_state or {},
-    })
+        "torchft": manager_state or {"step": 0, "batches_committed": 0},
+    }
     d = os.path.dirname(os.path.abspath(path))
     os.makedirs(d, exist_ok=True)
     fd, tmp = tempfile.mkstemp(dir=d, prefix=".ckpt_tmp_")
     try:
         with os.fdopen(fd, "wb") as f:
-            f.write(payload)
+            for chunk in iter_pytree_chunks(tree):
+                f.write(chunk)
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, path)  # atomic on POSIX
@@ -56,12 +64,11 @@ def load(path: str, target: Any, device_put: bool = True,
     """Read a checkpoint back into ``target``'s structure (and shardings
     when ``device_put``). Returns ``(user_state, manager_state)``."""
     with open(path, "rb") as f:
-        data = f.read()
-    tree = load_pytree(
-        data,
-        {"user": target, "torchft": {"step": 0, "batches_committed": 0}},
-        device_put_fn=device_put_like if device_put else None,
-    )
+        tree = load_pytree_from(
+            f,
+            {"user": target, "torchft": {"step": 0, "batches_committed": 0}},
+            device_put_fn=device_put_like if device_put else None,
+        )
     return tree["user"], tree["torchft"]
 
 

@@ -23,6 +23,7 @@ from __future__ import annotations
 import logging
 import socket
 import threading
+import time
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable, Optional, TypeVar
@@ -30,8 +31,9 @@ from typing import Any, Callable, Optional, TypeVar
 from torchft_tpu.utils import advertise_host
 from torchft_tpu.serialization import (
     device_put_like,
-    load_pytree,
-    save_pytree,
+    iter_pytree_chunks,
+    load_pytree_from,
+    plan_pytree,
 )
 
 T = TypeVar("T")
@@ -52,10 +54,19 @@ class CheckpointServer:
     Args:
         state_fn: zero-arg callable returning the current state pytree. Called
             lazily inside the GET handler, under the serve lock.
+        send_timeout_sec: per-socket-write timeout while streaming. The
+            stream runs under the serve lock (load-bearing: commit may
+            invalidate donated buffers, so ``disallow_checkpoint`` must wait
+            for in-flight serves — same discipline as the reference,
+            /root/reference/torchft/checkpointing.py:50-72); the timeout
+            bounds how long a *hung* healer can hold that lock and block
+            training. A slow-but-alive healer keeps streaming.
     """
 
-    def __init__(self, state_fn: Callable[[], T]) -> None:
+    def __init__(self, state_fn: Callable[[], T],
+                 send_timeout_sec: float = 120.0) -> None:
         self._state_fn = state_fn
+        self._send_timeout_sec = send_timeout_sec
         # The serve gate: held (locked) whenever serving is disallowed.
         # Acquired/released across threads, which plain Lock permits — same
         # discipline as the reference (checkpointing.py:123-144).
@@ -87,8 +98,14 @@ class CheckpointServer:
                             f"invalid checkpoint requested: serving {step} "
                             f"but got {req_step}")
                         return
+                    # Stream leaf-by-leaf: total length is known from
+                    # metadata before any device data is fetched, so the
+                    # response carries Content-Length yet never holds more
+                    # than one leaf + one chunk in host RAM. Socket-write
+                    # backpressure paces the device_get fetches.
                     try:
-                        data = save_pytree(ckpt_server._state_fn())
+                        state = ckpt_server._state_fn()
+                        plan = plan_pytree(state)
                     except Exception as e:  # surface to healer, keep serving
                         logger.exception("checkpoint state_fn failed")
                         self.send_error(500, str(e))
@@ -96,9 +113,25 @@ class CheckpointServer:
                     self.send_response(200)
                     self.send_header("Content-Type",
                                      "application/octet-stream")
-                    self.send_header("Content-Length", str(len(data)))
+                    self.send_header("Content-Length", str(plan[1]))
                     self.end_headers()
-                    self.wfile.write(data)
+                    # Stream the SAME plan the Content-Length came from.
+                    # 200 is already committed: a device_get failure
+                    # mid-stream can only short-close the socket (healer
+                    # sees "truncated"), so log the real cause here. The
+                    # send timeout bounds the serve-lock hold against a
+                    # hung healer; socket.timeout aborts this serve and
+                    # releases the lock for commit/other healers.
+                    self.connection.settimeout(
+                        ckpt_server._send_timeout_sec)
+                    try:
+                        for chunk in iter_pytree_chunks(state, plan=plan):
+                            self.wfile.write(chunk)
+                    except Exception:
+                        logger.exception(
+                            "checkpoint stream failed mid-transfer "
+                            "(healer will see a truncated stream)")
+                        raise
 
         self._server = _CheckpointHTTPServer(("0.0.0.0", 0), Handler)
         self._thread = threading.Thread(
@@ -135,10 +168,17 @@ class CheckpointServer:
                           timeout_sec: float = 300.0,
                           device_put: bool = True) -> T:
         """Fetch a peer's live checkpoint and restore it into ``target``'s
-        structure (and shardings, when ``device_put``)."""
+        structure (and shardings, when ``device_put``). Streams: each leaf
+        is read off the socket into a preallocated buffer and device_put
+        before the next is read — healing never buffers the full payload."""
         logger.info("fetching checkpoint from %s", address)
+        t0 = time.perf_counter()
         with urllib.request.urlopen(address, timeout=timeout_sec) as resp:
-            data = resp.read()
-        return load_pytree(
-            data, target,
-            device_put_fn=device_put_like if device_put else None)
+            nbytes = int(resp.headers.get("Content-Length", 0))
+            out = load_pytree_from(
+                resp, target,
+                device_put_fn=device_put_like if device_put else None)
+        dt = time.perf_counter() - t0
+        logger.info("checkpoint transfer: %.1f MB in %.2fs (%.0f MB/s)",
+                    nbytes / 1e6, dt, nbytes / 1e6 / max(dt, 1e-9))
+        return out

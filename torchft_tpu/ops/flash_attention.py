@@ -144,6 +144,35 @@ def _flash_fwd(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     return out.reshape(b, h, s, d).transpose(0, 2, 1, 3), lse[:, :, 0]
 
 
+def _recompute_p_ds(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    qi, ki, causal: bool, scale: float, offset: int):
+    """Shared backward recompute: rebuild the probability tile from
+    (q, k, lse) under the same end-aligned causal mask as the forward and
+    form ds = p * (dp - delta). Used by both the dq and dk/dv kernels so
+    their masking/scaling can never desynchronize. Returns (p, ds, q, k,
+    do) as f32."""
+    bq = q_ref.shape[1]
+    bk = k_ref.shape[1]
+    q = q_ref[0].astype(jnp.float32)                  # [bq, d]
+    k = k_ref[0].astype(jnp.float32)                  # [bk, d]
+    v = v_ref[0].astype(jnp.float32)                  # [bk, d]
+    do = do_ref[0].astype(jnp.float32)                # [bq, d]
+    logits = jnp.dot(q, k.T,
+                     preferred_element_type=jnp.float32) * scale
+    if causal:
+        q_pos = offset + qi * bq + jax.lax.broadcasted_iota(
+            jnp.int32, (bq, bk), 0)
+        k_pos = ki * bk + jax.lax.broadcasted_iota(
+            jnp.int32, (bq, bk), 1)
+        logits = jnp.where(q_pos >= k_pos, logits, NEG_INF)
+    lse_row = jnp.max(lse_ref[0], axis=1, keepdims=True)
+    p = jnp.exp(logits - lse_row)                     # exact softmax
+    dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+    delta_row = jnp.max(delta_ref[0], axis=1, keepdims=True)
+    ds = p * (dp - delta_row)
+    return p, ds, q, k, do
+
+
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
                    acc_ref, *, causal: bool, scale: float, nkb: int,
                    offset: int):
@@ -161,23 +190,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
     @pl.when(diag_ok)
     def _compute():
-        q = q_ref[0].astype(jnp.float32)                  # [bq, d]
-        k = k_ref[0].astype(jnp.float32)                  # [bk, d]
-        v = v_ref[0].astype(jnp.float32)                  # [bk, d]
-        do = do_ref[0].astype(jnp.float32)                # [bq, d]
-        logits = jnp.dot(q, k.T,
-                         preferred_element_type=jnp.float32) * scale
-        if causal:
-            q_pos = offset + qi * bq + jax.lax.broadcasted_iota(
-                jnp.int32, (bq, bk), 0)
-            k_pos = ki * bk + jax.lax.broadcasted_iota(
-                jnp.int32, (bq, bk), 1)
-            logits = jnp.where(q_pos >= k_pos, logits, NEG_INF)
-        lse_row = jnp.max(lse_ref[0], axis=1, keepdims=True)
-        p = jnp.exp(logits - lse_row)                     # exact softmax
-        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
-        delta_row = jnp.max(delta_ref[0], axis=1, keepdims=True)
-        ds = p * (dp - delta_row)
+        _, ds, _, k, _ = _recompute_p_ds(
+            q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+            qi, ki, causal, scale, offset)
         acc_ref[:] += jnp.dot(ds, k,
                               preferred_element_type=jnp.float32) * scale
 
@@ -204,25 +219,11 @@ def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(diag_ok)
     def _compute():
-        q = q_ref[0].astype(jnp.float32)                  # [bq, d]
-        k = k_ref[0].astype(jnp.float32)                  # [bk, d]
-        v = v_ref[0].astype(jnp.float32)                  # [bk, d]
-        do = do_ref[0].astype(jnp.float32)                # [bq, d]
-        logits = jnp.dot(q, k.T,
-                         preferred_element_type=jnp.float32) * scale
-        if causal:
-            q_pos = offset + qi * bq + jax.lax.broadcasted_iota(
-                jnp.int32, (bq, bk), 0)
-            k_pos = ki * bk + jax.lax.broadcasted_iota(
-                jnp.int32, (bq, bk), 1)
-            logits = jnp.where(q_pos >= k_pos, logits, NEG_INF)
-        lse_row = jnp.max(lse_ref[0], axis=1, keepdims=True)
-        p = jnp.exp(logits - lse_row)                     # [bq, bk]
+        p, ds, q, _, do = _recompute_p_ds(
+            q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+            qi, ki, causal, scale, offset)
         dv_acc[:] += jnp.dot(p.T, do,
                              preferred_element_type=jnp.float32)
-        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
-        delta_row = jnp.max(delta_ref[0], axis=1, keepdims=True)
-        ds = p * (dp - delta_row)
         dk_acc[:] += jnp.dot(ds.T, q,
                              preferred_element_type=jnp.float32) * scale
 

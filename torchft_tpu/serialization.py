@@ -13,20 +13,30 @@ The header carries the flattened key paths, dtypes, and shapes; leaves are
 healing move: weights arrive over DCN on the host and are laid out directly
 onto the receiving slice's mesh.
 
+Both directions stream: the header is computed from array *metadata* (no
+data fetched), then :func:`iter_pytree_chunks` materializes one leaf at a
+time and yields zero-copy memoryview slices, and :func:`load_pytree_from`
+fills preallocated buffers leaf-by-leaf with per-leaf ``device_put``. Peak
+extra host RAM on either side is O(largest leaf + chunk), not O(checkpoint)
+— healing a config-3-sized model (80GB+ params+opt) cannot double host RAM
+the way a monolithic ``bytes`` round-trip would (the reference streams via
+``torch.save`` directly to the socket for the same reason,
+/root/reference/torchft/checkpointing.py:63-72).
+
 No pickle anywhere — unlike ``torch.load``, a malicious checkpoint peer
 cannot execute code on the healer.
 """
 
 from __future__ import annotations
 
-import io
 import json
-from typing import Any, Callable, Optional
+from typing import Any, BinaryIO, Callable, Iterator, Optional, Tuple
 
 import jax
 import numpy as np
 
 _MAGIC = b"TFTPTREE"
+DEFAULT_CHUNK_BYTES = 8 * 1024 * 1024
 
 
 def _dtype_name(dt: np.dtype) -> str:
@@ -63,47 +73,164 @@ def _key_str(path) -> str:
     return "/".join(parts)
 
 
-def save_pytree(tree: Any) -> bytes:
-    """Serialize a pytree of arrays/scalars to bytes."""
+def _is_array_leaf(leaf: Any) -> bool:
+    return isinstance(leaf, (np.ndarray, np.generic, jax.Array))
+
+
+def plan_pytree(tree: Any) -> Tuple[bytes, int, list]:
+    """Compute the serialized header from leaf *metadata* only — no device
+    data is fetched. Returns ``(preamble_bytes, total_len, array_leaves)``
+    where ``preamble_bytes`` is magic+header, ``total_len`` the full
+    serialized size (so HTTP can send Content-Length before streaming), and
+    ``array_leaves`` the leaves whose raw bytes follow, in body order."""
     leaves_with_path = jax.tree_util.tree_flatten_with_path(tree)[0]
     header: dict = {"leaves": []}
-    arrays: list[np.ndarray] = []
+    array_leaves: list = []
     offset = 0
-    # Materialize device arrays on host in one batched transfer.
-    fetched = jax.device_get([leaf for _, leaf in leaves_with_path])
-    for (path, _), leaf in zip(leaves_with_path, fetched):
+    for path, leaf in leaves_with_path:
         key = _key_str(path)
-        if isinstance(leaf, (np.ndarray, np.generic)):
-            arr = np.ascontiguousarray(leaf)
+        if _is_array_leaf(leaf):
+            dt = np.dtype(leaf.dtype)
+            shape = list(leaf.shape)
+            nbytes = int(np.prod(shape, dtype=np.int64)) * dt.itemsize
             header["leaves"].append({
                 "key": key,
                 "kind": "array",
-                "dtype": _dtype_name(arr.dtype),
-                "shape": list(arr.shape),
+                "dtype": _dtype_name(dt),
+                "shape": shape,
                 "offset": offset,
-                "nbytes": int(arr.nbytes),
+                "nbytes": nbytes,
             })
-            arrays.append(arr)
-            offset += arr.nbytes
+            array_leaves.append(leaf)
+            offset += nbytes
         else:
             header["leaves"].append({"key": key, "kind": "py", "value": leaf})
     hdr = json.dumps(header).encode()
-    out = io.BytesIO()
-    out.write(_MAGIC)
-    out.write(len(hdr).to_bytes(4, "little"))
-    out.write(hdr)
-    for arr in arrays:
-        out.write(arr.tobytes())
-    return out.getvalue()
+    preamble = _MAGIC + len(hdr).to_bytes(4, "little") + hdr
+    return preamble, len(preamble) + offset, array_leaves
 
 
-def load_pytree(
-    data: bytes,
+DEFAULT_BATCH_BYTES = 64 * 1024 * 1024
+
+
+def iter_pytree_chunks(tree: Any,
+                       chunk_bytes: int = DEFAULT_CHUNK_BYTES,
+                       plan: Optional[Tuple[bytes, int, list]] = None,
+                       batch_bytes: int = DEFAULT_BATCH_BYTES,
+                       ) -> Iterator[memoryview]:
+    """Stream-serialize: yields the preamble, then the array leaves' raw
+    bytes in ``chunk_bytes`` slices. Leaves are host-materialized in
+    batched ``jax.device_get`` groups of up to ``batch_bytes`` (a pytree
+    with thousands of small optimizer-state leaves pays a handful of
+    dispatch round-trips, not thousands), so peak extra host RAM is
+    O(batch), not O(checkpoint). Slices are zero-copy memoryviews.
+    ``plan`` reuses a precomputed :func:`plan_pytree` result (the HTTP
+    server plans once for Content-Length and must stream that same plan)."""
+    preamble, _, array_leaves = plan if plan is not None else plan_pytree(tree)
+    yield memoryview(preamble)
+    group: list = []
+    group_bytes = 0
+
+    def flush():
+        fetched = jax.device_get(group)
+        for arr in fetched:
+            arr = np.ascontiguousarray(arr)
+            mv = arr.reshape(-1).view(np.uint8).data
+            for i in range(0, len(mv) or 1, chunk_bytes):
+                yield mv[i:i + chunk_bytes]
+
+    for leaf in array_leaves:
+        nbytes = int(np.prod(leaf.shape, dtype=np.int64)
+                     ) * np.dtype(leaf.dtype).itemsize
+        if group and group_bytes + nbytes > batch_bytes:
+            yield from flush()
+            group, group_bytes = [], 0
+        group.append(leaf)
+        group_bytes += nbytes
+    if group:
+        yield from flush()
+
+
+def save_pytree(tree: Any) -> bytes:
+    """Serialize a pytree of arrays/scalars to one buffer. Device fetches
+    are batched (see :func:`iter_pytree_chunks`), so the per-step host
+    collective path (``backends/host.py``) pays one dispatch round-trip
+    per ~64MB, not per leaf. For O(batch) RAM streaming to a socket/file,
+    use :func:`iter_pytree_chunks` directly."""
+    return b"".join(iter_pytree_chunks(tree))
+
+
+def _read_exact_into(fp: BinaryIO, mv: memoryview) -> None:
+    got = 0
+    while got < len(mv):
+        if hasattr(fp, "readinto"):
+            n = fp.readinto(mv[got:])
+        else:  # file-likes without readinto (e.g. raw HTTPResponse wrappers)
+            chunk = fp.read(len(mv) - got)
+            n = len(chunk)
+            mv[got:got + n] = chunk
+        if not n:
+            raise ValueError("truncated checkpoint stream")
+        got += n
+
+
+def _read_exact(fp: BinaryIO, n: int) -> bytes:
+    buf = bytearray(n)
+    _read_exact_into(fp, memoryview(buf))
+    return bytes(buf)
+
+
+def _match_entries(header: dict, target: Any):
+    """Validate checkpoint entries against the flattened target: positional
+    + name cross-check, array entries must meet an array target with equal
+    shape AND dtype, py entries must meet a non-array target. The header is
+    untrusted (a malicious/corrupt peer), so this is what bounds allocations
+    to target size and guarantees a structural mismatch fails loudly instead
+    of silently permuting or substituting weights. Returns
+    ``(pairs, treedef)``."""
+    tpaths, treedef = jax.tree_util.tree_flatten_with_path(target)
+    entries = header["leaves"]
+    if len(entries) != len(tpaths):
+        raise ValueError(
+            f"checkpoint has {len(entries)} leaves, target has {len(tpaths)}")
+    pairs = []
+    for entry, (path, tleaf) in zip(entries, tpaths):
+        key = _key_str(path)
+        if entry["key"] != key:
+            raise ValueError(
+                f"checkpoint leaf {entry['key']!r} does not match target "
+                f"leaf {key!r}")
+        if entry["kind"] == "array":
+            if not _is_array_leaf(tleaf):
+                raise ValueError(
+                    f"checkpoint leaf {key!r} is an array but the target "
+                    f"leaf is not")
+            if tuple(entry["shape"]) != tuple(tleaf.shape):
+                raise ValueError(
+                    f"checkpoint leaf {key!r} has shape "
+                    f"{tuple(entry['shape'])}, target expects "
+                    f"{tuple(tleaf.shape)}")
+            if _resolve_dtype(entry["dtype"]) != np.dtype(tleaf.dtype):
+                raise ValueError(
+                    f"checkpoint leaf {key!r} has dtype {entry['dtype']}, "
+                    f"target expects {np.dtype(tleaf.dtype).name}")
+        elif _is_array_leaf(tleaf):
+            raise ValueError(
+                f"checkpoint leaf {key!r} is a py value but the target "
+                f"leaf is an array")
+        pairs.append((entry, tleaf))
+    return pairs, treedef
+
+
+def load_pytree_from(
+    fp: BinaryIO,
     target: Any,
     device_put_fn: Optional[Callable[[np.ndarray, Any], Any]] = None,
 ) -> Any:
-    """Restore a pytree serialized by :func:`save_pytree` into the structure
-    of ``target``.
+    """Restore a pytree from a binary stream into the structure of
+    ``target``, incrementally: each array leaf is read into a preallocated
+    buffer and handed to ``device_put_fn`` before the next leaf is read, so
+    peak extra host RAM is one leaf, not the whole checkpoint.
 
     ``target`` supplies the tree structure (and, when ``device_put_fn`` is
     given, per-leaf placement: it is called as ``device_put_fn(np_array,
@@ -112,31 +239,70 @@ def load_pytree(
     cross-checked by name, so a structural mismatch fails loudly instead of
     silently permuting weights.
     """
-    if data[: len(_MAGIC)] != _MAGIC:
+    try:
+        magic = _read_exact(fp, len(_MAGIC))
+    except ValueError:
         raise ValueError("not a torchft_tpu pytree checkpoint")
-    hdr_len = int.from_bytes(data[len(_MAGIC) : len(_MAGIC) + 4], "little")
-    body_start = len(_MAGIC) + 4 + hdr_len
-    header = json.loads(data[len(_MAGIC) + 4 : body_start])
+    if magic != _MAGIC:
+        raise ValueError("not a torchft_tpu pytree checkpoint")
+    hdr_len = int.from_bytes(_read_exact(fp, 4), "little")
+    # Untrusted length: cap before allocating (headers are ~100B of JSON
+    # per leaf; 256MiB covers millions of leaves, while 0xFFFFFFFF from a
+    # corrupt peer would otherwise allocate 4GiB up front).
+    if hdr_len > 256 * 1024 * 1024:
+        raise ValueError(f"checkpoint header implausibly large ({hdr_len}B)")
+    header = json.loads(_read_exact(fp, hdr_len))
 
-    tpaths, treedef = jax.tree_util.tree_flatten_with_path(target)
-    entries = header["leaves"]
-    if len(entries) != len(tpaths):
-        raise ValueError(
-            f"checkpoint has {len(entries)} leaves, target has {len(tpaths)}")
+    pairs, treedef = _match_entries(header, target)
     out_leaves = []
-    for entry, (path, tleaf) in zip(entries, tpaths):
-        key = _key_str(path)
-        if entry["key"] != key:
-            raise ValueError(
-                f"checkpoint leaf {entry['key']!r} does not match target "
-                f"leaf {key!r}")
+    for entry, tleaf in pairs:
         if entry["kind"] == "py":
             out_leaves.append(entry["value"])
             continue
+        # Shape/dtype already validated against the target by
+        # _match_entries, so this allocation is exactly target-leaf-sized.
+        arr = np.empty(entry["shape"], dtype=_resolve_dtype(entry["dtype"]))
+        _read_exact_into(fp, arr.reshape(-1).view(np.uint8).data)
+        if device_put_fn is not None:
+            # device_put immediately: jax owns the transfer, the host buffer
+            # is released as soon as the copy lands, and the next leaf's
+            # read overlaps this leaf's host->device DMA.
+            out_leaves.append(device_put_fn(arr, tleaf))
+        else:
+            out_leaves.append(arr)
+    return jax.tree_util.tree_unflatten(treedef, out_leaves)
+
+
+def load_pytree(
+    data: Any,
+    target: Any,
+    device_put_fn: Optional[Callable[[np.ndarray, Any], Any]] = None,
+) -> Any:
+    """Restore from an in-memory buffer (bytes/bytearray/memoryview),
+    zero-copy: without ``device_put_fn``, returned arrays are
+    ``np.frombuffer`` views onto ``data`` — this is the per-step host
+    collective path (``backends/host.py`` hands in the received bytearray).
+    For incremental restore from a socket/file use :func:`load_pytree_from`.
+    """
+    if len(data) < len(_MAGIC) or bytes(data[:len(_MAGIC)]) != _MAGIC:
+        raise ValueError("not a torchft_tpu pytree checkpoint")
+    hdr_len = int.from_bytes(data[len(_MAGIC):len(_MAGIC) + 4], "little")
+    body_start = len(_MAGIC) + 4 + hdr_len
+    if len(data) < body_start:
+        raise ValueError("truncated checkpoint stream")
+    header = json.loads(bytes(data[len(_MAGIC) + 4:body_start]))
+
+    pairs, treedef = _match_entries(header, target)
+    out_leaves = []
+    for entry, tleaf in pairs:
+        if entry["kind"] == "py":
+            out_leaves.append(entry["value"])
+            continue
+        count = int(np.prod(entry["shape"], dtype=np.int64))
+        if body_start + entry["offset"] + entry["nbytes"] > len(data):
+            raise ValueError("truncated checkpoint stream")
         arr = np.frombuffer(
-            data, dtype=_resolve_dtype(entry["dtype"]),
-            count=int(np.prod(entry["shape"], dtype=np.int64)) if entry["shape"]
-            else 1,
+            data, dtype=_resolve_dtype(entry["dtype"]), count=count,
             offset=body_start + entry["offset"],
         ).reshape(entry["shape"])
         if device_put_fn is not None:
