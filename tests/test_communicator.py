@@ -316,3 +316,204 @@ class TestManagedCommunicator:
         assert mc.allreduce(tree).result() is tree
         assert comm.allreduce_count == 0  # underlying comm never touched
         assert mc.allgather(tree).result() == [tree] * mc.size()
+
+
+class TestMeshCommunicator:
+    """On-device full-membership fast path + host fallback
+    (backends/mesh.py)."""
+
+    def make_world(self, n, timeout=10):
+        from torchft_tpu.backends.mesh import MeshCommunicator, MeshWorld
+
+        world = MeshWorld(num_groups=n, timeout_sec=timeout)
+        return world, [MeshCommunicator(world, group_index=i)
+                       for i in range(n)]
+
+    def test_full_membership_allreduce_on_device(self):
+        import jax
+        import jax.numpy as jnp
+
+        world, comms = self.make_world(3)
+
+        def run(rank):
+            comms[rank].configure("store/q1", rank, 3)
+            assert comms[rank].mode() == "mesh"
+            assert comms[rank].wants_device_arrays
+            tree = {"g": jnp.full((4,), float(rank + 1)),
+                    "h": np.full((2, 2), rank, np.float32)}
+            return comms[rank].allreduce(tree).result(timeout=30)
+
+        for rank, out in enumerate(_run_ranks(3, run)):
+            np.testing.assert_allclose(np.asarray(out["g"]), np.full(4, 6.0))
+            np.testing.assert_allclose(np.asarray(out["h"]),
+                                       np.full((2, 2), 3.0))
+            # device-array inputs come back as device arrays
+            assert isinstance(out["g"], jax.Array)
+
+    def test_mean(self):
+        import jax.numpy as jnp
+
+        world, comms = self.make_world(2)
+
+        def run(rank):
+            comms[rank].configure("store/qm", rank, 2)
+            return comms[rank].allreduce(
+                {"g": jnp.full((3,), float(rank * 2))},
+                op="mean").result(timeout=30)
+
+        for out in _run_ranks(2, run):
+            np.testing.assert_allclose(np.asarray(out["g"]), np.full(3, 1.0))
+
+    def test_mean_bfloat16(self):
+        """bfloat16 is not np.inexact — the mean path must still divide,
+        not floor-divide sub-1.0 gradients to zero."""
+        import jax.numpy as jnp
+
+        world, comms = self.make_world(2)
+
+        def run(rank):
+            comms[rank].configure("store/qbf", rank, 2)
+            return comms[rank].allreduce(
+                {"g": jnp.full((4,), 0.25, jnp.bfloat16)},
+                op="mean").result(timeout=30)
+
+        for out in _run_ranks(2, run):
+            assert out["g"].dtype == jnp.bfloat16
+            np.testing.assert_allclose(
+                np.asarray(out["g"], np.float32), np.full(4, 0.25))
+
+    def test_wrappers_forward_wants_device_arrays(self):
+        from torchft_tpu.backends.mesh import MeshCommunicator, MeshWorld
+        from torchft_tpu.communicator import ErrorSwallowingCommunicator
+
+        mesh = MeshCommunicator(MeshWorld(num_groups=1))
+        mesh.configure("store/qw", 0, 1)
+        assert mesh.wants_device_arrays
+        assert ErrorSwallowingCommunicator(mesh).wants_device_arrays
+        assert not ErrorSwallowingCommunicator(
+            DummyCommunicator()).wants_device_arrays
+
+    def test_broadcast_and_allgather(self):
+        import jax.numpy as jnp
+
+        world, comms = self.make_world(2)
+
+        def run(rank):
+            comms[rank].configure("store/qb", rank, 2)
+            bc = comms[rank].broadcast(
+                {"w": jnp.full((2,), float(rank + 5))}, root=1
+            ).result(timeout=30)
+            ag = comms[rank].allgather({"r": np.int64(rank)}).result(
+                timeout=30)
+            return bc, ag
+
+        for rank, (bc, ag) in enumerate(_run_ranks(2, run)):
+            np.testing.assert_allclose(np.asarray(bc["w"]), np.full(2, 6.0))
+            assert [int(t["r"]) for t in ag] == [0, 1]
+
+    def test_sharded_leaves_keep_their_sharding(self):
+        """Each group's gradient lives on its own sub-mesh; the reduced
+        result must come back on that same sharding (on real multi-slice
+        hardware XLA owns the transfers — here we assert placement)."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        devs = jax.devices()
+        assert len(devs) >= 8
+        group_meshes = [Mesh(np.array(devs[:4]), ("dp",)),
+                        Mesh(np.array(devs[4:8]), ("dp",))]
+        world, comms = self.make_world(2)
+
+        def run(rank):
+            comms[rank].configure("store/qs", rank, 2)
+            sh = NamedSharding(group_meshes[rank], P("dp"))
+            g = jax.device_put(jnp.full((8, 4), float(rank + 1)), sh)
+            out = comms[rank].allreduce({"g": g}).result(timeout=30)
+            return out, sh
+
+        for rank, (out, sh) in enumerate(_run_ranks(2, run)):
+            np.testing.assert_allclose(np.asarray(out["g"]),
+                                       np.full((8, 4), 3.0))
+            assert out["g"].sharding == sh
+
+    def test_partial_membership_uses_host_fallback(self, store):
+        from torchft_tpu.backends.mesh import MeshCommunicator, MeshWorld
+
+        world = MeshWorld(num_groups=3, timeout_sec=10)
+        comms = [MeshCommunicator(world, group_index=i) for i in range(2)]
+        addr = store.address()
+
+        def run(rank):
+            # 2 of 3 static groups alive: must leave the device
+            comms[rank].configure(f"{addr}/fb", rank, 2)
+            assert comms[rank].mode() == "host"
+            assert not comms[rank].wants_device_arrays
+            return comms[rank].allreduce(
+                {"g": np.full(4, float(rank + 1), np.float32)}
+            ).result(timeout=30)
+
+        for out in _run_ranks(2, run):
+            np.testing.assert_allclose(out["g"], np.full(4, 3.0))
+        for c in comms:
+            c.shutdown()
+
+    def test_peer_never_arrives_times_out(self):
+        world, comms = self.make_world(2, timeout=0.5)
+        comms[0].configure("store/qt", 0, 2)
+        fut = comms[0].allreduce({"g": np.ones(2)})
+        with pytest.raises(CommunicatorError, match="timed out"):
+            fut.result(timeout=10)
+
+    def test_stale_epoch_cannot_crosstalk(self):
+        """A straggler keyed on an old quorum prefix can never meet a new
+        quorum's rendezvous — it expires instead of corrupting the sum."""
+        world, comms = self.make_world(2, timeout=0.5)
+        comms[0].configure("store/old", 0, 2)
+        stale = comms[0].allreduce({"g": np.full(2, 100.0)})
+
+        comms[0].configure("store/new", 0, 2)
+        comms[1].configure("store/new", 1, 2)
+
+        def run(rank):
+            return comms[rank].allreduce(
+                {"g": np.full(2, float(rank + 1))}).result(timeout=30)
+
+        outs = []
+        def go(r):
+            outs.append((r, run(r)))
+        ts = [threading.Thread(target=go, args=(r,)) for r in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=30)
+        for _, out in outs:
+            np.testing.assert_allclose(out["g"], np.full(2, 3.0))
+        with pytest.raises(CommunicatorError):
+            stale.result(timeout=10)
+
+    def test_peer_shutdown_aborts_pending_immediately(self):
+        """Mesh analogue of abort-by-socket-close: a peer's shutdown must
+        fail in-flight rendezvous NOW, not after the timeout — otherwise
+        a survivor sits out the lighthouse for the whole timeout and a
+        rejoining peer cuts a solo quorum (split progress)."""
+        import time as _time
+
+        world, comms = self.make_world(2, timeout=60)
+        for r in range(2):
+            comms[r].configure("store/qd", r, 2)
+        fut = comms[0].allreduce({"g": np.ones(2)})
+        t0 = _time.monotonic()
+        comms[1].shutdown()
+        with pytest.raises(CommunicatorError, match="shut down"):
+            fut.result(timeout=30)
+        assert _time.monotonic() - t0 < 5  # way under the 60s timer
+
+    def test_reconfigure_aborts_old_prefix_pending(self):
+        world, comms = self.make_world(2, timeout=60)
+        for r in range(2):
+            comms[r].configure("store/q1", r, 2)
+        fut = comms[0].allreduce({"g": np.ones(2)})
+        comms[1].configure("store/q2", 0, 1)  # peer moves to a new quorum
+        with pytest.raises(CommunicatorError, match="reconfigured away"):
+            fut.result(timeout=30)

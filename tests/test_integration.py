@@ -7,6 +7,7 @@ the oracle is bitwise equality of final parameter pytrees across groups.
 """
 
 import threading
+import time
 from concurrent.futures import ThreadPoolExecutor
 
 import jax
@@ -61,6 +62,7 @@ def run_group(
     injector: FailureInjector,
     min_replica_size: int = 1,
     attempts: int = 3,
+    comm_factory=None,
 ):
     """One replica group's training job, restarted on injected crashes
     (reference worker_manager retry, manager_integ_test.py:50-68)."""
@@ -72,6 +74,9 @@ def run_group(
         return optax.softmax_cross_entropy_with_integer_labels(
             logits, batch["y"]).mean()
 
+    if comm_factory is None:
+        comm_factory = lambda: HostCommunicator(timeout_sec=15)  # noqa: E731
+
     last_exc = None
     for attempt in range(attempts):
         params = model.init(jax.random.key(42), jnp.zeros((1, 8)))
@@ -80,7 +85,7 @@ def run_group(
             tx=optax.sgd(0.05),
             params=params,
             manager_factory=lambda load, save: Manager(
-                comm=HostCommunicator(timeout_sec=15),
+                comm=comm_factory(),
                 load_state_dict=load,
                 state_dict=save,
                 min_replica_size=min_replica_size,
@@ -158,6 +163,154 @@ class TestIntegration:
         jax.tree_util.tree_map(
             lambda a, b: np.testing.assert_array_equal(a, b),
             results[0]["params"], results[1]["params"])
+
+
+@pytest.mark.integration
+class TestMeshIntegration:
+    """Same oracles as TestIntegration but over the on-device
+    MeshCommunicator (backends/mesh.py): full membership rides the jitted
+    on-device sum, a death drops to the host fallback, and a rejoin
+    returns to the mesh path — the Gloo/NCCL-style duality, per quorum."""
+
+    def test_two_groups_converge_on_device(self):
+        from torchft_tpu import MeshCommunicator, MeshWorld
+
+        lh = Lighthouse(bind="127.0.0.1:0", min_replicas=2,
+                        join_timeout_ms=1000, quorum_tick_ms=50)
+        world = MeshWorld(num_groups=2, timeout_sec=30)
+        comms = []
+
+        def factory():
+            c = MeshCommunicator(world, group_index=len(comms))
+            comms.append(c)
+            return c
+
+        try:
+            with ThreadPoolExecutor(max_workers=2) as pool:
+                futs = [
+                    pool.submit(run_group, g, 2, lh.address(), 4,
+                                FailureInjector(), 2, 3, factory)
+                    for g in range(2)
+                ]
+                results = [f.result(timeout=120) for f in futs]
+        finally:
+            lh.shutdown()
+        assert results[0]["step"] == results[1]["step"] == 4
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_array_equal(a, b),
+            results[0]["params"], results[1]["params"])
+        # Full membership throughout: every communicator stayed on device.
+        assert all(c.mode() == "mesh" for c in comms)
+
+    def test_death_falls_back_then_returns_to_mesh(self):
+        """One group dies and stays down past the join timeout, so the
+        survivor's quorum shrinks below full membership (host fallback);
+        the restart rejoins, heals, and full membership restores the
+        on-device path. Coordination is deterministic: the victim sets the
+        shared stop step after its first post-recovery commit, and the
+        lockstep quorums carry both groups to that exact step."""
+        from torchft_tpu import MeshCommunicator, MeshWorld
+
+        lh = Lighthouse(bind="127.0.0.1:0", min_replicas=1,
+                        join_timeout_ms=300, quorum_tick_ms=50)
+        world = MeshWorld(num_groups=2, timeout_sec=30)
+        modes_seen = []
+        lock = threading.Lock()
+        stop_at: dict = {}
+
+        class RecordingMesh(MeshCommunicator):
+            def configure(self, store_addr, rank, world_size):
+                super().configure(store_addr, rank, world_size)
+                with lock:
+                    modes_seen.append(self.mode())
+
+        x, y = make_data()
+        model = MLP(features=(16,), num_classes=2)
+
+        def loss_fn(params, batch):
+            logits = model.apply(params, batch["x"])
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, batch["y"]).mean()
+
+        def make_trainer(gid):
+            params = model.init(jax.random.key(42), jnp.zeros((1, 8)))
+            return FTTrainer(
+                loss_fn=loss_fn, tx=optax.sgd(0.05), params=params,
+                manager_factory=lambda load, save: Manager(
+                    comm=RecordingMesh(world), load_state_dict=load,
+                    state_dict=save, min_replica_size=1, replica_id=gid,
+                    lighthouse_addr=lh.address(), rank=0, world_size=1,
+                    timeout_ms=15_000, quorum_timeout_ms=15_000,
+                ),
+            )
+
+        b = {"x": x[:16], "y": y[:16]}
+
+        deadline = time.monotonic() + 120  # bailout: hang -> failure, not wedge
+
+        def survivor():
+            trainer = make_trainer("mA")
+            try:
+                while ("step" not in stop_at
+                       or trainer.manager.current_step() < stop_at["step"]):
+                    if time.monotonic() > deadline:
+                        raise TimeoutError("victim never set the stop step")
+                    trainer.train_step(b)
+                return jax.device_get(trainer.params), \
+                    trainer.manager.current_step()
+            finally:
+                trainer.shutdown()
+
+        def victim():
+            try:
+                trainer = make_trainer("mB")
+                try:
+                    while trainer.manager.current_step() < 3:
+                        trainer.train_step(b)
+                finally:
+                    trainer.shutdown()  # death
+                time.sleep(1.5)  # stay dead past the join timeout
+                trainer = make_trainer("mB")  # slow restart, fresh member
+                try:
+                    # Recovery means the MERGED quorum: with min_replicas=1
+                    # the lighthouse may transiently cut a solo {mB} quorum
+                    # (straggler timeout races the survivor's call), which
+                    # always re-merges via fast quorum — so step until a
+                    # committed step saw both groups participating.
+                    while True:
+                        if time.monotonic() > deadline:
+                            raise TimeoutError("victim never recovered")
+                        _, committed = trainer.train_step(b)
+                        if committed and trainer.manager.num_participants() == 2:
+                            break
+                    # Recovered: both groups are now in lockstep — run a
+                    # few more joint steps and stop together.
+                    stop_at["step"] = trainer.manager.current_step() + 3
+                    while trainer.manager.current_step() < stop_at["step"]:
+                        trainer.train_step(b)
+                    return jax.device_get(trainer.params), \
+                        trainer.manager.current_step()
+                finally:
+                    trainer.shutdown()
+            except BaseException:
+                # Unblock the survivor before surfacing the failure.
+                stop_at.setdefault("step", -1)
+                raise
+
+        try:
+            with ThreadPoolExecutor(max_workers=2) as pool:
+                futs = [pool.submit(survivor), pool.submit(victim)]
+                (p0, s0), (p1, s1) = [f.result(timeout=180) for f in futs]
+        finally:
+            lh.shutdown()
+        assert s0 == s1 == stop_at["step"]
+        jax.tree_util.tree_map(
+            lambda a, b_: np.testing.assert_array_equal(a, b_), p0, p1)
+        # The death shrank the quorum below full membership (host mode);
+        # the rejoin restored it (mesh mode) — both transitions must have
+        # happened.
+        assert "host" in modes_seen and "mesh" in modes_seen
+        assert modes_seen[-1] == "mesh"
 
 
 @pytest.mark.integration

@@ -26,6 +26,7 @@ from torchft_tpu.communicator import (
     ManagedCommunicator,
 )
 from torchft_tpu.backends.host import HostCommunicator
+from torchft_tpu.backends.mesh import MeshCommunicator, MeshWorld
 from torchft_tpu.data import BatchIterator, DistributedSampler
 from torchft_tpu.local_sgd import DiLoCoTrainer, diloco_outer_optimizer
 from torchft_tpu.manager import Manager, WorldSizeMode
@@ -46,6 +47,8 @@ __all__ = [
     "Lighthouse",
     "ManagedCommunicator",
     "Manager",
+    "MeshCommunicator",
+    "MeshWorld",
     "ManagerClient",
     "ManagerServer",
     "OptimizerWrapper",

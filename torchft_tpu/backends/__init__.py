@@ -1,0 +1,12 @@
+"""Cross-replica-group collective backends.
+
+- :mod:`torchft_tpu.backends.host` — elastic host TCP ring (the Gloo-role
+  default; survives membership changes).
+- :mod:`torchft_tpu.backends.mesh` — on-device full-membership fast path
+  with host fallback (the NCCL-role optimization).
+"""
+
+from torchft_tpu.backends.host import HostCommunicator
+from torchft_tpu.backends.mesh import MeshCommunicator, MeshWorld
+
+__all__ = ["HostCommunicator", "MeshCommunicator", "MeshWorld"]

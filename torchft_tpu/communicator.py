@@ -72,6 +72,13 @@ class Communicator(ABC):
     @abstractmethod
     def rank(self) -> int: ...
 
+    @property
+    def wants_device_arrays(self) -> bool:
+        """True if collectives take device-resident ``jax.Array`` leaves
+        directly (on-device backends); False means the caller must hand
+        over host (numpy) leaves. Wrappers forward the wrapped value."""
+        return False
+
     def shutdown(self) -> None:  # noqa: B027
         pass
 
@@ -200,6 +207,10 @@ class ErrorSwallowingCommunicator(Communicator):
     def rank(self) -> int:
         return self._comm.rank()
 
+    @property
+    def wants_device_arrays(self) -> bool:
+        return self._comm.wants_device_arrays
+
     def shutdown(self) -> None:
         self._comm.shutdown()
 
@@ -267,3 +278,7 @@ class ManagedCommunicator(Communicator):
 
     def rank(self) -> int:
         return self._comm.rank()
+
+    @property
+    def wants_device_arrays(self) -> bool:
+        return self._comm.wants_device_arrays

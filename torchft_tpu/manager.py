@@ -47,6 +47,7 @@ from typing import Any, Callable, Dict, Optional, TypeVar, cast
 
 import numpy as np
 import jax
+import jax.numpy as jnp
 
 from torchft_tpu._native import ManagerClient, ManagerServer, Store, StoreClient
 from torchft_tpu.checkpointing import CheckpointServer
@@ -402,8 +403,14 @@ class Manager:
                 return _instant(tree)
 
             leaves, treedef = jax.tree_util.tree_flatten(tree)
+            # On-device backends (backends/mesh.py full-membership path)
+            # take device-resident leaves as-is — the optimization IS
+            # skipping this device->host round trip. Host backends need
+            # numpy.
+            wants_device = self._comm.wants_device_arrays
             if self.is_participating():
-                host = [np.asarray(x) for x in jax.device_get(leaves)]
+                host = (list(leaves) if wants_device
+                        else [np.asarray(x) for x in jax.device_get(leaves)])
             else:
                 # Healing/spare: contribute zeros (reference
                 # manager.py:215-216) — built from metadata, no
@@ -427,7 +434,12 @@ class Manager:
                 out_leaves = jax.tree_util.tree_leaves(summed)
                 placed = []
                 for inp, a in zip(leaves, out_leaves):
-                    if np.issubdtype(np.asarray(a).dtype, np.inexact):
+                    # .dtype directly: np.asarray on a device array would
+                    # force a host transfer just to read the dtype. And
+                    # jnp.issubdtype, not np: bfloat16 (ml_dtypes) is not
+                    # np.inexact, and floor-dividing grads by n stalls
+                    # training silently.
+                    if jnp.issubdtype(a.dtype, jnp.inexact):
                         a = (a / n).astype(a.dtype)
                     else:
                         a = a // n
