@@ -179,17 +179,29 @@ def bench_single_group(steps: int = 20, segments: int = 3,
 # --------------------------------------------------------------- scenario 2
 
 def bench_multigroup(n_groups: int = 2, steps: int = 20,
-                     hidden: int = 512) -> Dict[str, float]:
-    """N replica groups as threads, real cross-group gradient traffic:
-    device_get -> HostCommunicator ring allreduce over localhost TCP ->
-    device_put (the path a single-group bench never touches — round-1
-    VERDICT weak #3)."""
-    from torchft_tpu import HostCommunicator, Lighthouse, Manager
+                     hidden: int = 512,
+                     backend: str = "host") -> Dict[str, float]:
+    """N replica groups as threads, real cross-group gradient traffic.
+
+    backend="host": device_get -> HostCommunicator ring allreduce over
+    localhost TCP -> device_put (the path a single-group bench never
+    touches — round-1 VERDICT weak #3).
+    backend="mesh": the on-device full-membership fast path
+    (backends/mesh.py) — gradients stay device-resident, the cross-group
+    sum is one jitted XLA reduction, no serialization or sockets."""
+    from torchft_tpu import (HostCommunicator, Lighthouse, Manager,
+                             MeshCommunicator, MeshWorld)
     from torchft_tpu.models import MLP
     from torchft_tpu.parallel import FTTrainer
 
     lh = Lighthouse(bind="127.0.0.1:0", min_replicas=n_groups,
                     join_timeout_ms=2000, quorum_tick_ms=10)
+    mesh_world = MeshWorld(num_groups=n_groups, timeout_sec=60)
+
+    def make_comm():
+        if backend == "mesh":
+            return MeshCommunicator(mesh_world)
+        return HostCommunicator(timeout_sec=30)
     model = MLP(features=(hidden, hidden), num_classes=10)
     rng = np.random.default_rng(0)
     x = jnp.asarray(rng.normal(size=(64, 64)), jnp.float32)
@@ -209,7 +221,7 @@ def bench_multigroup(n_groups: int = 2, steps: int = 20,
         trainer = FTTrainer(
             loss_fn=loss_fn, tx=optax.sgd(0.05), params=params0,
             manager_factory=lambda load, save: Manager(
-                comm=HostCommunicator(timeout_sec=30), load_state_dict=load,
+                comm=make_comm(), load_state_dict=load,
                 state_dict=save, min_replica_size=n_groups, replica_id=gid,
                 lighthouse_addr=lh.address(), rank=0, world_size=1,
                 quorum_timeout_ms=30_000,
@@ -245,6 +257,7 @@ def bench_multigroup(n_groups: int = 2, steps: int = 20,
     ar = statistics.median(r["allreduce_ms_avg"] for r in results.values())
     return {
         "n_groups": n_groups,
+        "backend": backend,
         "steps_per_s": sps,
         "allreduce_ms_avg": ar,
         "grad_mbytes": n_params * 4 / 1e6,
@@ -363,9 +376,17 @@ def main() -> None:
     mg = bench_multigroup()
     _emit({"metric": "multigroup_steps_per_s",
            "value": round(mg["steps_per_s"], 2), "unit": "steps/s",
-           "n_groups": mg["n_groups"],
+           "n_groups": mg["n_groups"], "backend": "host",
            "allreduce_ms_avg": round(mg["allreduce_ms_avg"], 2),
            "grad_mbytes": round(mg["grad_mbytes"], 2)})
+
+    mm = bench_multigroup(backend="mesh")
+    _emit({"metric": "multigroup_mesh_steps_per_s",
+           "value": round(mm["steps_per_s"], 2), "unit": "steps/s",
+           "n_groups": mm["n_groups"], "backend": "mesh",
+           "allreduce_ms_avg": round(mm["allreduce_ms_avg"], 2),
+           "speedup_vs_host": round(mm["steps_per_s"]
+                                    / max(mg["steps_per_s"], 1e-9), 2)})
 
     rec = bench_recovery()
     _emit({"metric": "recovery_wall_clock_s",

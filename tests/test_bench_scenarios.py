@@ -18,6 +18,13 @@ class TestBenchScenarios:
         assert out["allreduce_ms_avg"] > 0
         assert out["grad_mbytes"] > 0
 
+    def test_multigroup_mesh_backend(self):
+        out = bench_multigroup(n_groups=2, steps=3, hidden=32,
+                               backend="mesh")
+        assert out["backend"] == "mesh"
+        assert out["steps_per_s"] > 0
+        assert out["allreduce_ms_avg"] > 0
+
     def test_recovery_guarantees(self):
         kill_at = 3
         out = bench_recovery(kill_at=kill_at, total_steps=12, hidden=16)

@@ -67,13 +67,16 @@ void tft_free(void* p) { free(p); }
 
 void* tft_lighthouse_new(const char* bind, uint64_t min_replicas,
                          int64_t join_timeout_ms, int64_t quorum_tick_ms,
-                         char** err) {
+                         int64_t heartbeat_fresh_ms,
+                         int64_t heartbeat_grace_factor, char** err) {
   try {
     LighthouseOpt opt;
     opt.bind = bind;
     opt.min_replicas = min_replicas;
     opt.join_timeout_ms = join_timeout_ms;
     opt.quorum_tick_ms = quorum_tick_ms;
+    opt.heartbeat_fresh_ms = heartbeat_fresh_ms;
+    opt.heartbeat_grace_factor = heartbeat_grace_factor;
     return new Lighthouse(opt);
   } catch (const std::exception& e) {
     fail(err, e.what());

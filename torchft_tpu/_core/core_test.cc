@@ -3,10 +3,14 @@
 // (src/lighthouse.rs:584-613), lighthouse client-server e2e on ephemeral ports
 // (:542-582), manager should_commit voting with concurrent clients and a real
 // lighthouse+manager pair (src/manager.rs:398-477).
+// The Release build defines NDEBUG, which would compile every assert out
+// and make this suite green-but-vacuous. Tests must always assert.
+#undef NDEBUG
 #include <assert.h>
 #include <unistd.h>
 
 #include <cstdio>
+#include <atomic>
 #include <thread>
 #include <vector>
 
@@ -236,6 +240,83 @@ static void test_fast_quorum_and_id_bump() {
   printf("test_fast_quorum_and_id_bump ok\n");
 }
 
+// A previous member that is absent from the join round but still
+// heartbeating gets an extended straggler grace (capped at
+// heartbeat_grace_factor * join_timeout); a member whose beats went stale
+// is cut out after the plain join_timeout. Heartbeats are load-bearing in
+// quorum logic here — the reference only visualizes them
+// (src/lighthouse.rs:378-391).
+static void test_heartbeat_straggler_grace() {
+  LighthouseOpt lopt;
+  lopt.bind = "127.0.0.1:0";
+  lopt.min_replicas = 1;
+  lopt.join_timeout_ms = 200;
+  lopt.quorum_tick_ms = 10;
+  lopt.heartbeat_fresh_ms = 500;
+  lopt.heartbeat_grace_factor = 4;  // grace cap = 800ms
+  Lighthouse lh(lopt);
+
+  auto join = [&](const std::string& id, int64_t step) {
+    RpcClient c(lh.address(), 2000);
+    LighthouseQuorumRequest req;
+    *req.mutable_requester() = member(id, step);
+    std::string resp, err;
+    assert(c.call(kLighthouseQuorum, req.SerializeAsString(), &resp, &err,
+                  10'000));
+    LighthouseQuorumResponse r;
+    assert(r.ParseFromString(resp));
+    return r.quorum();
+  };
+  auto beat = [&](const std::string& id) {
+    RpcClient c(lh.address(), 2000);
+    LighthouseHeartbeatRequest req;
+    req.set_replica_id(id);
+    std::string resp, err;
+    assert(c.call(kLighthouseHeartbeat, req.SerializeAsString(), &resp,
+                  &err, 2'000));
+  };
+
+  // Round 1: both join -> quorum {a,b}.
+  std::thread j1([&] { join("a", 1); });
+  Quorum q1 = join("b", 1);
+  j1.join();
+  assert(q1.participants_size() == 2);
+
+  // Round 2: b is dead (beats stale/absent). a alone must be cut after
+  // the plain join_timeout — grace never engages.
+  int64_t t0 = now_ms();
+  Quorum q2 = join("a", 2);
+  int64_t dead_wait = now_ms() - t0;
+  assert(q2.participants_size() == 1);
+  assert(dead_wait >= 200 && dead_wait < 600);
+
+  // Round 3: rebuild {a,b}.
+  std::thread j2([&] { join("a", 3); });
+  Quorum q3 = join("b", 3);
+  j2.join();
+  assert(q3.participants_size() == 2);
+
+  // Round 4: b does not join but keeps heartbeating (alive, stalled).
+  // The cut must be deferred to the grace cap, not the plain timeout.
+  std::atomic<bool> stop_beats{false};
+  std::thread beater([&] {
+    while (!stop_beats) {
+      beat("b");
+      usleep(50'000);
+    }
+  });
+  usleep(100'000);  // ensure a fresh beat is on record
+  t0 = now_ms();
+  Quorum q4 = join("a", 4);
+  int64_t grace_wait = now_ms() - t0;
+  stop_beats = true;
+  beater.join();
+  assert(q4.participants_size() == 1);
+  assert(grace_wait >= 700);  // held ~4x200ms, not 200ms
+  printf("test_heartbeat_straggler_grace ok (dead=%lldms grace=%lldms)\n",
+         (long long)dead_wait, (long long)grace_wait);
+}
+
 // Shutdown must not hang while a quorum RPC is parked at the lighthouse
 // waiting for a min_replicas that never arrives.
 static void test_shutdown_while_parked() {
@@ -280,6 +361,7 @@ int main() {
   test_lighthouse_manager_e2e();
   test_heal_decision();
   test_fast_quorum_and_id_bump();
+  test_heartbeat_straggler_grace();
   test_shutdown_while_parked();
   printf("ALL CORE TESTS PASSED\n");
   return 0;

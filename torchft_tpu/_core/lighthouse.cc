@@ -96,7 +96,24 @@ bool Lighthouse::quorum_valid_locked() const {
   // Membership is changing: give stragglers join_timeout_ms (measured from
   // the first join of this round) before forming the smaller/different
   // quorum (reference src/lighthouse.rs:133-156).
-  return now_ms() - first_join_ms_ >= opt_.join_timeout_ms;
+  int64_t now = now_ms();
+  int64_t wait = opt_.join_timeout_ms;
+  if (has_prev_quorum_) {
+    // A missing previous member that is still heartbeating is alive and
+    // will join shortly — extend its grace (capped) instead of forking
+    // the job into split quorums. A dead group's beats go stale within
+    // heartbeat_fresh_ms, so shrink-on-death latency is unchanged.
+    for (const auto& m : prev_quorum_.participants()) {
+      if (participants_.count(m.replica_id())) continue;
+      auto hb = heartbeats_.find(m.replica_id());
+      if (hb != heartbeats_.end() &&
+          now - hb->second < opt_.heartbeat_fresh_ms) {
+        wait = opt_.join_timeout_ms * opt_.heartbeat_grace_factor;
+        break;
+      }
+    }
+  }
+  return now - first_join_ms_ >= wait;
 }
 
 bool Lighthouse::tick() {

@@ -27,6 +27,18 @@ struct LighthouseOpt {
   uint64_t min_replicas = 1;
   int64_t join_timeout_ms = 60'000;
   int64_t quorum_tick_ms = 100;
+  // A previous-quorum member that is absent from the join round but still
+  // heartbeating (beat fresher than heartbeat_fresh_ms) is alive and en
+  // route — e.g. its training loop is momentarily stalled by compilation.
+  // Rather than cutting it out after join_timeout_ms (which forks the job
+  // into split quorums that must re-merge), the straggler wait is extended
+  // while its beats stay fresh, up to heartbeat_grace_factor *
+  // join_timeout_ms total (the cap bounds a wedged-but-beating group).
+  // The reference records heartbeats but never uses them in quorum logic
+  // (src/lighthouse.rs:378-391); this closes that gap. Set
+  // heartbeat_grace_factor = 1 to disable (reference behavior).
+  int64_t heartbeat_fresh_ms = 500;
+  int64_t heartbeat_grace_factor = 4;
 };
 
 class Lighthouse {

@@ -29,6 +29,13 @@ def main(argv: list[str] | None = None) -> None:
     parser.add_argument("--min-replicas", type=int, default=1)
     parser.add_argument("--join-timeout-ms", type=int, default=60_000)
     parser.add_argument("--quorum-tick-ms", type=int, default=100)
+    parser.add_argument("--heartbeat-fresh-ms", type=int, default=500,
+                        help="a missing prev member heartbeating within "
+                        "this window counts as alive-and-en-route")
+    parser.add_argument("--heartbeat-grace-factor", type=int, default=4,
+                        help="straggler wait extends to factor * "
+                        "join_timeout while such a member keeps beating "
+                        "(1 = reference behavior)")
     args = parser.parse_args(argv)
 
     logging.basicConfig(level=logging.INFO)
@@ -37,6 +44,8 @@ def main(argv: list[str] | None = None) -> None:
         min_replicas=args.min_replicas,
         join_timeout_ms=args.join_timeout_ms,
         quorum_tick_ms=args.quorum_tick_ms,
+        heartbeat_fresh_ms=args.heartbeat_fresh_ms,
+        heartbeat_grace_factor=args.heartbeat_grace_factor,
     )
     logging.info("lighthouse listening on %s (dashboard: http://%s/)",
                  lh.address(), lh.address())
