@@ -141,6 +141,17 @@ def test_lighthouse_status():
         status = lh.status()
         assert status["quorum_id"] >= 1
         assert [mm["replica_id"] for mm in status["members"]] == ["solo"]
+        # GET /status.json serves the same document over plain HTTP (no
+        # Python bridge needed — scrapers/SREs).
+        import json
+        import urllib.request
+
+        req = urllib.request.urlopen(
+            f"http://{lh.address()}/status.json", timeout=5)
+        assert req.headers["Content-Type"] == "application/json"
+        http_status = json.loads(req.read())
+        assert http_status["quorum_id"] == status["quorum_id"]
+        assert [mm["replica_id"] for mm in http_status["members"]] == ["solo"]
         m.shutdown()
     finally:
         lh.shutdown()

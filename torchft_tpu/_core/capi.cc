@@ -24,29 +24,6 @@
 using namespace torchft_tpu;
 
 namespace {
-std::string json_escape(const std::string& s) {
-  std::string out;
-  out.reserve(s.size() + 8);
-  for (unsigned char c : s) {
-    switch (c) {
-      case '"': out += "\\\""; break;
-      case '\\': out += "\\\\"; break;
-      case '\n': out += "\\n"; break;
-      case '\r': out += "\\r"; break;
-      case '\t': out += "\\t"; break;
-      default:
-        if (c < 0x20) {
-          char buf[8];
-          snprintf(buf, sizeof(buf), "\\u%04x", c);
-          out += buf;
-        } else {
-          out += (char)c;
-        }
-    }
-  }
-  return out;
-}
-
 char* dup_str(const std::string& s) {
   char* p = (char*)malloc(s.size() + 1);
   memcpy(p, s.data(), s.size());
@@ -289,25 +266,7 @@ int tft_lighthouse_client_status(const char* addr, int64_t timeout_ms,
       return fail(err, e);
     StatusResponse r;
     if (!r.ParseFromString(resp)) return fail(err, "bad StatusResponse");
-    std::string out = "{\"quorum_id\":" + std::to_string(r.quorum_id()) +
-                      ",\"quorum_age_ms\":" + std::to_string(r.quorum_age_ms()) +
-                      ",\"members\":[";
-    for (int i = 0; i < r.members_size(); i++) {
-      const auto& m = r.members(i);
-      if (i) out += ",";
-      out += "{\"replica_id\":\"" + json_escape(m.member().replica_id()) +
-             "\",\"address\":\"" + json_escape(m.member().address()) +
-             "\",\"step\":" + std::to_string(m.member().step()) +
-             ",\"world_size\":" + std::to_string(m.member().world_size()) +
-             ",\"heartbeat_age_ms\":" + std::to_string(m.heartbeat_age_ms()) +
-             "}";
-    }
-    out += "],\"joining\":[";
-    for (int i = 0; i < r.joining_size(); i++) {
-      if (i) out += ",";
-      out += "\"" + json_escape(r.joining(i)) + "\"";
-    }
-    out += "]}";
+    std::string out = Lighthouse::status_json(r);
     *json = dup_str(out);
     return 0;
   } catch (const std::exception& e) {

@@ -69,6 +69,52 @@ static std::string url_encode(const std::string& s) {
   return out;
 }
 
+static std::string json_escape(const std::string& s) {
+  std::string out;
+  out.reserve(s.size());
+  for (unsigned char c : s) {
+    switch (c) {
+      case '"': out += "\\\""; break;
+      case '\\': out += "\\\\"; break;
+      case '\n': out += "\\n"; break;
+      case '\r': out += "\\r"; break;
+      case '\t': out += "\\t"; break;
+      default:
+        if (c < 0x20) {
+          char buf[8];
+          snprintf(buf, sizeof buf, "\\u%04x", c);
+          out += buf;
+        } else {
+          out += (char)c;
+        }
+    }
+  }
+  return out;
+}
+
+std::string Lighthouse::status_json(const StatusResponse& r) {
+  std::string out = "{\"quorum_id\":" + std::to_string(r.quorum_id()) +
+                    ",\"quorum_age_ms\":" + std::to_string(r.quorum_age_ms()) +
+                    ",\"members\":[";
+  for (int i = 0; i < r.members_size(); i++) {
+    const auto& m = r.members(i);
+    if (i) out += ",";
+    out += "{\"replica_id\":\"" + json_escape(m.member().replica_id()) +
+           "\",\"address\":\"" + json_escape(m.member().address()) +
+           "\",\"step\":" + std::to_string(m.member().step()) +
+           ",\"world_size\":" + std::to_string(m.member().world_size()) +
+           ",\"heartbeat_age_ms\":" + std::to_string(m.heartbeat_age_ms()) +
+           "}";
+  }
+  out += "],\"joining\":[";
+  for (int i = 0; i < r.joining_size(); i++) {
+    if (i) out += ",";
+    out += "\"" + json_escape(r.joining(i)) + "\"";
+  }
+  out += "]}";
+  return out;
+}
+
 bool Lighthouse::quorum_changed(const Quorum& a, const Quorum& b) {
   // Membership (replica_id set) comparison only — step changes alone do not
   // constitute a new quorum (mirrors reference src/lighthouse.rs:81-86).
@@ -216,6 +262,18 @@ void Lighthouse::status_locked(StatusResponse* out) const {
 // buttons (the reference's askama/htmx dashboard, templates/status.html).
 std::string Lighthouse::handle_http(const std::string& request) {
   std::string body;
+  std::string content_type = "text/html";
+  // GET /status.json → machine-readable status (what the embedded binding's
+  // status() returns), so SREs/scripts can scrape without the Python bridge.
+  if (request.rfind("GET /status.json", 0) == 0) {
+    StatusResponse st;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      status_locked(&st);
+    }
+    body = status_json(st);
+    content_type = "application/json";
+  } else
   // POST /replica/{id}/kill → Kill RPC to that member's manager.
   if (request.rfind("POST /replica/", 0) == 0) {
     const size_t id_start = strlen("POST /replica/");
@@ -293,8 +351,9 @@ std::string Lighthouse::handle_http(const std::string& request) {
     body = os.str();
   }
   std::ostringstream resp;
-  resp << "HTTP/1.1 200 OK\r\nContent-Type: text/html\r\nContent-Length: "
-       << body.size() << "\r\nConnection: close\r\n\r\n"
+  resp << "HTTP/1.1 200 OK\r\nContent-Type: " << content_type
+       << "\r\nContent-Length: " << body.size()
+       << "\r\nConnection: close\r\n\r\n"
        << body;
   return resp.str();
 }

@@ -52,6 +52,10 @@ class Lighthouse {
   // Pure membership-change predicate (mirrors reference quorum_changed).
   static bool quorum_changed(const Quorum& a, const Quorum& b);
 
+  // StatusResponse -> JSON, shared by the ctypes bridge and the
+  // GET /status.json dashboard endpoint.
+  static std::string status_json(const StatusResponse& r);
+
  private:
   bool handle(uint8_t method, const std::string& req, std::string* resp,
               std::string* err);
