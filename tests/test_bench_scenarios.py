@@ -7,7 +7,8 @@ from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
-from bench import bench_multigroup, bench_recovery  # noqa: E402
+from bench import (bench_long_context, bench_multigroup,  # noqa: E402
+                   bench_recovery)
 
 
 class TestBenchScenarios:
@@ -24,6 +25,11 @@ class TestBenchScenarios:
         assert out["backend"] == "mesh"
         assert out["steps_per_s"] > 0
         assert out["allreduce_ms_avg"] > 0
+
+    def test_long_context_smoke(self):
+        out = bench_long_context()  # off-TPU: interpreter-mode smoke
+        assert out["tokens_per_s"] > 0
+        assert out["ms_per_fwd_bwd"] > 0
 
     def test_recovery_guarantees(self):
         kill_at = 3

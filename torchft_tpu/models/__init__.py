@@ -5,7 +5,11 @@ from torchft_tpu.models.transformer import (
     Transformer,
     TransformerConfig,
     causal_lm_loss,
+    llama2_7b_config,
+    llama2_13b_config,
+    llama2_70b_config,
     moe_lm_loss,
+    tiny_config,
     tp_rules,
 )
 
@@ -21,5 +25,9 @@ __all__ = [
     "Transformer",
     "TransformerConfig",
     "causal_lm_loss",
+    "llama2_7b_config",
+    "llama2_13b_config",
+    "llama2_70b_config",
+    "tiny_config",
     "tp_rules",
 ]

@@ -175,6 +175,49 @@ class Transformer(nn.Module):
                         name="lm_head")(x)
 
 
+# --------------------------------------------------------------- presets
+#
+# Named configurations for the BASELINE.md model families. Sizes follow
+# the published Llama-2 architecture table; ``llama2_7b`` is the HSDP
+# target of BASELINE config 3 (shard-within-group via fsdp rules,
+# replicate-across-groups via the FT manager).
+
+def tiny_config(**overrides: Any) -> TransformerConfig:
+    """Test-scale model: full architecture, trivial size."""
+    cfg = dict(vocab_size=256, num_layers=2, embed_dim=128, num_heads=4,
+               max_seq_len=256)
+    cfg.update(overrides)
+    return TransformerConfig(**cfg)
+
+
+def llama2_7b_config(**overrides: Any) -> TransformerConfig:
+    """Llama-2 7B: 32 layers, 4096 embed, 32 heads, 11008 hidden,
+    4k context (params ≈ 6.74e9; asserted by eval_shape in
+    tests/test_parallel.py)."""
+    cfg = dict(vocab_size=32_000, num_layers=32, embed_dim=4096,
+               num_heads=32, hidden_dim=11_008, max_seq_len=4096)
+    cfg.update(overrides)
+    return TransformerConfig(**cfg)
+
+
+def llama2_13b_config(**overrides: Any) -> TransformerConfig:
+    """Llama-2 13B: 40 layers, 5120 embed, 40 heads, 13824 hidden."""
+    cfg = dict(vocab_size=32_000, num_layers=40, embed_dim=5120,
+               num_heads=40, hidden_dim=13_824, max_seq_len=4096)
+    cfg.update(overrides)
+    return TransformerConfig(**cfg)
+
+
+def llama2_70b_config(**overrides: Any) -> TransformerConfig:
+    """Llama-2 70B: 80 layers, 8192 embed, 64 heads (8 kv — GQA),
+    28672 hidden."""
+    cfg = dict(vocab_size=32_000, num_layers=80, embed_dim=8192,
+               num_heads=64, num_kv_heads=8, hidden_dim=28_672,
+               max_seq_len=4096)
+    cfg.update(overrides)
+    return TransformerConfig(**cfg)
+
+
 def tp_rules() -> list:
     """Megatron-style tensor-parallel PartitionSpecs for
     :func:`torchft_tpu.parallel.sharding.apply_rules`.
