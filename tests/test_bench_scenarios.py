@@ -8,7 +8,7 @@ from pathlib import Path
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 from bench import (bench_long_context, bench_multigroup,  # noqa: E402
-                   bench_recovery)
+                   bench_recovery, bench_transformer)
 
 
 class TestBenchScenarios:
@@ -25,6 +25,11 @@ class TestBenchScenarios:
         assert out["backend"] == "mesh"
         assert out["steps_per_s"] > 0
         assert out["allreduce_ms_avg"] > 0
+
+    def test_transformer_smoke(self):
+        out = bench_transformer()  # off-TPU: tiny smoke shape
+        assert out["tokens_per_s"] > 0
+        assert out["n_params"] > 0
 
     def test_long_context_smoke(self):
         out = bench_long_context()  # off-TPU: interpreter-mode smoke

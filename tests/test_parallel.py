@@ -141,6 +141,33 @@ class TestPresets:
         assert cfg.kv_heads == 8 and cfg.num_heads == 64
         assert cfg.head_dim == 128  # MXU-tile friendly
 
+    def test_remat_matches_plain_gradients(self):
+        """cfg.remat trades backward FLOPs for activation memory; values
+        and gradients must be bitwise-stable vs the plain path."""
+        from torchft_tpu.models import (Transformer, causal_lm_loss,
+                                        tiny_config)
+
+        tokens = jnp.asarray(
+            np.random.default_rng(0).integers(0, 256, size=(2, 32)),
+            jnp.int32)
+
+        def loss_and_grad(remat):
+            model = Transformer(tiny_config(remat=remat))
+            params = model.init(jax.random.key(0), tokens)
+
+            def loss_fn(p):
+                return causal_lm_loss(model.apply(p, tokens), tokens)
+
+            return jax.jit(jax.value_and_grad(loss_fn))(params)
+
+        (l0, g0), (l1, g1) = loss_and_grad(False), loss_and_grad(True)
+        np.testing.assert_allclose(float(l0), float(l1), rtol=1e-6)
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a, np.float32), np.asarray(b, np.float32),
+                rtol=1e-5, atol=1e-6),
+            g0, g1)
+
     def test_7b_sharding_rules_cover_all_params(self):
         """Every 7B parameter gets a sharding from the tp+fsdp rule set
         on a dp×fsdp×tp mesh, and each spec divides the dims — the HSDP
