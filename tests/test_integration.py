@@ -390,7 +390,11 @@ class TestHSDPIntegration:
                 shardings = infer_fsdp_sharding(params, mesh, min_size=64)
                 trainer = FTTrainer(
                     loss_fn=loss_fn,
-                    tx=optax.sgd(0.05),
+                    # adamw, not sgd: its step counter is a leaf optax
+                    # creates from scratch (not zeros_like(params)), the
+                    # case where healed state must land on the mesh and
+                    # not get pinned to one device (step.py _on_mesh).
+                    tx=optax.adamw(0.05),
                     params=params,
                     param_shardings=shardings,
                     batch_sharding=NamedSharding(

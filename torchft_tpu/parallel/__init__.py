@@ -2,6 +2,7 @@ from torchft_tpu.parallel.mesh import make_mesh
 from torchft_tpu.parallel.sharding import (
     apply_rules,
     batch_spec,
+    combined_shardings,
     infer_fsdp_sharding,
     list_shardings,
     replicated,
@@ -25,6 +26,7 @@ __all__ = [
     "transformer_pipeline_forward",
     "apply_rules",
     "batch_spec",
+    "combined_shardings",
     "infer_fsdp_sharding",
     "list_shardings",
     "make_mesh",

@@ -35,6 +35,30 @@ from torchft_tpu.optim import FTOptimizer
 logger = logging.getLogger(__name__)
 
 
+def _on_mesh(tree: Any, param_shardings: Any) -> Any:
+    """Place every jax.Array leaf of ``tree`` on the mesh that
+    ``param_shardings`` lives on; leaves not already there are replicated
+    (they're scalars/counters — tiny)."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    mesh = next(
+        (s.mesh for s in jax.tree_util.tree_leaves(
+            param_shardings, is_leaf=lambda x: isinstance(x, NamedSharding))
+         if isinstance(s, NamedSharding)), None)
+    if mesh is None:
+        return tree
+    devices = set(mesh.devices.flat)
+    rep = NamedSharding(mesh, PartitionSpec())
+
+    def fix(leaf: Any) -> Any:
+        if (isinstance(leaf, jax.Array)
+                and set(leaf.sharding.device_set) != devices):
+            return jax.device_put(leaf, rep)
+        return leaf
+
+    return jax.tree_util.tree_map(fix, tree)
+
+
 class FTTrainer:
     """Owns ``(params, opt_state)`` and runs the per-step FT protocol.
 
@@ -88,6 +112,19 @@ class FTTrainer:
         self.model_state = model_state
         self._has_state = model_state is not None
         self.opt_state = tx.init(params)
+        if param_shardings is not None:
+            # Zeros-like moments inherit the params' shardings, but leaves
+            # optax creates from scratch (adam's step counter) land
+            # uncommitted on the default device. jit tolerates the mix only
+            # while they stay uncommitted; healing commits restored leaves
+            # onto the CURRENT placement (serialization.device_put_like),
+            # which would pin them to one device and crash the next update
+            # with a mixed device set. Keep every leaf on the params' mesh
+            # from the start.
+            self.opt_state = _on_mesh(self.opt_state, param_shardings)
+            if self._has_state:
+                self.model_state = _on_mesh(self.model_state,
+                                            param_shardings)
         self._batch_sharding = batch_sharding
         self._strict_commit = strict_commit
 
