@@ -121,16 +121,42 @@ def main() -> None:
     logger.info("replica group %d/%d up (%s)", replica_group, num_groups,
                 m.replica_id())
 
+    # Durable checkpoint/resume (the reference documents the cadence in its
+    # trainer, train_ddp.py:130-137: manager state MUST ride with the model
+    # state so step counters stay in sync). Live healing covers replica
+    # death; this covers whole-job restarts.
+    ckpt_dir = os.environ.get("CHECKPOINT_DIR")
+    ckpt_every = int(os.environ.get("CHECKPOINT_EVERY", 10))
+    if ckpt_dir:
+        from torchft_tpu import checkpoint_io
+
+        path = checkpoint_io.latest(os.path.join(ckpt_dir,
+                                                 str(replica_group)))
+        if path:
+            user, mgr_state = checkpoint_io.load(
+                path, target=trainer.state_dict())
+            trainer.load_state_dict(user)
+            m.load_state_dict(mgr_state)
+            logger.info("resumed from %s at step %d", path,
+                        m.current_step())
+
     t0 = time.perf_counter()
     while m.current_step() < total_steps:
         batch = next(batches)
         loss, committed = trainer.train_step(batch)
-        if m.current_step() % 10 == 0:
+        step = m.current_step()
+        if ckpt_dir and committed and step % ckpt_every == 0:
+            from torchft_tpu import checkpoint_io
+
+            checkpoint_io.save(
+                os.path.join(ckpt_dir, str(replica_group), f"ckpt_{step}"),
+                trainer.state_dict(), m.state_dict())
+        if step % 10 == 0:
             dt = time.perf_counter() - t0
             logger.info(
                 "step=%d loss=%.4f committed=%s participants=%d "
                 "(%.2f steps/s)",
-                m.current_step(), float(loss), committed,
+                step, float(loss), committed,
                 m.num_participants(), 10 / dt if dt else 0)
             t0 = time.perf_counter()
     logger.info("done: %d steps, %d batches committed",
