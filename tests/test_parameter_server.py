@@ -1,0 +1,139 @@
+"""Parameter-server topology tests (BASELINE.md config 4): lighthouse-free
+fault tolerance via per-session reconfigurable communicators — mirrors the
+reference's parameter_server_test.py (client/server session, collectives
+both ways, session isolation on failure)."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from torchft_tpu.backends.host import HostCommunicator
+from torchft_tpu.communicator import CommunicatorError
+from torchft_tpu.parameter_server import ParameterServer
+
+
+class EchoPS(ParameterServer):
+    """Serves its weights down (broadcast) and averages updates back
+    (allreduce), once per session."""
+
+    def __init__(self):
+        super().__init__()
+        self.weights = {"w": np.arange(4.0, dtype=np.float32)}
+        self.sessions_served = 0
+        self.session_errors = 0
+        self._lock = threading.Lock()
+
+    def new_communicator(self):
+        return HostCommunicator(timeout_sec=10)
+
+    def forward(self, session_id, comm):
+        try:
+            comm.broadcast(self.weights, root=0).result(timeout=30)
+            averaged = comm.allreduce(dict(self.weights),
+                                      op="mean").result(timeout=30)
+            with self._lock:
+                self.weights = averaged
+                self.sessions_served += 1
+        except Exception:
+            with self._lock:
+                self.session_errors += 1
+            raise
+
+
+@pytest.fixture
+def ps():
+    server = EchoPS()
+    yield server
+    server.shutdown()
+
+
+class TestParameterServer:
+    def test_session_roundtrip(self, ps):
+        comm = EchoPS.new_session(ps.address())
+        try:
+            # weights come down from the server...
+            got = comm.broadcast({"w": np.zeros(4, np.float32)},
+                                 root=0).result(timeout=30)
+            np.testing.assert_allclose(got["w"], [0, 1, 2, 3])
+            # ...client pushes an update, both sides see the mean
+            mean = comm.allreduce({"w": got["w"] + 2.0},
+                                  op="mean").result(timeout=30)
+            np.testing.assert_allclose(mean["w"], [1, 2, 3, 4])
+        finally:
+            comm.shutdown()
+        assert ps.sessions_served == 1
+        np.testing.assert_allclose(ps.weights["w"], [1, 2, 3, 4])
+
+    def test_sequential_sessions_accumulate(self, ps):
+        for k in range(3):
+            comm = EchoPS.new_session(ps.address())
+            try:
+                got = comm.broadcast({"w": np.zeros(4, np.float32)},
+                                     root=0).result(timeout=30)
+                comm.allreduce({"w": got["w"]}, op="mean").result(timeout=30)
+            finally:
+                comm.shutdown()
+        assert ps.sessions_served == 3
+        # each session averaged identical trees: weights unchanged
+        np.testing.assert_allclose(ps.weights["w"], [0, 1, 2, 3])
+
+    def test_client_death_kills_only_its_session(self, ps):
+        """A client that dies mid-session must not poison the server:
+        its session errors out alone and the next session works."""
+        dead = EchoPS.new_session(ps.address())
+        dead.broadcast({"w": np.zeros(4, np.float32)},
+                       root=0).result(timeout=30)
+        dead.shutdown()  # dies before the allreduce
+
+        # wait for the server's session thread to observe the death
+        deadline = threading.Event()
+        for _ in range(100):
+            if ps.session_errors >= 1:
+                break
+            deadline.wait(0.2)
+        assert ps.session_errors == 1
+
+        comm = EchoPS.new_session(ps.address())
+        try:
+            got = comm.broadcast({"w": np.zeros(4, np.float32)},
+                                 root=0).result(timeout=30)
+            np.testing.assert_allclose(got["w"], [0, 1, 2, 3])
+            comm.allreduce({"w": got["w"]}, op="mean").result(timeout=30)
+        finally:
+            comm.shutdown()
+        assert ps.sessions_served == 1
+
+    def test_concurrent_sessions_are_isolated(self, ps):
+        """Two clients in flight at once: per-session store prefixes keep
+        their collectives from crosstalking."""
+        results = {}
+
+        def client(name):
+            comm = EchoPS.new_session(ps.address())
+            try:
+                got = comm.broadcast({"w": np.zeros(4, np.float32)},
+                                     root=0).result(timeout=30)
+                results[name] = comm.allreduce(
+                    {"w": got["w"]}, op="mean").result(timeout=30)
+            finally:
+                comm.shutdown()
+
+        ts = [threading.Thread(target=client, args=(f"c{i}",))
+              for i in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=60)
+        assert len(results) == 2
+        for r in results.values():
+            np.testing.assert_allclose(r["w"], [0, 1, 2, 3])
+        assert ps.sessions_served == 2
+
+    def test_bad_path_404(self, ps):
+        import urllib.error
+        import urllib.request
+
+        addr = ps.address().replace("/new_session", "/nope")
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(addr, timeout=10)
