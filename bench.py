@@ -387,6 +387,76 @@ def bench_long_context(seq_len: int = 16_384, heads: int = 8,
     }
 
 
+# --------------------------------------------------------------- scenario 2c
+
+def bench_diloco(n_groups: int = 2, sync_every: int = 8,
+                 rounds: int = 4, hidden: int = 512) -> Dict[str, float]:
+    """DiLoCo local SGD (BASELINE.md config 5): inner steps touch no
+    cross-group interconnect at all; only every ``sync_every``-th step
+    pays an outer allreduce of the parameter delta. Reports the measured
+    inner-step rate vs the per-step-DDP rate on the same model
+    (bench_multigroup), i.e. the communication-reduction payoff."""
+    from torchft_tpu import HostCommunicator, Lighthouse, Manager
+    from torchft_tpu.local_sgd import DiLoCoTrainer
+    from torchft_tpu.models import MLP
+
+    lh = Lighthouse(bind="127.0.0.1:0", min_replicas=n_groups,
+                    join_timeout_ms=2000, quorum_tick_ms=10)
+    model = MLP(features=(hidden, hidden), num_classes=10)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(64, 64)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 10, size=(64,)), jnp.int32)
+
+    def loss_fn(params, batch):
+        logits = model.apply(params, batch["x"])
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, batch["y"]).mean()
+
+    params0 = model.init(jax.random.key(0), x[:1])
+    results: Dict[str, float] = {}
+
+    def worker(gid: str) -> None:
+        t = DiLoCoTrainer(
+            loss_fn=loss_fn, inner_tx=optax.sgd(0.05), params=params0,
+            manager_factory=lambda load, save: Manager(
+                comm=HostCommunicator(timeout_sec=30), load_state_dict=load,
+                state_dict=save, min_replica_size=n_groups, replica_id=gid,
+                lighthouse_addr=lh.address(), rank=0, world_size=1,
+                quorum_timeout_ms=30_000,
+            ),
+            sync_every=sync_every,
+        )
+        b = {"x": x, "y": y}
+        # warm: one full outer round (compile + first quorum)
+        while t.manager.current_step() < 1:
+            t.train_step(b)
+        t0 = time.perf_counter()
+        target = 1 + rounds
+        inner = 0
+        while t.manager.current_step() < target:
+            t.train_step(b)
+            inner += 1
+        _materialize(t.anchor)
+        dt = time.perf_counter() - t0
+        results[gid] = inner / dt
+        t.shutdown()
+
+    threads = [threading.Thread(target=worker, args=(f"d{i}",))
+               for i in range(n_groups)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=600)
+    lh.shutdown()
+
+    return {
+        "n_groups": n_groups,
+        "sync_every": sync_every,
+        "inner_steps_per_s": statistics.median(results.values()),
+        "comm_per_step_frac": 1.0 / sync_every,
+    }
+
+
 # --------------------------------------------------------------- scenario 3
 
 def bench_recovery(kill_at: int = 6, total_steps: int = 16,
@@ -517,6 +587,13 @@ def main() -> None:
            "allreduce_ms_avg": round(mm["allreduce_ms_avg"], 2),
            "speedup_vs_host": round(mm["steps_per_s"]
                                     / max(mg["steps_per_s"], 1e-9), 2)})
+
+    dl = bench_diloco()
+    _emit({"metric": "diloco_inner_steps_per_s",
+           "value": round(dl["inner_steps_per_s"], 2), "unit": "steps/s",
+           "sync_every": dl["sync_every"],
+           "speedup_vs_ddp": round(dl["inner_steps_per_s"]
+                                   / max(mg["steps_per_s"], 1e-9), 2)})
 
     lc = bench_long_context()
     _emit({"metric": "long_context_tokens_per_s",

@@ -7,8 +7,8 @@ from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
-from bench import (bench_long_context, bench_multigroup,  # noqa: E402
-                   bench_recovery, bench_transformer)
+from bench import (bench_diloco, bench_long_context,  # noqa: E402
+                   bench_multigroup, bench_recovery, bench_transformer)
 
 
 class TestBenchScenarios:
@@ -25,6 +25,11 @@ class TestBenchScenarios:
         assert out["backend"] == "mesh"
         assert out["steps_per_s"] > 0
         assert out["allreduce_ms_avg"] > 0
+
+    def test_diloco_rate(self):
+        out = bench_diloco(n_groups=2, sync_every=4, rounds=2, hidden=32)
+        assert out["inner_steps_per_s"] > 0
+        assert out["comm_per_step_frac"] == 0.25
 
     def test_transformer_smoke(self):
         out = bench_transformer()  # off-TPU: tiny smoke shape
