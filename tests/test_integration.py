@@ -362,6 +362,96 @@ class TestMeshIntegration:
 
 
 @pytest.mark.integration
+class TestFourGroupMesh:
+    """BASELINE config 2's shape at test scale: 4 replica groups, each
+    owning a 2-device fsdp sub-mesh of the 8-device host, cross-group
+    gradients on the on-device MeshCommunicator, ResNet-style conv model.
+    All groups must converge bitwise-identically."""
+
+    def test_four_groups_sharded_converge(self):
+        from jax.sharding import NamedSharding
+
+        from torchft_tpu import MeshCommunicator, MeshWorld
+        from torchft_tpu.models import ResNet
+        from torchft_tpu.models.resnet import ResNetBlock
+        from torchft_tpu.parallel import batch_spec, infer_fsdp_sharding, \
+            make_mesh
+
+        n_groups, total = 4, 3
+        lh = Lighthouse(bind="127.0.0.1:0", min_replicas=n_groups,
+                        join_timeout_ms=2000, quorum_tick_ms=20)
+        world = MeshWorld(num_groups=n_groups, timeout_sec=60)
+        devs = jax.devices()
+        assert len(devs) >= 8
+        # micro-ResNet: the ResNet-50 family's machinery (stem, stages,
+        # batch norm state) at test size
+        model = ResNet(stage_sizes=(1, 1), block_cls=ResNetBlock,
+                       num_classes=4, num_filters=8)
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(32, 16, 16, 3)).astype(np.float32)
+        y = rng.integers(0, 4, size=(32,)).astype(np.int32)
+
+        def loss_fn(params, model_state, batch):
+            logits, new_state = model.apply(
+                {"params": params, **model_state}, batch["x"], train=True,
+                mutable=["batch_stats"])
+            loss = optax.softmax_cross_entropy_with_integer_labels(
+                logits, batch["y"]).mean()
+            return loss, new_state
+
+        def run_group(g):
+            mesh = make_mesh({"fsdp": 2}, devices=devs[2 * g: 2 * g + 2])
+            variables = model.init(jax.random.key(3),
+                                   jnp.zeros((1, 16, 16, 3)), train=True)
+            trainer = FTTrainer(
+                loss_fn=loss_fn,
+                tx=optax.sgd(0.05),
+                params=variables["params"],
+                model_state={"batch_stats": variables["batch_stats"]},
+                param_shardings=infer_fsdp_sharding(
+                    variables["params"], mesh, min_size=64),
+                batch_sharding=NamedSharding(
+                    mesh, batch_spec(mesh, data_axes=("fsdp",))),
+                manager_factory=lambda load, save: Manager(
+                    comm=MeshCommunicator(world, group_index=g),
+                    load_state_dict=load, state_dict=save,
+                    min_replica_size=n_groups, replica_id=f"m4_{g}",
+                    lighthouse_addr=lh.address(), rank=0, world_size=1,
+                    timeout_ms=20_000, quorum_timeout_ms=20_000,
+                ),
+            )
+            try:
+                sampler = DistributedSampler(len(x), g, n_groups,
+                                             batch_size=8, seed=1)
+                batches = iter([])
+                while trainer.manager.current_step() < total:
+                    try:
+                        idx = next(batches)
+                    except StopIteration:
+                        sampler.set_epoch(sampler.epoch + 1)
+                        batches = iter(sampler)
+                        idx = next(batches)
+                    trainer.train_step({"x": x[idx], "y": y[idx]})
+                return jax.device_get(trainer.params)
+            finally:
+                trainer.shutdown()
+
+        try:
+            with ThreadPoolExecutor(max_workers=n_groups) as pool:
+                futs = [pool.submit(run_group, g) for g in range(n_groups)]
+                results = [f.result(timeout=240) for f in futs]
+        finally:
+            lh.shutdown()
+        # Params replicate bitwise; batch-norm running stats are local by
+        # design (they track each group's own data shard, as in unsynced
+        # BN under torch DDP) and are deliberately not compared.
+        for other in results[1:]:
+            jax.tree_util.tree_map(
+                lambda a, b: np.testing.assert_array_equal(a, b),
+                results[0], other)
+
+
+@pytest.mark.integration
 class TestHSDPIntegration:
     """HSDP: FSDP-sharded params inside each replica group + FT replication
     across groups (BASELINE.md config 3's shape), including healing of
