@@ -432,6 +432,17 @@ class Manager:
                     allreduce_ms_total=(time.perf_counter() - ar_t0) * 1e3,
                 )
                 out_leaves = jax.tree_util.tree_leaves(summed)
+                if wants_device and all(isinstance(a, jax.Array)
+                                        for a in out_leaves):
+                    # On-device results are already placed like the inputs
+                    # (the backend's contract); scale the whole tree in ONE
+                    # jitted call — per-leaf eager ops each pay a dispatch
+                    # round-trip, ruinous through a tunneled chip. n is a
+                    # traced argument, so membership changes don't
+                    # recompile.
+                    return _scale_tree(
+                        jax.tree_util.tree_unflatten(treedef, out_leaves),
+                        n)
                 placed = []
                 for inp, a in zip(leaves, out_leaves):
                     # .dtype directly: np.asarray on a device array would
@@ -636,6 +647,16 @@ class Manager:
             self._manager_server.shutdown()
         if self._store_server is not None:
             self._store_server.shutdown()
+
+
+@jax.jit
+def _scale_tree(tree: Any, n: Any) -> Any:
+    """sum -> mean by live participant count, one fused computation; jit
+    caches per tree structure, n is traced."""
+    return jax.tree_util.tree_map(
+        lambda a: (a / n).astype(a.dtype)
+        if jnp.issubdtype(a.dtype, jnp.inexact) else a // n,
+        tree)
 
 
 def _instant(value: Any) -> Future:
