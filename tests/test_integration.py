@@ -166,6 +166,73 @@ class TestIntegration:
 
 
 @pytest.mark.integration
+class TestSparesIntegration:
+    """FIXED_WITH_SPARES end to end: three groups, participating world
+    clamped to two — the third runs as a warm spare (computes, contributes
+    zeros, excluded from 1/n) yet stays bitwise-identical, so promotion
+    on a real death is instant."""
+
+    def test_spare_tracks_but_does_not_contribute(self):
+        from torchft_tpu.manager import WorldSizeMode
+
+        n_groups, total = 3, 4
+        lh = Lighthouse(bind="127.0.0.1:0", min_replicas=n_groups,
+                        join_timeout_ms=2000, quorum_tick_ms=20)
+        x, y = make_data()
+        model = MLP(features=(16,), num_classes=2)
+
+        def loss_fn(params, batch):
+            logits = model.apply(params, batch["x"])
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, batch["y"]).mean()
+
+        def run(group):
+            params = model.init(jax.random.key(42), jnp.zeros((1, 8)))
+            trainer = FTTrainer(
+                loss_fn=loss_fn, tx=optax.sgd(0.05), params=params,
+                manager_factory=lambda load, save: Manager(
+                    comm=HostCommunicator(timeout_sec=15),
+                    load_state_dict=load, state_dict=save,
+                    min_replica_size=2,
+                    world_size_mode=WorldSizeMode.FIXED_WITH_SPARES,
+                    replica_id=f"spare{group}",
+                    lighthouse_addr=lh.address(), rank=0, world_size=1,
+                    timeout_ms=15_000, quorum_timeout_ms=15_000,
+                ),
+            )
+            participants_seen = set()
+            b = {"x": x[:16], "y": y[:16]}
+            try:
+                while trainer.manager.current_step() < total:
+                    trainer.train_step(b)
+                    participants_seen.add(
+                        trainer.manager.num_participants())
+                return (jax.device_get(trainer.params), participants_seen,
+                        trainer.manager.is_participating())
+            finally:
+                trainer.shutdown()
+
+        try:
+            with ThreadPoolExecutor(max_workers=n_groups) as pool:
+                futs = [pool.submit(run, g) for g in range(n_groups)]
+                results = [f.result(timeout=180) for f in futs]
+        finally:
+            lh.shutdown()
+
+        # arithmetic world stayed clamped at 2 for everyone
+        for _, seen, _ in results:
+            assert seen == {2}, seen
+        # exactly one group ended as the non-participating spare
+        assert sum(0 if p else 1 for _, _, p in results) == 1
+        # spare included: identical params (it applies the same averaged
+        # update — that's what makes instant promotion safe)
+        for other in results[1:]:
+            jax.tree_util.tree_map(
+                lambda a, b_: np.testing.assert_array_equal(a, b_),
+                results[0][0], other[0])
+
+
+@pytest.mark.integration
 class TestChaosSoak:
     """Randomized multi-failure soak: three replica groups, each killed at
     pseudo-random steps (seeded — the schedule is deterministic across
