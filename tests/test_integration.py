@@ -506,7 +506,8 @@ class TestFourGroupMesh:
         try:
             with ThreadPoolExecutor(max_workers=n_groups) as pool:
                 futs = [pool.submit(run_group, g) for g in range(n_groups)]
-                results = [f.result(timeout=240) for f in futs]
+                # generous: 4 threads x jit compiles contend for one core
+                results = [f.result(timeout=420) for f in futs]
         finally:
             lh.shutdown()
         # Params replicate bitwise; batch-norm running stats are local by
