@@ -71,6 +71,76 @@ class TestFlashAttention:
                                        atol=2e-4, rtol=2e-4)
 
 
+class TestFlashAttentionBlock:
+    """The ring-attention building block: one flash pass against a K/V
+    block with a TRACED mask shift, returning (out, lse) for
+    online-softmax merging — differentiable through both outputs."""
+
+    def test_shift_modes_match_reference(self):
+        from torchft_tpu.ops.flash_attention import (_reference,
+                                                     flash_attention_block)
+
+        q, k, v = qkv(s=32)
+        s = q.shape[1]
+        out_f, _ = flash_attention_block(q, k, v, jnp.int32(s), 8, 8)
+        np.testing.assert_allclose(
+            np.asarray(out_f), np.asarray(_reference(q, k, v, False)),
+            rtol=2e-5, atol=2e-5)
+        out_c, _ = flash_attention_block(q, k, v, jnp.int32(0), 8, 8)
+        np.testing.assert_allclose(
+            np.asarray(out_c), np.asarray(_reference(q, k, v, True)),
+            rtol=2e-5, atol=2e-5)
+        # fully blocked: lse ~ -inf → zero weight when merged
+        _, lse_b = flash_attention_block(q, k, v, jnp.int32(-s), 8, 8)
+        assert float(jnp.max(lse_b)) < -1e29
+
+    def test_merge_value_and_grads_match_dense(self):
+        """Two blocks (one full, one diagonal-causal) merged via lse must
+        equal dense attention over the concatenated keys — including
+        gradients, which flow through the lse cotangent."""
+        from torchft_tpu.ops.flash_attention import flash_attention_block
+
+        q, k1, v1 = qkv(s=16)
+        _, k2, v2 = qkv(s=16, seed=9)
+        s = q.shape[1]
+        b, _, h, _ = q.shape
+
+        def per(w):
+            return w.reshape(b, h, s).transpose(0, 2, 1)[..., None]
+
+        def loss_merged(q, k1, v1, k2, v2):
+            o1, l1 = flash_attention_block(q, k1, v1, jnp.int32(s), 8, 8)
+            o2, l2 = flash_attention_block(q, k2, v2, jnp.int32(0), 8, 8)
+            m = jnp.maximum(l1, l2)
+            w1, w2 = jnp.exp(l1 - m), jnp.exp(l2 - m)
+            out = (per(w1) * o1 + per(w2) * o2) / (per(w1) + per(w2))
+            return jnp.sum(out ** 2)
+
+        def loss_dense(q, k1, v1, k2, v2):
+            kk = jnp.concatenate([k1, k2], axis=1)
+            vv = jnp.concatenate([v1, v2], axis=1)
+            scale = q.shape[-1] ** -0.5
+            logits = jnp.einsum("bqhd,bkhd->bhqk", q, kk) * scale
+            qp = jnp.arange(s)[:, None]
+            kp = jnp.arange(s)[None, :]
+            mask = jnp.concatenate(
+                [jnp.ones((s, s), bool), qp >= kp], axis=1)
+            logits = jnp.where(mask[None, None], logits, -1e30)
+            p = jax.nn.softmax(logits, axis=-1)
+            return jnp.sum(jnp.einsum("bhqk,bkhd->bqhd", p, vv) ** 2)
+
+        np.testing.assert_allclose(
+            float(loss_merged(q, k1, v1, k2, v2)),
+            float(loss_dense(q, k1, v1, k2, v2)), rtol=1e-4)
+        gm = jax.grad(loss_merged, argnums=(0, 1, 2, 3, 4))(
+            q, k1, v1, k2, v2)
+        gd = jax.grad(loss_dense, argnums=(0, 1, 2, 3, 4))(
+            q, k1, v1, k2, v2)
+        for a, b_ in zip(gm, gd):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                       rtol=2e-4, atol=2e-4)
+
+
 class TestRingAttention:
     @pytest.mark.parametrize("causal", [True, False])
     def test_matches_reference_sp8(self, causal):

@@ -38,8 +38,14 @@ NEG_INF = -1e30
 _LANES = 128  # TPU vector lane count
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
-                *, causal: bool, scale: float, nkb: int, offset: int):
+def _fwd_kernel(*refs, causal: bool, scale: float, nkb: int, offset: int,
+                dynamic_shift: bool):
+    if dynamic_shift:
+        q_ref, k_ref, v_ref, shift_ref, o_ref, lse_ref, \
+            m_ref, l_ref, acc_ref = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref = refs
+        shift_ref = None
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     bq = q_ref.shape[1]
@@ -54,7 +60,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
     # Causal: blocks strictly above the diagonal contribute nothing.
     # ``offset = s_k - s_q`` end-aligns queries to the last s_q key
     # positions (decode convention; matches _reference's tril(k=s_k-s_q)).
-    diag_ok = jnp.logical_or(not causal,
+    # With a traced shift the mask is data, so every block computes.
+    diag_ok = jnp.logical_or(not causal or dynamic_shift,
                              qi * bq + bq - 1 + offset >= ki * bk)
 
     @pl.when(diag_ok)
@@ -63,11 +70,16 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
         k = k_ref[0].astype(jnp.float32)                  # [bk, d]
         v = v_ref[0].astype(jnp.float32)                  # [bk, d]
         logits = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
-        if causal:
+        if causal or dynamic_shift:
             q_pos = offset + qi * bq + jax.lax.broadcasted_iota(
                 jnp.int32, (bq, bk), 0)
             k_pos = ki * bk + jax.lax.broadcasted_iota(
                 jnp.int32, (bq, bk), 1)
+            if dynamic_shift:
+                # Traced mask selector (ring attention): q_pos + shift >=
+                # k_pos. shift=0 → diagonal causal; shift >= s_k → full
+                # attention; shift <= -s_q → fully blocked.
+                q_pos = q_pos + shift_ref[0, 0]
             logits = jnp.where(q_pos >= k_pos, logits, NEG_INF)
         m_prev = m_ref[:]
         m_new = jnp.maximum(m_prev,
@@ -106,11 +118,13 @@ def _auto_block(seq: int, cap: int = 1024) -> int:
 
 def _flash_fwd(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                causal: bool, block_q: Optional[int], block_k: Optional[int],
-               interpret: bool) -> jnp.ndarray:
+               interpret: bool,
+               shift: Optional[jnp.ndarray] = None) -> jnp.ndarray:
     b, s, h, d = q.shape
     scale = d ** -0.5
     block_q = block_q or _auto_block(s)
     block_k = block_k or _auto_block(k.shape[1])
+    dynamic_shift = shift is not None
 
     def to_bh(x):
         return x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
@@ -128,9 +142,21 @@ def _flash_fwd(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     nkb = sk // block_k
 
     grid = (b * h, s // block_q, nkb)
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
+        pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0)),
+        pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0)),
+    ]
+    inputs = [qh, kh, vh]
+    if dynamic_shift:
+        # Traced mask selector, one scalar riding a [1, LANES] i32 tile.
+        in_specs.append(pl.BlockSpec((1, _LANES), lambda bh, i, j: (0, 0)))
+        inputs.append(jnp.broadcast_to(
+            jnp.asarray(shift, jnp.int32).reshape(1, 1), (1, _LANES)))
     out, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, causal=causal, scale=scale,
-                          nkb=nkb, offset=sk - s),
+                          nkb=nkb, offset=sk - s,
+                          dynamic_shift=dynamic_shift),
         out_shape=[
             jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
             # Row stats ride in [bh, s, 128] with the value broadcast over
@@ -140,11 +166,7 @@ def _flash_fwd(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
             jax.ShapeDtypeStruct((b * h, s, _LANES), jnp.float32),
         ],
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
             pl.BlockSpec((1, block_q, _LANES),
@@ -156,17 +178,19 @@ def _flash_fwd(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
             pltpu.VMEM((block_q, d), jnp.float32),   # output accumulator
         ],
         interpret=interpret,
-    )(qh, kh, vh)
+    )(*inputs)
     return out.reshape(b, h, s, d).transpose(0, 2, 1, 3), lse[:, :, 0]
 
 
 def _recompute_p_ds(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    qi, ki, causal: bool, scale: float, offset: int):
+                    qi, ki, causal: bool, scale: float, offset: int,
+                    shift_ref=None):
     """Shared backward recompute: rebuild the probability tile from
     (q, k, lse) under the same end-aligned causal mask as the forward and
     form ds = p * (dp - delta). Used by both the dq and dk/dv kernels so
     their masking/scaling can never desynchronize. Returns (p, ds, q, k,
-    do) as f32."""
+    do) as f32. ``delta`` may carry the lse cotangent folded in
+    (delta - g_lse) — d(lse)/d(logits) is the softmax itself."""
     bq = q_ref.shape[1]
     bk = k_ref.shape[1]
     q = q_ref[0].astype(jnp.float32)                  # [bq, d]
@@ -175,11 +199,13 @@ def _recompute_p_ds(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     do = do_ref[0].astype(jnp.float32)                # [bq, d]
     logits = jnp.dot(q, k.T,
                      preferred_element_type=jnp.float32) * scale
-    if causal:
+    if causal or shift_ref is not None:
         q_pos = offset + qi * bq + jax.lax.broadcasted_iota(
             jnp.int32, (bq, bk), 0)
         k_pos = ki * bk + jax.lax.broadcasted_iota(
             jnp.int32, (bq, bk), 1)
+        if shift_ref is not None:
+            q_pos = q_pos + shift_ref[0, 0]
         logits = jnp.where(q_pos >= k_pos, logits, NEG_INF)
     lse_row = jnp.max(lse_ref[0], axis=1, keepdims=True)
     p = jnp.exp(logits - lse_row)                     # exact softmax
@@ -189,9 +215,15 @@ def _recompute_p_ds(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     return p, ds, q, k, do
 
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   acc_ref, *, causal: bool, scale: float, nkb: int,
-                   offset: int):
+def _bwd_dq_kernel(*refs, causal: bool, scale: float, nkb: int,
+                   offset: int, dynamic_shift: bool):
+    if dynamic_shift:
+        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, shift_ref, \
+            dq_ref, acc_ref = refs
+    else:
+        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, \
+            dq_ref, acc_ref = refs
+        shift_ref = None
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     bq = q_ref.shape[1]
@@ -201,14 +233,14 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     def _init():
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    diag_ok = jnp.logical_or(not causal,
+    diag_ok = jnp.logical_or(not causal or dynamic_shift,
                              qi * bq + bq - 1 + offset >= ki * bk)
 
     @pl.when(diag_ok)
     def _compute():
         _, ds, _, k, _ = _recompute_p_ds(
             q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-            qi, ki, causal, scale, offset)
+            qi, ki, causal, scale, offset, shift_ref)
         acc_ref[:] += jnp.dot(ds, k,
                               preferred_element_type=jnp.float32) * scale
 
@@ -217,9 +249,15 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dq_ref[0] = acc_ref[:].astype(dq_ref.dtype)
 
 
-def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                     dk_ref, dv_ref, dk_acc, dv_acc, *, causal: bool,
-                     scale: float, nqb: int, offset: int):
+def _bwd_dkdv_kernel(*refs, causal: bool, scale: float, nqb: int,
+                     offset: int, dynamic_shift: bool):
+    if dynamic_shift:
+        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, shift_ref, \
+            dk_ref, dv_ref, dk_acc, dv_acc = refs
+    else:
+        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, \
+            dk_ref, dv_ref, dk_acc, dv_acc = refs
+        shift_ref = None
     ki = pl.program_id(1)
     qi = pl.program_id(2)
     bq = q_ref.shape[1]
@@ -230,14 +268,14 @@ def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
-    diag_ok = jnp.logical_or(not causal,
+    diag_ok = jnp.logical_or(not causal or dynamic_shift,
                              qi * bq + bq - 1 + offset >= ki * bk)
 
     @pl.when(diag_ok)
     def _compute():
         p, ds, q, _, do = _recompute_p_ds(
             q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-            qi, ki, causal, scale, offset)
+            qi, ki, causal, scale, offset, shift_ref)
         dv_acc[:] += jnp.dot(p.T, do,
                              preferred_element_type=jnp.float32)
         dk_acc[:] += jnp.dot(ds.T, q,
@@ -250,7 +288,8 @@ def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_bwd(q, k, v, out, lse, g, causal: bool, block_q: Optional[int],
-               block_k: int, interpret: bool):
+               block_k: Optional[int], interpret: bool, shift=None,
+               g_lse=None):
     b, s, h, d = q.shape
     scale = d ** -0.5
 
@@ -270,47 +309,68 @@ def _flash_bwd(q, k, v, out, lse, g, causal: bool, block_q: Optional[int],
     # O(S) like the lse, computed once outside the kernels.
     delta = jnp.sum(doh.astype(jnp.float32) * oh.astype(jnp.float32),
                     axis=-1)                               # [bh, s]
+    if g_lse is not None:
+        # lse cotangent (ring-block merges differentiate through lse):
+        # d lse / d logits = softmax = p, so it folds into delta —
+        # ds = p * (dp - (delta - g_lse)).
+        delta = delta - g_lse.astype(jnp.float32)
     # Lane-broadcast layout for per-row scalars (see _flash_fwd).
     delta_l = jnp.broadcast_to(delta[:, :, None], (b * h, s, _LANES))
     lse_l = jnp.broadcast_to(lse[:, :, None], (b * h, s, _LANES))
 
+    dynamic_shift = shift is not None
     q_spec = pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0))
     k_spec = pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0))
     row_spec = pl.BlockSpec((1, block_q, _LANES),
                             lambda bh, i, j: (bh, i, 0))
 
+    in_specs = [q_spec, k_spec, k_spec, q_spec, row_spec, row_spec]
+    inputs = [qh, kh, vh, doh, lse_l, delta_l]
+    if dynamic_shift:
+        shift_arr = jnp.broadcast_to(
+            jnp.asarray(shift, jnp.int32).reshape(1, 1), (1, _LANES))
+        in_specs.append(pl.BlockSpec((1, _LANES), lambda bh, i, j: (0, 0)))
+        inputs.append(shift_arr)
+
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, causal=causal, scale=scale,
-                          nkb=nkb, offset=offset),
+                          nkb=nkb, offset=offset,
+                          dynamic_shift=dynamic_shift),
         out_shape=jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
         grid=(b * h, nqb, nkb),
-        in_specs=[q_spec, k_spec, k_spec, q_spec, row_spec, row_spec],
+        in_specs=in_specs,
         out_specs=q_spec,
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=interpret,
-    )(qh, kh, vh, doh, lse_l, delta_l)
+    )(*inputs)
 
     # dk/dv: k-block outer, q-block innermost (sequential accumulation).
     q_spec2 = pl.BlockSpec((1, block_q, d), lambda bh, j, i: (bh, i, 0))
     k_spec2 = pl.BlockSpec((1, block_k, d), lambda bh, j, i: (bh, j, 0))
     row_spec2 = pl.BlockSpec((1, block_q, _LANES),
                              lambda bh, j, i: (bh, i, 0))
+    in_specs2 = [q_spec2, k_spec2, k_spec2, q_spec2, row_spec2, row_spec2]
+    inputs2 = [qh, kh, vh, doh, lse_l, delta_l]
+    if dynamic_shift:
+        in_specs2.append(pl.BlockSpec((1, _LANES), lambda bh, j, i: (0, 0)))
+        inputs2.append(shift_arr)
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkdv_kernel, causal=causal, scale=scale,
-                          nqb=nqb, offset=offset),
+                          nqb=nqb, offset=offset,
+                          dynamic_shift=dynamic_shift),
         out_shape=[
             jax.ShapeDtypeStruct((b * h, sk, d), k.dtype),
             jax.ShapeDtypeStruct((b * h, sk, d), v.dtype),
         ],
         grid=(b * h, nkb, nqb),
-        in_specs=[q_spec2, k_spec2, k_spec2, q_spec2, row_spec2, row_spec2],
+        in_specs=in_specs2,
         out_specs=[k_spec2, k_spec2],
         scratch_shapes=[
             pltpu.VMEM((block_k, d), jnp.float32),
             pltpu.VMEM((block_k, d), jnp.float32),
         ],
         interpret=interpret,
-    )(qh, kh, vh, doh, lse_l, delta_l)
+    )(*inputs2)
 
     def from_bh(x, seq):
         return x.reshape(b, h, seq, d).transpose(0, 2, 1, 3)
@@ -363,3 +423,52 @@ def _bwd_rule(causal, block_q, block_k, interpret, res, g):
 
 
 flash_attention.defvjp(_fwd_rule, _bwd_rule)
+
+
+# ------------------------------------------------------------- ring block
+#
+# The composable primitive ring attention needs: one flash pass against a
+# single K/V block with a TRACED mask selector, returning the
+# block-normalized output AND its per-row logsumexp so blocks merge
+# online-softmax style outside the kernel. ``shift`` (int32 scalar) picks
+# the mask: 0 = diagonal-causal, >= s_k = full attention, <= -s_q = fully
+# blocked (the block then carries lse ~ -inf and merges with zero
+# weight). Differentiable: the lse cotangent folds into the backward's
+# delta term (d lse / d logits is the softmax itself, so
+# ds = p * (dp - (delta - g_lse))).
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def flash_attention_block(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                          shift: jnp.ndarray,
+                          block_q: Optional[int] = None,
+                          block_k: Optional[int] = None,
+                          interpret: Optional[bool] = None):
+    """One flash pass with a traced shift mask; returns ``(out, lse)``
+    with ``out`` [B, S, H, D] block-normalized and ``lse`` [B*H, S]."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return _flash_fwd(q, k, v, False, block_q, block_k, interpret,
+                      shift=shift)
+
+
+def _block_fwd_rule(q, k, v, shift, block_q, block_k, interpret):
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    out, lse = _flash_fwd(q, k, v, False, block_q, block_k, interpret,
+                          shift=shift)
+    return (out, lse), (q, k, v, out, lse, shift)
+
+
+def _block_bwd_rule(block_q, block_k, interpret, res, g):
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    q, k, v, out, lse, shift = res
+    g_out, g_lse = g
+    dq, dk, dv = _flash_bwd(q, k, v, out, lse, g_out, False, block_q,
+                            block_k, interpret, shift=shift,
+                            g_lse=g_lse)
+    return dq, dk, dv, jnp.zeros(jnp.shape(shift),
+                                 dtype=jax.dtypes.float0)
+
+
+flash_attention_block.defvjp(_block_fwd_rule, _block_bwd_rule)

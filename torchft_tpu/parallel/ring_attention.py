@@ -33,57 +33,61 @@ NEG_INF = -1e30
 
 
 def _ring_body(q, k, v, axis: str, causal: bool):
-    """Local computation inside shard_map. q/k/v: [B, S_local, H, D]."""
+    """Local computation inside shard_map. q/k/v: [B, S_local, H, D].
+
+    Each K/V block is processed by the Pallas flash kernel
+    (:func:`~torchft_tpu.ops.flash_attention.flash_attention_block`) with
+    a traced shift selecting the block's mask — full for past blocks,
+    diagonal-causal for the resident block, fully-blocked for future ones
+    — and the block-normalized outputs merge online-softmax style via
+    their logsumexps. Per-device memory is O(tile), never
+    O(s_local^2)."""
+    from torchft_tpu.ops.flash_attention import flash_attention_block
+
     n = jax.lax.psum(1, axis)
     my = jax.lax.axis_index(axis)
-    scale = q.shape[-1] ** -0.5
-    qf = q.astype(jnp.float32) * scale
 
     b, s_loc, h, d = q.shape
-    m = jnp.full((b, h, s_loc, 1), NEG_INF, jnp.float32)
-    l = jnp.zeros((b, h, s_loc, 1), jnp.float32)
+    m_run = jnp.full((b * h, s_loc), NEG_INF, jnp.float32)
+    r = jnp.zeros((b * h, s_loc), jnp.float32)
     acc = jnp.zeros((b, s_loc, h, d), jnp.float32)
 
     # Block t holds K/V originating from device (my - t) mod n.
     perm = [(i, (i + 1) % n) for i in range(n)]
 
+    def per_row(x):  # [b*h, s] -> [b, s, h, 1] aligned with outputs
+        return x.reshape(b, h, s_loc).transpose(0, 2, 1)[..., None]
+
     def step(t, carry):
-        k_t, v_t, m, l, acc = carry
+        k_t, v_t, m_run, r, acc = carry
         src = (my - t) % n
-        logits = jnp.einsum("bqhd,bkhd->bhqk", qf,
-                            k_t.astype(jnp.float32),
-                            preferred_element_type=jnp.float32)
         if causal:
-            # Global block ordering: src > my → entirely in the future;
-            # src == my → the diagonal block, causal within.
-            q_pos = jax.lax.broadcasted_iota(jnp.int32,
-                                             (1, 1, s_loc, s_loc), 2)
-            k_pos = jax.lax.broadcasted_iota(jnp.int32,
-                                             (1, 1, s_loc, s_loc), 3)
-            diag_mask = q_pos >= k_pos
-            block_mask = jnp.where(
-                src == my, diag_mask,
-                jnp.where(src < my, jnp.ones_like(diag_mask),
-                          jnp.zeros_like(diag_mask)))
-            logits = jnp.where(block_mask, logits, NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(logits, axis=-1, keepdims=True))
-        p = jnp.exp(logits - m_new)  # [b,h,q,k]
-        corr = jnp.exp(m - m_new)
-        l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
-        pv = jnp.einsum("bhqk,bkhd->bqhd", p,
-                        v_t.astype(jnp.float32),
-                        preferred_element_type=jnp.float32)
-        acc = acc * corr.transpose(0, 2, 1, 3) + pv
+            # src < my → past block (full); src == my → diagonal
+            # (causal within); src > my → future (blocked; its lse comes
+            # back ~ -inf so it merges with weight 0).
+            shift = jnp.where(src < my, s_loc,
+                              jnp.where(src == my, 0, -s_loc))
+        else:
+            shift = jnp.int32(s_loc)
+        out_t, lse_t = flash_attention_block(q, k_t, v_t, shift)
+        # Online-softmax merge across blocks. t=0 is always the resident
+        # (diagonal) block, so m_run is real before any blocked block's
+        # ~-inf lse arrives — their weights underflow to exactly 0.
+        m_new = jnp.maximum(m_run, lse_t)
+        c = jnp.exp(m_run - m_new)
+        w = jnp.exp(lse_t - m_new)
+        r = r * c + w
+        acc = acc * per_row(c) + per_row(w) * out_t.astype(jnp.float32)
         # Rotate K/V to the next device. (The final rotation restores the
         # original placement; keeping it unconditional avoids a collective
         # inside lax.cond, which XLA cannot partition correctly.)
         k_t = jax.lax.ppermute(k_t, axis, perm)
         v_t = jax.lax.ppermute(v_t, axis, perm)
-        return k_t, v_t, m_new, l, acc
+        return k_t, v_t, m_new, r, acc
 
-    _, _, m, l, acc = jax.lax.fori_loop(
-        0, n, step, (k, v, m, l, acc), unroll=True)
-    out = acc / jnp.maximum(l, 1e-30).transpose(0, 2, 1, 3)
+    _, _, m_run, r, acc = jax.lax.fori_loop(
+        0, n, step, (k, v, m_run, r, acc), unroll=True)
+    out = acc / per_row(jnp.maximum(r, 1e-30))
     return out.astype(q.dtype)
 
 
