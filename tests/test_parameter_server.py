@@ -4,6 +4,7 @@ reference's parameter_server_test.py (client/server session, collectives
 both ways, session isolation on failure)."""
 
 import threading
+import time
 
 import numpy as np
 import pytest
@@ -41,6 +42,18 @@ class EchoPS(ParameterServer):
             raise
 
 
+
+
+def wait_for(predicate, timeout=20.0):
+    """The server's session thread finishes (and bumps its counters) a
+    beat after the client's last collective resolves — poll, don't race."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.05)
+    return predicate()
+
 @pytest.fixture
 def ps():
     server = EchoPS()
@@ -62,7 +75,7 @@ class TestParameterServer:
             np.testing.assert_allclose(mean["w"], [1, 2, 3, 4])
         finally:
             comm.shutdown()
-        assert ps.sessions_served == 1
+        assert wait_for(lambda: ps.sessions_served == 1)
         np.testing.assert_allclose(ps.weights["w"], [1, 2, 3, 4])
 
     def test_sequential_sessions_accumulate(self, ps):
@@ -74,6 +87,7 @@ class TestParameterServer:
                 comm.allreduce({"w": got["w"]}, op="mean").result(timeout=30)
             finally:
                 comm.shutdown()
+            assert wait_for(lambda: ps.sessions_served == k + 1)
         assert ps.sessions_served == 3
         # each session averaged identical trees: weights unchanged
         np.testing.assert_allclose(ps.weights["w"], [0, 1, 2, 3])
@@ -87,12 +101,7 @@ class TestParameterServer:
         dead.shutdown()  # dies before the allreduce
 
         # wait for the server's session thread to observe the death
-        deadline = threading.Event()
-        for _ in range(100):
-            if ps.session_errors >= 1:
-                break
-            deadline.wait(0.2)
-        assert ps.session_errors == 1
+        assert wait_for(lambda: ps.session_errors == 1)
 
         comm = EchoPS.new_session(ps.address())
         try:
@@ -102,7 +111,7 @@ class TestParameterServer:
             comm.allreduce({"w": got["w"]}, op="mean").result(timeout=30)
         finally:
             comm.shutdown()
-        assert ps.sessions_served == 1
+        assert wait_for(lambda: ps.sessions_served == 1)
 
     def test_concurrent_sessions_are_isolated(self, ps):
         """Two clients in flight at once: per-session store prefixes keep
@@ -128,7 +137,7 @@ class TestParameterServer:
         assert len(results) == 2
         for r in results.values():
             np.testing.assert_allclose(r["w"], [0, 1, 2, 3])
-        assert ps.sessions_served == 2
+        assert wait_for(lambda: ps.sessions_served == 2)
 
     def test_bad_path_404(self, ps):
         import urllib.error
