@@ -1,3 +1,6 @@
-from torchft_tpu.ops.flash_attention import flash_attention
+from torchft_tpu.ops.flash_attention import (
+    flash_attention,
+    flash_attention_block,
+)
 
-__all__ = ["flash_attention"]
+__all__ = ["flash_attention", "flash_attention_block"]

@@ -7,6 +7,10 @@ accumulators with bf16 inputs. This is new scope relative to the reference
 because long-context is first-class in the TPU build and the plain
 attention in :mod:`torchft_tpu.models.transformer` is HBM-bound at long S.
 
+Measured (v5e, bf16, H=8 D=128, fwd+backward, auto tiles): S=16384 at
+32 ms / 59 TFLOP/s; S=65536 at 334 ms / 92 TFLOP/s (47% of bf16 peak) —
+dense attention at S=64k would need a 34 GB score matrix per head-batch.
+
 Kernel structure: grid (batch*heads, q_blocks, k_blocks). The innermost
 (k) grid dimension is sequential on a TPU core, so the running
 (max, sum, acc) statistics live in VMEM scratch that persists across k
