@@ -326,7 +326,8 @@ class TestMeshCommunicator:
         from torchft_tpu.backends.mesh import MeshCommunicator, MeshWorld
 
         world = MeshWorld(num_groups=n, timeout_sec=timeout)
-        return world, [MeshCommunicator(world, group_index=i)
+        return world, [MeshCommunicator(world, group_index=i,
+                                        timeout_sec=timeout)
                        for i in range(n)]
 
     def test_full_membership_allreduce_on_device(self):

@@ -39,8 +39,23 @@ def _build_native() -> None:
     )
 
 
-def _load() -> ctypes.CDLL:
+def _stale() -> bool:
+    """True when any C++ source/header/proto is newer than the built .so —
+    calling a stale library through changed ctypes signatures is an ABI
+    mismatch (garbage args or a segfault), so rebuild instead."""
     if not os.path.exists(_LIB_PATH):
+        return True
+    built = os.path.getmtime(_LIB_PATH)
+    for name in os.listdir(_CORE_DIR):
+        if name.endswith((".cc", ".h")):
+            if os.path.getmtime(os.path.join(_CORE_DIR, name)) > built:
+                return True
+    proto = os.path.join(_CORE_DIR, "proto", "torchft.proto")
+    return os.path.exists(proto) and os.path.getmtime(proto) > built
+
+
+def _load() -> ctypes.CDLL:
+    if _stale():
         _build_native()
     lib = ctypes.CDLL(_LIB_PATH)
 

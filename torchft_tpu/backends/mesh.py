@@ -47,6 +47,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from torchft_tpu.communicator import Communicator, CommunicatorError
+from torchft_tpu.utils import div_by_count
 from torchft_tpu.backends.host import HostCommunicator
 
 logger: logging.Logger = logging.getLogger(__name__)
@@ -93,19 +94,23 @@ class MeshWorld:
     # ------------------------------------------------------------ rendezvous
 
     def contribute(self, key: Tuple, rank: int, world: int, kind: str,
-                   payload: Any, extra: Any = None) -> Future:
+                   payload: Any, extra: Any = None,
+                   timeout_sec: Optional[float] = None) -> Future:
         """Contribute rank's payload to the collective identified by
         ``key``; the future resolves (on the last contributor's thread)
         once all ``world`` ranks have arrived, or fails after
-        ``timeout_sec`` if a peer never does (peer death -> commit vote)."""
+        ``timeout_sec`` (default: the world's) if a peer never does
+        (peer death -> commit vote)."""
         fut: Future = Future()
         with self._lock:
             entry = self._pending.get(key)
             if entry is None:
                 entry = _Collect(kind, world)
                 self._pending[key] = entry
-                entry.timer = threading.Timer(self.timeout_sec,
-                                              self._expire, args=(key,))
+                entry.timer = threading.Timer(
+                    timeout_sec if timeout_sec is not None
+                    else self.timeout_sec,
+                    self._expire, args=(key,))
                 entry.timer.daemon = True
                 entry.timer.start()
             if entry.kind != kind or entry.world != world:
@@ -173,13 +178,8 @@ class MeshWorld:
             summed = _jit_tree_sum(*_co_locate(trees))
             op = next(iter(entry.extra.values()))
             if op == "mean":
-                # jnp.issubdtype, not np: bfloat16 (ml_dtypes) is not
-                # np.inexact and would silently floor-divide to zero.
                 summed = jax.tree_util.tree_map(
-                    lambda a: (a / entry.world).astype(a.dtype)
-                    if jnp.issubdtype(a.dtype, jnp.inexact)
-                    else a // entry.world,
-                    summed)
+                    lambda a: div_by_count(a, entry.world), summed)
             for rank in ranks:
                 fut, inp = entry.futures[rank]
                 fut.set_result(_place_like(summed, inp))
@@ -251,7 +251,8 @@ class MeshCommunicator(Communicator):
             (informational; collective rank comes from ``configure``).
         fallback: the elastic backend for partial membership. Defaults to a
             fresh :class:`HostCommunicator`.
-        timeout_sec: collective timeout in mesh mode.
+        timeout_sec: collective timeout, applied in both modes (mesh
+            rendezvous timer and host fallback).
     """
 
     def __init__(self, world: MeshWorld, group_index: int = 0,
@@ -334,7 +335,7 @@ class MeshCommunicator(Communicator):
             return _done(tree)
         return self._mesh_world.contribute(
             self._key("allreduce"), self._rank, self._size, "allreduce",
-            tree, extra=op)
+            tree, extra=op, timeout_sec=self._timeout_sec)
 
     def broadcast(self, tree: Any, root: int = 0) -> Future:
         if self._mode == "host":
@@ -343,7 +344,7 @@ class MeshCommunicator(Communicator):
             return _done(tree)
         return self._mesh_world.contribute(
             self._key("broadcast"), self._rank, self._size, "broadcast",
-            tree, extra=root)
+            tree, extra=root, timeout_sec=self._timeout_sec)
 
     def allgather(self, tree: Any) -> Future:
         if self._mode == "host":
@@ -352,7 +353,7 @@ class MeshCommunicator(Communicator):
             return _done([tree])
         return self._mesh_world.contribute(
             self._key("allgather"), self._rank, self._size, "allgather",
-            tree)
+            tree, timeout_sec=self._timeout_sec)
 
     # ------------------------------------------------------------- accessors
 

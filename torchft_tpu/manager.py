@@ -52,7 +52,7 @@ import jax.numpy as jnp
 from torchft_tpu._native import ManagerClient, ManagerServer, Store, StoreClient
 from torchft_tpu.checkpointing import CheckpointServer
 from torchft_tpu.communicator import Communicator
-from torchft_tpu.utils import advertise_host
+from torchft_tpu.utils import advertise_host, div_by_count
 
 logger: logging.Logger = logging.getLogger(__name__)
 
@@ -445,15 +445,7 @@ class Manager:
                         n)
                 placed = []
                 for inp, a in zip(leaves, out_leaves):
-                    # .dtype directly: np.asarray on a device array would
-                    # force a host transfer just to read the dtype. And
-                    # jnp.issubdtype, not np: bfloat16 (ml_dtypes) is not
-                    # np.inexact, and floor-dividing grads by n stalls
-                    # training silently.
-                    if jnp.issubdtype(a.dtype, jnp.inexact):
-                        a = (a / n).astype(a.dtype)
-                    else:
-                        a = a // n
+                    a = div_by_count(a, n)
                     # Leaves come back placed like the inputs: device arrays
                     # return to their sharding (the update consumes them
                     # on-device anyway), host arrays stay host.
@@ -653,10 +645,7 @@ class Manager:
 def _scale_tree(tree: Any, n: Any) -> Any:
     """sum -> mean by live participant count, one fused computation; jit
     caches per tree structure, n is traced."""
-    return jax.tree_util.tree_map(
-        lambda a: (a / n).astype(a.dtype)
-        if jnp.issubdtype(a.dtype, jnp.inexact) else a // n,
-        tree)
+    return jax.tree_util.tree_map(lambda a: div_by_count(a, n), tree)
 
 
 def _instant(value: Any) -> Future:

@@ -108,16 +108,22 @@ def _fwd_kernel(*refs, causal: bool, scale: float, nkb: int, offset: int,
 
 def _auto_block(seq: int, cap: int = 1024) -> int:
     """Largest power-of-two tile <= cap dividing ``seq`` (>= 128); short
-    sequences fall back to one whole-sequence tile. Measured on a v5e at
-    S=16k: 1024-tiles run the fwd+bwd 2.5x faster than 256-tiles (more
-    MXU work per grid step, fewer HBM round-trips for the running
-    stats)."""
+    sequences get one whole-sequence tile. Measured on a v5e at S=16k:
+    1024-tiles run the fwd+bwd 2.5x faster than 256-tiles (more MXU work
+    per grid step, fewer HBM round-trips for the running stats).
+
+    A LONG seq with no power-of-two divisor (e.g. 6000) returns 128 so
+    the divisibility assert fires with a clear message — silently tiling
+    the whole sequence would blow VMEM instead. Odd seqs up to ``cap``
+    still get the whole-sequence tile (VMEM-safe)."""
+    if seq <= 128:
+        return seq
     b = cap
     while b >= 128:
         if seq % b == 0:
             return b
         b //= 2
-    return seq
+    return seq if seq <= cap else 128
 
 
 def _flash_fwd(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,

@@ -6,6 +6,22 @@ import os
 import socket
 
 
+def div_by_count(a, n):
+    """Divide a reduced leaf by the participant count, dtype-aware.
+
+    True-divide + cast back for inexact dtypes — via ``jnp.issubdtype``,
+    because bfloat16 (ml_dtypes) is NOT ``np.inexact`` and would silently
+    floor sub-1.0 gradients to zero under the integer branch — and
+    floor-divide for integers. The single spelling of this rule; used by
+    the manager's 1/n scaling (host and jitted device paths) and the mesh
+    backend's mean reduction."""
+    import jax.numpy as jnp
+
+    if jnp.issubdtype(a.dtype, jnp.inexact):
+        return (a / n).astype(a.dtype)
+    return a // n
+
+
 def force_cpu_devices(n: int) -> None:
     """Rebuild JAX on an ``n``-device virtual CPU platform.
 
