@@ -71,6 +71,33 @@ class TestFlashAttention:
                                        atol=2e-4, rtol=2e-4)
 
 
+class TestFlashAttentionGQA:
+    """GQA/MQA kv heads are shared via kernel index maps — values and
+    gradients must match the materialized-repeat path exactly."""
+
+    @pytest.mark.parametrize("h_kv", [1, 2])
+    def test_matches_repeat_path(self, h_kv):
+        q, _, _ = qkv(s=32, h=8)
+        _, k, v = qkv(s=32, h=h_kv, seed=3)
+        rep = 8 // h_kv
+
+        def loss_gqa(q, k, v):
+            return jnp.sum(flash_attention(q, k, v, True, 8, 8) ** 2)
+
+        def loss_rep(q, k, v):
+            return jnp.sum(flash_attention(
+                q, jnp.repeat(k, rep, 2), jnp.repeat(v, rep, 2),
+                True, 8, 8) ** 2)
+
+        np.testing.assert_allclose(float(loss_gqa(q, k, v)),
+                                   float(loss_rep(q, k, v)), rtol=1e-5)
+        g1 = jax.grad(loss_gqa, argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(loss_rep, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-5, atol=1e-5)
+
+
 class TestFlashAttentionBlock:
     """The ring-attention building block: one flash pass against a K/V
     block with a TRACED mask shift, returning (out, lse) for

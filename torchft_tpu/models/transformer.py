@@ -116,11 +116,15 @@ class Attention(nn.Module):
         v = dense((cfg.kv_heads, cfg.head_dim), "v")(x)
         q = rotary(q, positions, cfg.rope_theta)
         k = rotary(k, positions, cfg.rope_theta)
-        if cfg.kv_heads != cfg.num_heads:  # GQA: repeat kv heads
+        attn = cfg.attention_fn or plain_attention
+        if (cfg.kv_heads != cfg.num_heads
+                and not getattr(attn, "supports_gqa", False)):
+            # GQA: repeat kv heads for impls that need equal head counts.
+            # The flash kernel shares them via index maps instead — no
+            # H/H_kv-times kv memory blowup.
             rep = cfg.num_heads // cfg.kv_heads
             k = jnp.repeat(k, rep, axis=2)
             v = jnp.repeat(v, rep, axis=2)
-        attn = cfg.attention_fn or plain_attention
         out = attn(q, k, v, True)
         out = out.reshape(B, S, cfg.num_heads * cfg.head_dim)
         return nn.DenseGeneral(cfg.embed_dim, use_bias=False,

@@ -131,13 +131,17 @@ def _flash_fwd(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                interpret: bool,
                shift: Optional[jnp.ndarray] = None) -> jnp.ndarray:
     b, s, h, d = q.shape
+    h_kv = k.shape[2]
+    assert h % h_kv == 0, f"num_heads {h} not a multiple of kv heads {h_kv}"
+    rep = h // h_kv
     scale = d ** -0.5
     block_q = block_q or _auto_block(s)
     block_k = block_k or _auto_block(k.shape[1])
     dynamic_shift = shift is not None
 
     def to_bh(x):
-        return x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
+        bh = x.shape[0] * x.shape[2]
+        return x.transpose(0, 2, 1, 3).reshape(bh, x.shape[1], d)
 
     qh, kh, vh = to_bh(q), to_bh(k), to_bh(v)
     sk = kh.shape[1]
@@ -151,11 +155,16 @@ def _flash_fwd(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         f"got s={s}, sk={sk}, block_q={block_q}, block_k={block_k}")
     nkb = sk // block_k
 
+    # GQA is an index-map concern, not a data one: query row bi*h + hi
+    # reads K/V row bi*h_kv + hi//rep — no materialized jnp.repeat.
+    def kv_row(bh):
+        return (bh // h) * h_kv + (bh % h) // rep
+
     grid = (b * h, s // block_q, nkb)
     in_specs = [
         pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
-        pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0)),
-        pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0)),
+        pl.BlockSpec((1, block_k, d), lambda bh, i, j: (kv_row(bh), j, 0)),
+        pl.BlockSpec((1, block_k, d), lambda bh, i, j: (kv_row(bh), j, 0)),
     ]
     inputs = [qh, kh, vh]
     if dynamic_shift:
@@ -301,10 +310,16 @@ def _flash_bwd(q, k, v, out, lse, g, causal: bool, block_q: Optional[int],
                block_k: Optional[int], interpret: bool, shift=None,
                g_lse=None):
     b, s, h, d = q.shape
+    h_kv = k.shape[2]
+    rep = h // h_kv
     scale = d ** -0.5
 
     def to_bh(x):
-        return x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
+        bh = x.shape[0] * x.shape[2]
+        return x.transpose(0, 2, 1, 3).reshape(bh, x.shape[1], d)
+
+    def kv_row(bh):
+        return (bh // h) * h_kv + (bh % h) // rep
 
     qh, kh, vh = to_bh(q), to_bh(k), to_bh(v)
     doh, oh = to_bh(g), to_bh(out)
@@ -330,7 +345,8 @@ def _flash_bwd(q, k, v, out, lse, g, causal: bool, block_q: Optional[int],
 
     dynamic_shift = shift is not None
     q_spec = pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0))
-    k_spec = pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0))
+    k_spec = pl.BlockSpec((1, block_k, d),
+                          lambda bh, i, j: (kv_row(bh), j, 0))
     row_spec = pl.BlockSpec((1, block_q, _LANES),
                             lambda bh, i, j: (bh, i, 0))
 
@@ -355,11 +371,17 @@ def _flash_bwd(q, k, v, out, lse, g, causal: bool, block_q: Optional[int],
     )(*inputs)
 
     # dk/dv: k-block outer, q-block innermost (sequential accumulation).
+    # Outputs are per QUERY head (each grid row writes its own block, no
+    # cross-row accumulation hazards); GQA reduces over the rep query
+    # heads sharing a kv head afterwards, outside the kernel.
     q_spec2 = pl.BlockSpec((1, block_q, d), lambda bh, j, i: (bh, i, 0))
-    k_spec2 = pl.BlockSpec((1, block_k, d), lambda bh, j, i: (bh, j, 0))
+    k_in_spec2 = pl.BlockSpec((1, block_k, d),
+                              lambda bh, j, i: (kv_row(bh), j, 0))
+    k_out_spec2 = pl.BlockSpec((1, block_k, d), lambda bh, j, i: (bh, j, 0))
     row_spec2 = pl.BlockSpec((1, block_q, _LANES),
                              lambda bh, j, i: (bh, i, 0))
-    in_specs2 = [q_spec2, k_spec2, k_spec2, q_spec2, row_spec2, row_spec2]
+    in_specs2 = [q_spec2, k_in_spec2, k_in_spec2, q_spec2, row_spec2,
+                 row_spec2]
     inputs2 = [qh, kh, vh, doh, lse_l, delta_l]
     if dynamic_shift:
         in_specs2.append(pl.BlockSpec((1, _LANES), lambda bh, j, i: (0, 0)))
@@ -374,7 +396,7 @@ def _flash_bwd(q, k, v, out, lse, g, causal: bool, block_q: Optional[int],
         ],
         grid=(b * h, nkb, nqb),
         in_specs=in_specs2,
-        out_specs=[k_spec2, k_spec2],
+        out_specs=[k_out_spec2, k_out_spec2],
         scratch_shapes=[
             pltpu.VMEM((block_k, d), jnp.float32),
             pltpu.VMEM((block_k, d), jnp.float32),
@@ -385,7 +407,16 @@ def _flash_bwd(q, k, v, out, lse, g, causal: bool, block_q: Optional[int],
     def from_bh(x, seq):
         return x.reshape(b, h, seq, d).transpose(0, 2, 1, 3)
 
-    return from_bh(dq, s), from_bh(dk, sk), from_bh(dv, sk)
+    def kv_from_bh(x, seq):
+        # [b*h, seq, d] per query head -> sum the rep heads sharing each
+        # kv head -> [b, seq, h_kv, d]
+        x = x.reshape(b, h_kv, rep, seq, d)
+        x = x.astype(jnp.float32).sum(axis=2)
+        return x.transpose(0, 2, 1, 3).astype(k.dtype)
+
+    if rep == 1:
+        return from_bh(dq, s), from_bh(dk, sk), from_bh(dv, sk)
+    return from_bh(dq, s), kv_from_bh(dk, sk), kv_from_bh(dv, sk)
 
 
 def _reference(q, k, v, causal):
@@ -405,10 +436,11 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                     causal: bool = True, block_q: Optional[int] = None,
                     block_k: Optional[int] = None,
                     interpret: Optional[bool] = None) -> jnp.ndarray:
-    """Flash attention. q/k/v: [B, S, H, D] (same H — repeat GQA kv heads
-    first). ``block_q/block_k=None`` auto-picks the largest power-of-two
-    tile (<=1024) dividing the sequence; ``interpret=None`` auto-selects
-    interpreter mode off-TPU."""
+    """Flash attention. q: [B, S, H, D]; k/v: [B, S_k, H_kv, D] with H_kv
+    dividing H — GQA/MQA kv heads are shared via kernel index maps, never
+    materialized with a repeat. ``block_q/block_k=None`` auto-picks the
+    largest power-of-two tile (<=1024) dividing the sequence;
+    ``interpret=None`` auto-selects interpreter mode off-TPU."""
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     out, _ = _flash_fwd(q, k, v, causal, block_q, block_k, interpret)
@@ -433,6 +465,9 @@ def _bwd_rule(causal, block_q, block_k, interpret, res, g):
 
 
 flash_attention.defvjp(_fwd_rule, _bwd_rule)
+# Consumers (models.transformer.Attention) check this to skip the GQA
+# kv-head repeat — the kernel shares kv heads via its index maps.
+flash_attention.supports_gqa = True
 
 
 # ------------------------------------------------------------- ring block
