@@ -75,6 +75,27 @@ class TestFlashAttentionGQA:
     """GQA/MQA kv heads are shared via kernel index maps — values and
     gradients must match the materialized-repeat path exactly."""
 
+    def test_ring_attention_gqa(self):
+        """Ring attention with GQA kv heads matches plain attention; the
+        ring rotates the SMALL kv tensors."""
+        from torchft_tpu.models.transformer import plain_attention
+        from torchft_tpu.parallel import make_ring_attention
+        from torchft_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh({"sp": 4}, devices=jax.devices()[:4])
+        ring = make_ring_attention(mesh, axis="sp", batch_axes=())
+        assert ring.supports_gqa
+        q, _, _ = qkv(s=32, h=8)
+        _, k, v = qkv(s=32, h=2, seed=5)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        sh = NamedSharding(mesh, P(None, "sp", None, None))
+        qs, ks, vs = (jax.device_put(x, sh) for x in (q, k, v))
+        out = ring(qs, ks, vs, True)
+        ref = plain_attention(q, k, v, True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-4)
+
     @pytest.mark.parametrize("h_kv", [1, 2])
     def test_matches_repeat_path(self, h_kv):
         q, _, _ = qkv(s=32, h=8)

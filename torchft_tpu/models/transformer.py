@@ -90,7 +90,12 @@ def rotary(x: jnp.ndarray, positions: jnp.ndarray,
 
 
 def plain_attention(q, k, v, causal: bool = True):
-    """Reference softmax attention; q,k,v: [B, S, H, D] (f32 softmax)."""
+    """Reference softmax attention; q: [B, S, H, D], k/v may carry fewer
+    (GQA) heads — repeated here (f32 softmax)."""
+    if k.shape[2] != q.shape[2]:
+        rep = q.shape[2] // k.shape[2]
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
     scale = q.shape[-1] ** -0.5
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
                         preferred_element_type=jnp.float32) * scale
@@ -100,6 +105,9 @@ def plain_attention(q, k, v, causal: bool = True):
         logits = jnp.where(mask, logits, -1e30)
     probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+plain_attention.supports_gqa = True
 
 
 class Attention(nn.Module):

@@ -118,4 +118,8 @@ def make_ring_attention(
         )
         return fn(q, k, v)
 
+    # Per-block compute is the GQA-capable flash kernel (and the sp=1
+    # fallback repeats internally), so callers need not repeat kv heads —
+    # the ring then rotates H/H_kv-times less K/V over the interconnect.
+    attention.supports_gqa = True
     return attention
