@@ -157,6 +157,25 @@ def test_lighthouse_status():
         lh.shutdown()
 
 
+def test_heartbeat_grace_options_plumbed():
+    """The straggler-grace knobs reach the C++ lighthouse (the grace
+    semantics themselves are covered by core_test.cc); factor=1 restores
+    reference behavior and must still form quorums."""
+    lh = Lighthouse(bind="127.0.0.1:0", min_replicas=1, join_timeout_ms=50,
+                    quorum_tick_ms=10, heartbeat_fresh_ms=200,
+                    heartbeat_grace_factor=1)
+    try:
+        m = ManagerServer("plumb", lh.address(), bind="127.0.0.1:0",
+                          world_size=1)
+        c = ManagerClient(m.address())
+        q = c.quorum(rank=0, step=1, checkpoint_server_addr="x",
+                     timeout_ms=10_000)
+        assert q.replica_world_size == 1
+        m.shutdown()
+    finally:
+        lh.shutdown()
+
+
 def test_step_retry_gets_fresh_rounds():
     """After a failed commit the Manager retries the SAME step; both the
     quorum and the vote must run fresh rounds, not replay the stale result
