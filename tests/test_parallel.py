@@ -141,6 +141,37 @@ class TestPresets:
         assert cfg.kv_heads == 8 and cfg.num_heads == 64
         assert cfg.head_dim == 128  # MXU-tile friendly
 
+    def test_chunked_lm_loss_matches_full(self):
+        """chunked_causal_lm_loss never materializes [B, S, vocab] (the
+        biggest allocation in LM training) yet must match the full loss
+        and gradients — including a non-chunk-divisible sequence, which
+        exercises the masked padding path."""
+        from torchft_tpu.models import (Transformer, causal_lm_loss,
+                                        chunked_causal_lm_loss, tiny_config)
+
+        model = Transformer(tiny_config())
+        tokens = jnp.asarray(
+            np.random.default_rng(0).integers(0, 256, (2, 50)), jnp.int32)
+        params = model.init(jax.random.key(0), tokens)
+
+        def loss_full(p):
+            return causal_lm_loss(model.apply(p, tokens), tokens)
+
+        def loss_chunked(p):
+            hid = model.apply(p, tokens, return_hidden=True)
+            return chunked_causal_lm_loss(
+                hid, p["params"]["lm_head"]["kernel"], tokens,
+                chunk_size=16)
+
+        lf, gf = jax.jit(jax.value_and_grad(loss_full))(params)
+        lc, gc = jax.jit(jax.value_and_grad(loss_chunked))(params)
+        np.testing.assert_allclose(float(lf), float(lc), rtol=1e-6)
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a, np.float32), np.asarray(b, np.float32),
+                rtol=1e-5, atol=1e-6),
+            gf, gc)
+
     def test_remat_matches_plain_gradients(self):
         """cfg.remat trades backward FLOPs for activation memory; values
         and gradients must be bitwise-stable vs the plain path."""

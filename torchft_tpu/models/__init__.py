@@ -5,6 +5,7 @@ from torchft_tpu.models.transformer import (
     Transformer,
     TransformerConfig,
     causal_lm_loss,
+    chunked_causal_lm_loss,
     llama2_7b_config,
     llama2_13b_config,
     llama2_70b_config,
@@ -25,6 +26,7 @@ __all__ = [
     "Transformer",
     "TransformerConfig",
     "causal_lm_loss",
+    "chunked_causal_lm_loss",
     "llama2_7b_config",
     "llama2_13b_config",
     "llama2_70b_config",

@@ -177,7 +177,12 @@ class Transformer(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, tokens):
+    def __call__(self, tokens, return_hidden: bool = False):
+        """``return_hidden=True`` skips the LM head and returns the
+        final-norm hidden states [B, S, E] — pair with
+        :func:`chunked_causal_lm_loss` so the [B, S, vocab] logits tensor
+        (the largest allocation in LM training; ~2 GB at B=16 S=2048
+        V=32k in f32) never materializes."""
         cfg = self.cfg
         x = nn.Embed(cfg.vocab_size, cfg.embed_dim,
                      dtype=cfg.dtype, name="embed")(tokens)
@@ -188,6 +193,8 @@ class Transformer(nn.Module):
         for i in range(cfg.num_layers):
             x = layer_cls(cfg, name=f"layer_{i}")(x, positions)
         x = RMSNorm(name="final_norm")(x)
+        if return_hidden:
+            return x
         # tied-untied head in f32 for stable loss
         return nn.Dense(cfg.vocab_size, use_bias=False, dtype=jnp.float32,
                         name="lm_head")(x)
@@ -267,6 +274,45 @@ def causal_lm_loss(logits: jnp.ndarray, tokens: jnp.ndarray) -> jnp.ndarray:
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
     return jnp.mean(nll)
+
+
+def chunked_causal_lm_loss(hidden: jnp.ndarray, head_kernel: jnp.ndarray,
+                           tokens: jnp.ndarray,
+                           chunk_size: int = 256) -> jnp.ndarray:
+    """Next-token cross-entropy WITHOUT materializing [B, S, vocab].
+
+    The full-logits tensor is the largest allocation in LM training
+    (B=16, S=2048, V=32k → 2 GB in f32, live through the log-softmax
+    backward). This computes the head matmul + log-softmax per sequence
+    chunk under ``jax.checkpoint`` inside a scan, so both passes peak at
+    one [B, chunk, V] tile. Use with
+    ``model.apply(params, tokens, return_hidden=True)`` and the
+    ``lm_head`` kernel from params.
+    """
+    b, s, e = hidden.shape
+    h = hidden[:, :-1]
+    t = tokens[:, 1:]
+    s1 = s - 1
+    n_chunks = -(-s1 // chunk_size)
+    pad = n_chunks * chunk_size - s1
+    h = jnp.pad(h, ((0, 0), (0, pad), (0, 0)))
+    t = jnp.pad(t, ((0, 0), (0, pad)))
+    mask = jnp.pad(jnp.ones((b, s1), jnp.float32), ((0, 0), (0, pad)))
+    hc = h.reshape(b, n_chunks, chunk_size, e).transpose(1, 0, 2, 3)
+    tc = t.reshape(b, n_chunks, chunk_size).transpose(1, 0, 2)
+    mc = mask.reshape(b, n_chunks, chunk_size).transpose(1, 0, 2)
+
+    def body(carry, xs):
+        h_c, t_c, m_c = xs
+        logits = jnp.einsum("bce,ev->bcv", h_c.astype(jnp.float32),
+                            head_kernel.astype(jnp.float32))
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, t_c[..., None], axis=-1)[..., 0]
+        return carry + jnp.sum(nll * m_c), None
+
+    total, _ = jax.lax.scan(jax.checkpoint(body), jnp.float32(0.0),
+                            (hc, tc, mc))
+    return total / (b * s1)
 
 
 def moe_lm_loss(model: "Transformer", params: Any,
