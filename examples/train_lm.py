@@ -45,7 +45,8 @@ from jax.sharding import NamedSharding  # noqa: E402
 from torchft_tpu import HostCommunicator, Manager  # noqa: E402
 from torchft_tpu.data import BatchIterator, DistributedSampler  # noqa: E402
 from torchft_tpu.models import (Transformer, TransformerConfig,  # noqa: E402
-                                causal_lm_loss, tiny_config, tp_rules)
+                                chunked_causal_lm_loss, tiny_config,
+                                tp_rules)
 from torchft_tpu.parallel import (FTTrainer, batch_spec,  # noqa: E402
                                   combined_shardings, make_mesh)
 
@@ -95,8 +96,13 @@ def main() -> None:
     batches = BatchIterator({"tokens": tokens_data}, sampler)
 
     def loss_fn(params, batch):
-        return causal_lm_loss(model.apply(params, batch["tokens"]),
-                              batch["tokens"])
+        # Chunked loss: the [B, S, vocab] logits tensor (LM training's
+        # largest allocation) never materializes — essential at the 7B
+        # config's 32k vocab.
+        hidden = model.apply(params, batch["tokens"], return_hidden=True)
+        return chunked_causal_lm_loss(
+            hidden, params["params"]["lm_head"]["kernel"],
+            batch["tokens"])
 
     params = model.init(jax.random.key(0),
                         jnp.zeros((1, seq_len), jnp.int32))
