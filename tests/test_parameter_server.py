@@ -10,7 +10,6 @@ import numpy as np
 import pytest
 
 from torchft_tpu.backends.host import HostCommunicator
-from torchft_tpu.communicator import CommunicatorError
 from torchft_tpu.parameter_server import ParameterServer
 
 

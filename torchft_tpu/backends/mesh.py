@@ -43,7 +43,6 @@ from concurrent.futures import Future
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 
 from torchft_tpu.communicator import Communicator, CommunicatorError

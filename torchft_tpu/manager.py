@@ -47,7 +47,6 @@ from typing import Any, Callable, Dict, Optional, TypeVar, cast
 
 import numpy as np
 import jax
-import jax.numpy as jnp
 
 from torchft_tpu._native import ManagerClient, ManagerServer, Store, StoreClient
 from torchft_tpu.checkpointing import CheckpointServer
