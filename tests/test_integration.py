@@ -78,6 +78,7 @@ def run_group(
         comm_factory = lambda: HostCommunicator(timeout_sec=15)  # noqa: E731
 
     last_exc = None
+    commits = []  # (step, quorum_id, num_participants) per committed step
     for attempt in range(attempts):
         params = model.init(jax.random.key(42), jnp.zeros((1, 8)))
         trainer = FTTrainer(
@@ -110,11 +111,16 @@ def run_group(
                     batches = iter(sampler)
                     idx = next(batches)
                 injector.check(trainer.manager.current_step() + 1)
-                trainer.train_step({"x": x[idx], "y": y[idx]})
+                _, committed = trainer.train_step({"x": x[idx], "y": y[idx]})
+                if committed:
+                    commits.append((trainer.manager.current_step(),
+                                    trainer.manager.quorum_id(),
+                                    trainer.manager.num_participants()))
             return {
                 "params": jax.device_get(trainer.params),
                 "step": trainer.manager.current_step(),
                 "batches_committed": trainer.manager.batches_committed(),
+                "commits": commits,
             }
         except InjectedFailure as e:
             last_exc = e
@@ -274,6 +280,19 @@ class TestChaosSoak:
         # earlier death). Later ones may be jumped over by a heal.
         assert all(inj.count >= 1 for inj in injectors)
         assert sum(inj.count for inj in injectors) >= n_groups + 1
+        # No split brain, ever: a step committed by more than one group must
+        # have been committed under ONE quorum. Two groups committing the
+        # same step under different quorum ids means the lighthouse cut
+        # disjoint quorums from overlapping liveness epochs (the regrow race
+        # the joining-beat grace closes, _core/lighthouse.cc) — each side
+        # would apply a divergent update at the same max_step, which no heal
+        # can reconcile.
+        step_qids: dict = {}
+        for r in results:
+            for step, qid, _ in r["commits"]:
+                step_qids.setdefault(step, set()).add(qid)
+        split = {s: q for s, q in step_qids.items() if len(q) > 1}
+        assert not split, f"steps committed under multiple quorums: {split}"
         for other in results[1:]:
             jax.tree_util.tree_map(
                 lambda a, b: np.testing.assert_array_equal(a, b),

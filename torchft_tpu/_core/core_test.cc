@@ -267,10 +267,11 @@ static void test_heartbeat_straggler_grace() {
     assert(r.ParseFromString(resp));
     return r.quorum();
   };
-  auto beat = [&](const std::string& id) {
+  auto beat = [&](const std::string& id, bool joining = false) {
     RpcClient c(lh.address(), 2000);
     LighthouseHeartbeatRequest req;
     req.set_replica_id(id);
+    req.set_joining(joining);
     std::string resp, err;
     assert(c.call(kLighthouseHeartbeat, req.SerializeAsString(), &resp,
                   &err, 2'000));
@@ -290,7 +291,11 @@ static void test_heartbeat_straggler_grace() {
   assert(q2.participants_size() == 1);
   assert(dead_wait >= 200 && dead_wait < 600);
 
-  // Round 3: rebuild {a,b}.
+  // Round 3: rebuild {a,b}. b announces first (the manager sends a
+  // synchronous joining beat before its quorum RPC), so whichever join
+  // lands first, the quorum must include both — a's solo fast-quorum
+  // (prev_quorum = {a}) is deferred while b's announce is fresh.
+  beat("b", /*joining=*/true);
   std::thread j2([&] { join("a", 3); });
   Quorum q3 = join("b", 3);
   j2.join();
@@ -315,6 +320,80 @@ static void test_heartbeat_straggler_grace() {
   assert(grace_wait >= 700);  // held ~4x200ms, not 200ms
   printf("test_heartbeat_straggler_grace ok (dead=%lldms grace=%lldms)\n",
          (long long)dead_wait, (long long)grace_wait);
+}
+
+// Regrow after a shrink, with the joiner racing the tick: after {a,b}
+// shrinks to a solo {a} quorum, a restarted b announces (joining beat) and
+// then joins LATE — deliberately after a's join has already landed and
+// ticks have fired. Without the exclusion guard on the fast-quorum path,
+// a's rejoin alone satisfies fast quorum (prev_quorum = {a}) and instantly
+// cuts another solo quorum; b then parks alone and cuts ITS own solo
+// quorum — a split brain where both sides commit divergent steps at the
+// same max_step, so neither ever heals. With the guard, both rounds must
+// produce {a,b} regardless of arrival order.
+static void test_regrow_race_after_shrink() {
+  LighthouseOpt lopt;
+  lopt.bind = "127.0.0.1:0";
+  lopt.min_replicas = 1;
+  lopt.join_timeout_ms = 200;
+  lopt.quorum_tick_ms = 10;
+  lopt.heartbeat_fresh_ms = 500;
+  lopt.heartbeat_grace_factor = 4;
+  Lighthouse lh(lopt);
+
+  auto join = [&](const std::string& id, int64_t step) {
+    RpcClient c(lh.address(), 2000);
+    LighthouseQuorumRequest req;
+    *req.mutable_requester() = member(id, step);
+    std::string resp, err;
+    assert(c.call(kLighthouseQuorum, req.SerializeAsString(), &resp, &err,
+                  10'000));
+    LighthouseQuorumResponse r;
+    assert(r.ParseFromString(resp));
+    return r.quorum();
+  };
+  auto announce = [&](const std::string& id) {
+    RpcClient c(lh.address(), 2000);
+    LighthouseHeartbeatRequest req;
+    req.set_replica_id(id);
+    req.set_joining(true);
+    std::string resp, err;
+    assert(c.call(kLighthouseHeartbeat, req.SerializeAsString(), &resp,
+                  &err, 2'000));
+  };
+
+  // Establish {a,b}, then shrink to solo {a} (b silent -> cut after
+  // join_timeout).
+  std::thread j1([&] { join("a", 1); });
+  Quorum q1 = join("b", 1);
+  j1.join();
+  assert(q1.participants_size() == 2);
+  Quorum q2 = join("a", 2);
+  assert(q2.participants_size() == 1);
+
+  // Restart: b announces, then a joins FIRST and many ticks fire before
+  // b's join finally lands.
+  announce("b");
+  Quorum qa, qb;
+  std::thread ja([&] { qa = join("a", 3); });
+  usleep(100'000);  // a's join has landed; ~10 ticks have fired
+  qb = join("b", 3);
+  ja.join();
+  assert(qa.participants_size() == 2);
+  assert(qb.participants_size() == 2);
+  assert(qa.quorum_id() == qb.quorum_id());
+
+  // And the mirror order: a announces, b joins first, parks, a joins late.
+  // (b would otherwise wait out join_timeout alone and cut a solo {b}.)
+  announce("a");
+  Quorum qa2, qb2;
+  std::thread jb([&] { qb2 = join("b", 4); });
+  usleep(100'000);
+  qa2 = join("a", 4);
+  jb.join();
+  assert(qa2.participants_size() == 2);
+  assert(qb2.participants_size() == 2);
+  printf("test_regrow_race_after_shrink ok\n");
 }
 
 // Shutdown must not hang while a quorum RPC is parked at the lighthouse
@@ -362,6 +441,7 @@ int main() {
   test_heal_decision();
   test_fast_quorum_and_id_bump();
   test_heartbeat_straggler_grace();
+  test_regrow_race_after_shrink();
   test_shutdown_while_parked();
   printf("ALL CORE TESTS PASSED\n");
   return 0;

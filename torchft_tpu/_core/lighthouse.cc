@@ -126,10 +126,40 @@ bool Lighthouse::quorum_changed(const Quorum& a, const Quorum& b) {
 
 bool Lighthouse::quorum_valid_locked() const {
   if (participants_.empty()) return false;
-  if (has_prev_quorum_) {
-    // Fast quorum: every member of the previous quorum has re-joined, so
-    // membership is unchanged and there is no reason to wait for stragglers
-    // (reference src/lighthouse.rs:118-131).
+  int64_t now = now_ms();
+  // Pending-alive: fresh evidence that a replica absent from this round is
+  // alive and trying to join. Cutting a quorum that excludes it risks the
+  // split-quorum fork (both sides commit divergent solo steps at the same
+  // max_step, so neither ever heals) — defer instead, up to the grace cap.
+  //  (1) any replica with a fresh joining-flagged beat (restarted groups
+  //      announce before their Quorum RPC — see manager.cc);
+  //  (2) a previous-quorum member with any fresh beat (alive, stalled).
+  // A dead group's beats go stale within heartbeat_fresh_ms, so
+  // shrink-on-death latency is unchanged.
+  bool pending_alive = false;
+  for (const auto& [id, b] : heartbeats_) {
+    if (participants_.count(id)) continue;
+    if (b.last_joining_ms >= 0 &&
+        now - b.last_joining_ms < opt_.heartbeat_fresh_ms) {
+      pending_alive = true;
+      break;
+    }
+  }
+  if (!pending_alive && has_prev_quorum_) {
+    for (const auto& m : prev_quorum_.participants()) {
+      if (participants_.count(m.replica_id())) continue;
+      auto hb = heartbeats_.find(m.replica_id());
+      if (hb != heartbeats_.end() && hb->second.last_ms >= 0 &&
+          now - hb->second.last_ms < opt_.heartbeat_fresh_ms) {
+        pending_alive = true;
+        break;
+      }
+    }
+  }
+  if (has_prev_quorum_ && !pending_alive) {
+    // Fast quorum: every member of the previous quorum has re-joined AND
+    // no alive joiner would be excluded — membership is settled, cut now
+    // (reference src/lighthouse.rs:118-131, plus the exclusion guard).
     bool all_present = true;
     for (const auto& m : prev_quorum_.participants())
       if (!participants_.count(m.replica_id())) {
@@ -139,30 +169,36 @@ bool Lighthouse::quorum_valid_locked() const {
     if (all_present) return true;
   }
   if (participants_.size() < opt_.min_replicas) return false;
-  // Membership is changing: give stragglers join_timeout_ms (measured from
-  // the first join of this round) before forming the smaller/different
-  // quorum (reference src/lighthouse.rs:133-156).
-  int64_t now = now_ms();
-  int64_t wait = opt_.join_timeout_ms;
-  if (has_prev_quorum_) {
-    // A missing previous member that is still heartbeating is alive and
-    // will join shortly — extend its grace (capped) instead of forking
-    // the job into split quorums. A dead group's beats go stale within
-    // heartbeat_fresh_ms, so shrink-on-death latency is unchanged.
-    for (const auto& m : prev_quorum_.participants()) {
-      if (participants_.count(m.replica_id())) continue;
-      auto hb = heartbeats_.find(m.replica_id());
-      if (hb != heartbeats_.end() &&
-          now - hb->second < opt_.heartbeat_fresh_ms) {
-        wait = opt_.join_timeout_ms * opt_.heartbeat_grace_factor;
-        break;
-      }
-    }
-  }
+  // Membership is changing (or an alive joiner is en route): give
+  // stragglers join_timeout_ms — or the grace cap when pending-alive —
+  // measured from the first join of this round, before forming the
+  // smaller/different quorum (reference src/lighthouse.rs:133-156).
+  int64_t wait = pending_alive
+                     ? opt_.join_timeout_ms * opt_.heartbeat_grace_factor
+                     : opt_.join_timeout_ms;
   return now - first_join_ms_ >= wait;
 }
 
 bool Lighthouse::tick() {
+  // Prune long-stale beat entries (each restart brings a fresh uuid-suffixed
+  // replica_id, so the map otherwise grows without bound across a long job).
+  // Previous-quorum members are kept so the dashboard can show their
+  // staleness.
+  {
+    int64_t now = now_ms();
+    int64_t keep_ms = std::max<int64_t>(10'000, 20 * opt_.heartbeat_fresh_ms);
+    std::set<std::string> prev_ids;
+    if (has_prev_quorum_)
+      for (const auto& m : prev_quorum_.participants())
+        prev_ids.insert(m.replica_id());
+    for (auto it = heartbeats_.begin(); it != heartbeats_.end();) {
+      int64_t latest = std::max(it->second.last_ms, it->second.last_joining_ms);
+      if (now - latest > keep_ms && !prev_ids.count(it->first))
+        it = heartbeats_.erase(it);
+      else
+        ++it;
+    }
+  }
   if (!quorum_valid_locked()) return false;
   Quorum q;
   // Deterministic participant order: sorted by replica_id (std::map
@@ -219,8 +255,13 @@ bool Lighthouse::handle(uint8_t method, const std::string& req,
       }
       {
         std::lock_guard<std::mutex> lk(mu_);
-        heartbeats_[r.replica_id()] = now_ms();
+        auto& b = heartbeats_[r.replica_id()];
+        b.last_ms = now_ms();
+        if (r.joining()) b.last_joining_ms = b.last_ms;
       }
+      // A joining beat can lift a fast-quorum deferral the moment the
+      // announcer lands in participants_ via its Quorum RPC; no tick needed
+      // here — beats alone never form quorums.
       *resp = LighthouseHeartbeatResponse().SerializeAsString();
       return true;
     }
@@ -251,8 +292,9 @@ void Lighthouse::status_locked(StatusResponse* out) const {
       auto* ms = out->add_members();
       *ms->mutable_member() = m;
       auto it = heartbeats_.find(m.replica_id());
-      ms->set_heartbeat_age_ms(it == heartbeats_.end() ? -1
-                                                       : now_ms() - it->second);
+      ms->set_heartbeat_age_ms(it == heartbeats_.end() || it->second.last_ms < 0
+                                   ? -1
+                                   : now_ms() - it->second.last_ms);
     }
   }
   for (const auto& [id, _] : participants_) out->add_joining(id);

@@ -27,15 +27,22 @@ struct LighthouseOpt {
   uint64_t min_replicas = 1;
   int64_t join_timeout_ms = 60'000;
   int64_t quorum_tick_ms = 100;
-  // A previous-quorum member that is absent from the join round but still
-  // heartbeating (beat fresher than heartbeat_fresh_ms) is alive and en
-  // route — e.g. its training loop is momentarily stalled by compilation.
-  // Rather than cutting it out after join_timeout_ms (which forks the job
-  // into split quorums that must re-merge), the straggler wait is extended
-  // while its beats stay fresh, up to heartbeat_grace_factor *
-  // join_timeout_ms total (the cap bounds a wedged-but-beating group).
-  // The reference records heartbeats but never uses them in quorum logic
-  // (src/lighthouse.rs:378-391); this closes that gap. Set
+  // Grace: a quorum cut that would EXCLUDE a replica we have fresh evidence
+  // is alive and trying to join is deferred, up to heartbeat_grace_factor *
+  // join_timeout_ms from the round's first join (the cap bounds a
+  // wedged-but-beating group). Two evidence sources qualify:
+  //   1. a previous-quorum member whose heartbeat is fresher than
+  //      heartbeat_fresh_ms (alive, momentarily stalled — e.g. compiling);
+  //   2. ANY replica whose joining-flagged heartbeat is fresh (managers
+  //      announce intent with a synchronous joining beat before the Quorum
+  //      RPC, so a restarted group — fresh replica_id, never a previous
+  //      member — is protected too).
+  // Crucially the deferral also applies to the FAST-quorum path: after a
+  // shrink to {a}, a's rejoin alone satisfies fast quorum and would
+  // otherwise instantly cut a solo quorum while a restarted b's join is in
+  // flight — forking the job into split quorums that commit divergent
+  // steps. The reference records heartbeats but never uses them in quorum
+  // logic (src/lighthouse.rs:378-391); this closes that gap. Set
   // heartbeat_grace_factor = 1 to disable (reference behavior).
   int64_t heartbeat_fresh_ms = 500;
   int64_t heartbeat_grace_factor = 4;
@@ -78,7 +85,11 @@ class Lighthouse {
   Quorum prev_quorum_;
   int64_t quorum_id_ = 0;
   int64_t broadcast_seq_ = 0;
-  std::map<std::string, int64_t> heartbeats_;  // replica_id -> last seen ms
+  struct Beat {
+    int64_t last_ms = -1;          // any heartbeat
+    int64_t last_joining_ms = -1;  // heartbeat with joining=true
+  };
+  std::map<std::string, Beat> heartbeats_;  // replica_id -> last seen
   bool shutdown_ = false;
 
   std::thread tick_thread_;

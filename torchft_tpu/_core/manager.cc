@@ -41,16 +41,19 @@ void ManagerServer::heartbeat_loop() {
   // src/manager.rs:148-159; only visualized there, same here).
   std::unique_ptr<RpcClient> client;
   while (true) {
+    bool joining;
     {
       std::unique_lock<std::mutex> lk(mu_);
       cv_.wait_for(lk, std::chrono::milliseconds(opt_.heartbeat_ms));
       if (shutdown_) return;
+      joining = quorum_inflight_ > 0;
     }
     try {
       if (!client)
         client = std::make_unique<RpcClient>(opt_.lighthouse_addr, 1'000);
       LighthouseHeartbeatRequest r;
       r.set_replica_id(opt_.replica_id);
+      r.set_joining(joining);
       std::string resp, err;
       if (!client->call(kLighthouseHeartbeat, r.SerializeAsString(), &resp,
                         &err, 1'000))
@@ -157,7 +160,26 @@ bool ManagerServer::handle_quorum(const ManagerQuorumRequest& r,
     self.set_store_address(opt_.store_addr);
     self.set_step(r.step());
     self.set_world_size(opt_.world_size);
+    quorum_inflight_++;
     lk.unlock();
+
+    // Announce intent BEFORE the quorum RPC: a synchronous joining-flagged
+    // heartbeat is processed by the lighthouse before our join can land, so
+    // a survivor whose fast-quorum would otherwise instantly cut us out
+    // (e.g. regrow after a shrink — we may be a restarted group with a
+    // fresh replica_id that no previous-quorum grace covers) defers until
+    // our join arrives. Failure is non-fatal: the quorum loop below retries
+    // against the same lighthouse anyway.
+    try {
+      RpcClient announce(opt_.lighthouse_addr, 2'000);
+      LighthouseHeartbeatRequest hb;
+      hb.set_replica_id(opt_.replica_id);
+      hb.set_joining(true);
+      std::string hresp, herr;
+      announce.call(kLighthouseHeartbeat, hb.SerializeAsString(), &hresp,
+                    &herr, 2'000);
+    } catch (...) {
+    }
 
     // The lighthouse legitimately parks this RPC until quorum forms (up to
     // join_timeout_ms of straggler wait), so poll with bounded per-call
@@ -209,6 +231,7 @@ bool ManagerServer::handle_quorum(const ManagerQuorumRequest& r,
     }
 
     lk.lock();
+    quorum_inflight_--;
     lighthouse_inflight_.reset();
     if (!ok) {
       round->error = "lighthouse quorum failed: " + rpc_err;

@@ -102,6 +102,12 @@ class ManagerServer {
   // a call parked at the lighthouse.
   std::shared_ptr<RpcClient> lighthouse_inflight_;
 
+  // Number of lighthouse quorum round-trips currently in flight. While > 0
+  // the periodic heartbeat carries joining=true, keeping the lighthouse's
+  // split-quorum guard armed if our join parks longer than
+  // heartbeat_fresh_ms (see LighthouseHeartbeatRequest.joining).
+  int64_t quorum_inflight_ = 0;
+
   std::unique_ptr<RpcServer> server_;
   std::thread heartbeat_thread_;
 };

@@ -618,6 +618,16 @@ class Manager:
     def is_healing(self) -> bool:
         return self._healing
 
+    def quorum_id(self) -> int:
+        """Id of the quorum this group last joined (-1 before the first).
+
+        Bumps exactly when membership changes. Tests use the commit-time
+        trace of ``(step, quorum_id)`` to assert the no-split-brain
+        invariant: a step must never be committed by two groups under
+        different quorum ids (disjoint quorums at the same max_step would
+        each commit a divergent update that no heal can reconcile)."""
+        return self._quorum_id
+
     def current_step(self) -> int:
         return self._step
 
