@@ -9,13 +9,31 @@ reference (/root/reference/torchft/checkpointing.py:50-72 serving
 Consistency comes from step gating (reference ``checkpointing.py:123-144``):
 the Manager opens the window with :meth:`allow_checkpoint` at step start
 (while compute runs) and shuts it with :meth:`disallow_checkpoint` at commit,
-so a healer can never observe a half-updated state. Requests for a different
-step get 400.
+so a healer can never observe a half-updated state.
 
-TPU-native difference: the payload is the :mod:`torchft_tpu.serialization`
-pytree format (no pickle — a malicious peer cannot execute code on the
-healer, unlike ``torch.load``), and restore goes through ``jax.device_put``
-with the healer's own shardings.
+TPU-native differences from the reference:
+
+* The payload is the :mod:`torchft_tpu.serialization` pytree format (no
+  pickle — a malicious peer cannot execute code on the healer, unlike
+  ``torch.load``), and restore goes through ``jax.device_put`` with the
+  healer's own shardings.
+* **The donor never stalls at commit.** The reference holds its serve lock
+  for the entire transfer, so ``disallow_checkpoint`` (and with it the
+  donor's commit, and its training) blocks until every in-flight healer
+  download finishes — up to the full send timeout
+  (/root/reference/torchft/checkpointing.py:123-144). Here the first GET of
+  a step captures an **on-device snapshot** of the state under the lock
+  (``jnp.copy`` per jax leaf — one pass at HBM bandwidth, milliseconds) and
+  streams from the snapshot with no lock held. ``jax.Array`` immutability
+  makes the snapshot consistent forever; the copy (rather than a bare
+  reference) is what makes it survive the commit-time optimizer update,
+  which *donates* the old params/opt-state buffers to XLA
+  (optim.py ``donate_argnums``) — a donated array is deleted even while
+  other references exist. Commit therefore proceeds concurrently with any
+  number of slow healer downloads. The price is one transient state-sized
+  copy in HBM while a heal is being served; for donors too memory-tight for
+  that, ``lock_streaming=True`` restores the reference's
+  hold-the-lock-and-wait behavior.
 """
 
 from __future__ import annotations
@@ -26,7 +44,10 @@ import threading
 import time
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Any, Callable, Optional, TypeVar
+from typing import Any, Callable, Optional, Tuple, TypeVar
+
+import jax
+import jax.numpy as jnp
 
 from torchft_tpu.utils import advertise_host
 from torchft_tpu.serialization import (
@@ -48,31 +69,52 @@ class _CheckpointHTTPServer(ThreadingHTTPServer):
     address_family = socket.AF_INET
 
 
+def _snapshot_leaf(x: Any) -> Any:
+    """A copy that stays valid after the commit-time donated update.
+
+    Only jax leaves need copying (donation deletes them even while other
+    references exist); the copy is on-device, sharding-preserving, and runs
+    at HBM bandwidth. numpy/scalar leaves pass by reference — host RAM
+    stays O(leaf) for large host-side states, and the FT commit contract
+    REPLACES pytrees rather than mutating leaves in place, so a served
+    reference stays consistent."""
+    if isinstance(x, jax.Array):
+        return jnp.copy(x)
+    return x
+
+
 class CheckpointServer:
     """Serves the live state pytree to healing peers, step-gated.
 
     Args:
-        state_fn: zero-arg callable returning the current state pytree. Called
-            lazily inside the GET handler, under the serve lock.
-        send_timeout_sec: per-socket-write timeout while streaming. The
-            stream runs under the serve lock (load-bearing: commit may
-            invalidate donated buffers, so ``disallow_checkpoint`` must wait
-            for in-flight serves — same discipline as the reference,
-            /root/reference/torchft/checkpointing.py:50-72); the timeout
-            bounds how long a *hung* healer can hold that lock and block
-            training. A slow-but-alive healer keeps streaming.
+        state_fn: zero-arg callable returning the current state pytree.
+            Called lazily inside the first GET handler of a step, under the
+            serve lock.
+        send_timeout_sec: per-socket-write timeout while streaming (bounds a
+            hung healer), and the bound on how long a GET waits for a closed
+            serve window to reopen.
+        lock_streaming: serve the **live** state under the serve lock for
+            the whole transfer (reference behavior: commit blocks until
+            in-flight downloads finish). Only for donors too memory-tight
+            for the default snapshot copy.
     """
 
     def __init__(self, state_fn: Callable[[], T],
-                 send_timeout_sec: float = 120.0) -> None:
+                 send_timeout_sec: float = 120.0,
+                 lock_streaming: bool = False) -> None:
         self._state_fn = state_fn
         self._send_timeout_sec = send_timeout_sec
-        # The serve gate: held (locked) whenever serving is disallowed.
-        # Acquired/released across threads, which plain Lock permits — same
-        # discipline as the reference (checkpointing.py:123-144).
-        self._checkpoint_lock = threading.Lock()
-        self._disallowed = False
+        self._lock_streaming = lock_streaming
+        # One condition guards the tiny critical sections: the step window,
+        # the snapshot cache, and the in-flight stream count.
+        self._cond = threading.Condition()
+        self._allowed = True
         self._step = -1
+        self._inflight = 0
+        self._shutdown = False
+        # (step, state, plan): snapshot shared by every GET of the same
+        # step, so N concurrent healers cost one copy, not N.
+        self._snap: Optional[Tuple[int, Any, Any]] = None
 
         ckpt_server = self
 
@@ -81,49 +123,61 @@ class CheckpointServer:
                 logger.debug("checkpoint http: " + fmt, *args)
 
             def do_GET(self) -> None:
-                with ckpt_server._checkpoint_lock:
-                    step = ckpt_server._step
-                    prefix = "/checkpoint/"
-                    if not self.path.startswith(prefix):
-                        self.send_error(404, "unknown path")
+                prefix = "/checkpoint/"
+                if not self.path.startswith(prefix):
+                    self.send_error(404, "unknown path")
+                    return
+                try:
+                    req_step = int(self.path[len(prefix):])
+                except ValueError:
+                    self.send_error(400, "bad step")
+                    return
+                srv = ckpt_server
+                deadline = time.monotonic() + srv._send_timeout_sec
+                with srv._cond:
+                    # A closed window (commit in progress) reopens at the
+                    # next step start; park briefly rather than bouncing
+                    # the healer (the reference blocks here too, on its
+                    # held lock).
+                    while not srv._allowed and not srv._shutdown:
+                        remaining = deadline - time.monotonic()
+                        if remaining <= 0:
+                            self.send_error(
+                                503, "serve window closed (commit)")
+                            return
+                        srv._cond.wait(timeout=remaining)
+                    if srv._shutdown:
+                        self.send_error(503, "shutting down")
                         return
-                    try:
-                        req_step = int(self.path[len(prefix):])
-                    except ValueError:
-                        self.send_error(400, "bad step")
-                        return
-                    if req_step != step:
+                    if req_step != srv._step:
                         self.send_error(
                             400,
-                            f"invalid checkpoint requested: serving {step} "
-                            f"but got {req_step}")
+                            f"invalid checkpoint requested: serving "
+                            f"{srv._step} but got {req_step}")
                         return
-                    # Stream leaf-by-leaf: total length is known from
-                    # metadata before any device data is fetched, so the
-                    # response carries Content-Length yet never holds more
-                    # than one leaf + one chunk in host RAM. Socket-write
-                    # backpressure paces the device_get fetches.
                     try:
-                        state = ckpt_server._state_fn()
-                        plan = plan_pytree(state)
+                        state, plan = srv._capture_locked()
                     except Exception as e:  # surface to healer, keep serving
-                        logger.exception("checkpoint state_fn failed")
+                        logger.exception("checkpoint state capture failed")
                         self.send_error(500, str(e))
                         return
+                    srv._inflight += 1
+                # Stream OUTSIDE the lock: the snapshot is immutable, so a
+                # slow healer never delays the donor's commit. Leaf-by-leaf:
+                # total length is known from the plan before any device data
+                # is fetched, so the response carries Content-Length yet
+                # never holds more than one leaf + one chunk in host RAM;
+                # socket-write backpressure paces the device_get fetches.
+                try:
                     self.send_response(200)
                     self.send_header("Content-Type",
                                      "application/octet-stream")
                     self.send_header("Content-Length", str(plan[1]))
                     self.end_headers()
-                    # Stream the SAME plan the Content-Length came from.
                     # 200 is already committed: a device_get failure
                     # mid-stream can only short-close the socket (healer
-                    # sees "truncated"), so log the real cause here. The
-                    # send timeout bounds the serve-lock hold against a
-                    # hung healer; socket.timeout aborts this serve and
-                    # releases the lock for commit/other healers.
-                    self.connection.settimeout(
-                        ckpt_server._send_timeout_sec)
+                    # sees "truncated"), so log the real cause here.
+                    self.connection.settimeout(srv._send_timeout_sec)
                     try:
                         for chunk in iter_pytree_chunks(state, plan=plan):
                             self.wfile.write(chunk)
@@ -132,12 +186,30 @@ class CheckpointServer:
                             "checkpoint stream failed mid-transfer "
                             "(healer will see a truncated stream)")
                         raise
+                finally:
+                    with srv._cond:
+                        srv._inflight -= 1
+                        srv._cond.notify_all()
 
         self._server = _CheckpointHTTPServer(("0.0.0.0", 0), Handler)
         self._thread = threading.Thread(
             target=self._server.serve_forever, daemon=True,
             name="checkpoint-server")
         self._thread.start()
+
+    def _capture_locked(self) -> Tuple[Any, Any]:
+        """State + plan to stream for the current step. Requires _cond held.
+
+        Snapshot mode: first GET of the step copies the state (see module
+        docstring); later GETs share it. Lock-streaming mode: the live
+        refs (disallow_checkpoint then waits for the stream to drain)."""
+        if self._lock_streaming:
+            state = self._state_fn()
+            return state, plan_pytree(state)
+        if self._snap is None or self._snap[0] != self._step:
+            state = jax.tree_util.tree_map(_snapshot_leaf, self._state_fn())
+            self._snap = (self._step, state, plan_pytree(state))
+        return self._snap[1], self._snap[2]
 
     def address(self) -> str:
         """Dialable HTTP URL for the current step's checkpoint."""
@@ -146,20 +218,35 @@ class CheckpointServer:
 
     def allow_checkpoint(self, step: int) -> None:
         """Open the serve window for ``step`` (called at step start, while
-        the forward/backward runs — the state is still the pre-update one)."""
-        self._step = step
-        if self._disallowed:
-            self._disallowed = False
-            self._checkpoint_lock.release()
+        the forward/backward runs — the state is still the pre-update
+        one)."""
+        with self._cond:
+            self._step = step
+            # Drop a stale-step snapshot (in-flight streams keep their own
+            # references; this only frees the cache).
+            if self._snap is not None and self._snap[0] != step:
+                self._snap = None
+            self._allowed = True
+            self._cond.notify_all()
 
     def disallow_checkpoint(self) -> None:
-        """Shut the serve window (called at commit, before state mutates).
-        Blocks until in-flight GETs finish."""
-        if not self._disallowed:
-            self._disallowed = True
-            self._checkpoint_lock.acquire()
+        """Shut the serve window (called at commit).
+
+        Snapshot mode (default): returns immediately — in-flight streams
+        serve their immutable snapshot, so commit can donate/replace the
+        live state concurrently. Lock-streaming mode: blocks until
+        in-flight GETs finish, like the reference."""
+        with self._cond:
+            self._allowed = False
+            self._snap = None
+            if self._lock_streaming:
+                while self._inflight > 0:
+                    self._cond.wait()
 
     def shutdown(self) -> None:
+        with self._cond:
+            self._shutdown = True
+            self._cond.notify_all()
         self._server.shutdown()
         self._server.server_close()
 
