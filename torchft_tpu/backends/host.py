@@ -47,46 +47,77 @@ def _send_all(sock: socket.socket, data: bytes | memoryview) -> None:
 
 def _recv_exact(sock: socket.socket, n: int) -> bytearray:
     buf = bytearray(n)
-    view = memoryview(buf)
+    _recv_exact_into(sock, memoryview(buf))
+    return buf
+
+
+def _recv_exact_into(sock: socket.socket, view: memoryview) -> None:
+    """Fill a writable byte view from the socket (zero-copy receive)."""
     got = 0
+    n = len(view)
     while got < n:
         r = sock.recv_into(view[got:], n - got)
         if r == 0:
             raise CommunicatorError("peer closed connection")
         got += r
-    return buf
+
+
+# Pipeline segment for receive+reduce overlap. Large enough that the numpy
+# add amortizes its dispatch, small enough that the first add starts long
+# before the full chunk has crossed the wire; a power of two so every
+# segment boundary is element-aligned for any power-of-two itemsize.
+_SEG_BYTES = 1 << 18  # 256 KB
 
 
 class _Ring:
-    """The per-epoch socket pair (next/prev neighbors on the ring)."""
+    """The per-epoch socket pair (next/prev neighbors on the ring).
+
+    A persistent sender thread services all outbound transfers (one thread
+    spawn per *configure*, not per exchange), so each ring step runs full
+    duplex: the send streams to the next neighbor while this thread
+    receives from the previous one.
+    """
 
     def __init__(self, next_sock: socket.socket, prev_sock: socket.socket,
                  listener: socket.socket):
         self.next_sock = next_sock
         self.prev_sock = prev_sock
         self.listener = listener
+        self._send_q: "queue.Queue[Optional[Tuple[Any, Future]]]" = \
+            queue.Queue()
+        self._sender = threading.Thread(target=self._send_loop, daemon=True,
+                                        name="ring-sender")
+        self._sender.start()
+
+    def _send_loop(self) -> None:
+        while True:
+            item = self._send_q.get()
+            if item is None:
+                return
+            buf, done = item
+            try:
+                _send_all(self.next_sock, buf)
+                done.set_result(None)
+            except Exception as e:  # noqa: BLE001
+                done.set_exception(
+                    CommunicatorError(f"ring send failed: {e}"))
+
+    def send_async(self, buf) -> Future:
+        """Queue a buffer for the sender thread; resolve when fully sent.
+        The caller must not mutate ``buf`` until the future resolves."""
+        done: Future = Future()
+        self._send_q.put((buf, done))
+        return done
 
     def exchange(self, send_buf, recv_nbytes: int) -> bytearray:
         """Full-duplex: send to next while receiving from prev."""
-        err: List[Exception] = []
-
-        def sender() -> None:
-            try:
-                _send_all(self.next_sock, send_buf)
-            except Exception as e:  # noqa: BLE001
-                err.append(e)
-
-        t = threading.Thread(target=sender, daemon=True)
-        t.start()
-        try:
-            out = _recv_exact(self.prev_sock, recv_nbytes)
-        finally:
-            t.join()
-        if err:
-            raise CommunicatorError(f"ring send failed: {err[0]}")
+        fut = self.send_async(send_buf)
+        out = _recv_exact(self.prev_sock, recv_nbytes)
+        fut.result()
         return out
 
     def close(self) -> None:
+        self._send_q.put(None)
         for s in (self.next_sock, self.prev_sock, self.listener):
             try:
                 s.shutdown(socket.SHUT_RDWR)
@@ -272,31 +303,60 @@ class HostCommunicator(Communicator):
 
     def _ring_allreduce_buffer(self, ring: _Ring,
                                flat: np.ndarray) -> np.ndarray:
-        """Bandwidth-optimal ring allreduce: reduce-scatter + allgather."""
+        """Bandwidth-optimal ring allreduce: reduce-scatter + allgather.
+
+        Each ring step is fully pipelined: the outbound chunk streams from
+        the persistent sender thread while this thread receives the inbound
+        chunk in ``_SEG_BYTES`` segments, folding each segment into the
+        accumulator as soon as it lands — the reduce overlaps the wire
+        (and, via kernel socket buffering, the wire keeps flowing during
+        the add) instead of waiting for the whole chunk. The allgather
+        phase needs no reduce, so segments are received zero-copy straight
+        into the accumulator's memory.
+        """
         n = self._world
         rank = self._rank
-        acc = flat.copy()
+        # Reduces in place: `flat` must be a fresh buffer owned by the
+        # caller's collective (the per-dtype np.concatenate above always
+        # allocates one), so no defensive copy on the hot gradient path.
+        acc = flat if flat.flags.c_contiguous else np.ascontiguousarray(flat)
+        acc_bytes = memoryview(acc).cast("B")
         bounds = np.linspace(0, acc.size, n + 1, dtype=np.int64)
+        itemsize = acc.itemsize
 
         def chunk(i: int) -> np.ndarray:
             i %= n
             return acc[bounds[i]:bounds[i + 1]]
 
-        itemsize = acc.itemsize
+        def chunk_bytes(i: int) -> memoryview:
+            i %= n
+            return acc_bytes[bounds[i] * itemsize:bounds[i + 1] * itemsize]
+
+        # Scratch for inbound reduce segments, reused across steps.
+        scratch = bytearray(_SEG_BYTES)
+        scratch_view = memoryview(scratch)
+
         for step in range(n - 1):
-            send_c = chunk(rank - step)
+            # Chunks of the contiguous 1-D accumulator are contiguous
+            # views: the sender streams directly from acc (the chunk being
+            # sent is never the one being reduced this step).
+            fut = ring.send_async(chunk_bytes(rank - step))
             recv_c = chunk(rank - step - 1)
-            data = ring.exchange(np.ascontiguousarray(send_c).data,
-                                 recv_c.size * itemsize)
-            # np.frombuffer reads the bytearray zero-copy — no bytes() dup on
-            # the hot gradient path.
-            recv_c += np.frombuffer(data, dtype=acc.dtype)
+            nbytes = recv_c.size * itemsize
+            off = 0
+            while off < nbytes:
+                k = min(_SEG_BYTES, nbytes - off)
+                seg = scratch_view[:k]
+                _recv_exact_into(ring.prev_sock, seg)
+                lo = off // itemsize
+                recv_c[lo:lo + k // itemsize] += np.frombuffer(
+                    seg, dtype=acc.dtype)
+                off += k
+            fut.result()
         for step in range(n - 1):
-            send_c = chunk(rank + 1 - step)
-            recv_c = chunk(rank - step)
-            data = ring.exchange(np.ascontiguousarray(send_c).data,
-                                 recv_c.size * itemsize)
-            recv_c[:] = np.frombuffer(data, dtype=acc.dtype)
+            fut = ring.send_async(chunk_bytes(rank + 1 - step))
+            _recv_exact_into(ring.prev_sock, chunk_bytes(rank - step))
+            fut.result()
         return acc
 
     def _do_broadcast(self, ring: Optional[_Ring], tree: Any,
@@ -325,23 +385,13 @@ class HostCommunicator(Communicator):
         payload = save_pytree(tree)
         for step in range(n - 1):
             header = struct.pack("<qq", (rank - step) % n, len(payload))
-            err: List[Exception] = []
-
-            def sender(h=header, p=payload):
-                try:
-                    _send_all(ring.next_sock, h)
-                    _send_all(ring.next_sock, p)
-                except Exception as e:  # noqa: BLE001
-                    err.append(e)
-
-            t = threading.Thread(target=sender, daemon=True)
-            t.start()
+            f1 = ring.send_async(header)
+            f2 = ring.send_async(payload)
             src, size = struct.unpack(
                 "<qq", bytes(_recv_exact(ring.prev_sock, 16)))
             payload = _recv_exact(ring.prev_sock, size)  # bytearray, no copy
-            t.join()
-            if err:
-                raise CommunicatorError(f"allgather send failed: {err[0]}")
+            f1.result()
+            f2.result()
             results[src] = load_pytree(payload, tree)
         return results  # type: ignore[return-value]
 

@@ -39,7 +39,8 @@ from __future__ import annotations
 
 import logging
 import threading
-from concurrent.futures import Future
+from concurrent.futures import Future, ThreadPoolExecutor
+from concurrent.futures import TimeoutError as FutureTimeout
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
@@ -85,10 +86,70 @@ class MeshWorld:
     """
 
     def __init__(self, num_groups: int, timeout_sec: float = 60.0) -> None:
+        # The on-device path exists ONLY single-controller: rendezvous is
+        # in-process, so in a multi-controller job (one process per group,
+        # jax.distributed) each process would wait for contributions that
+        # can never arrive and every collective would time out — a silent
+        # 7.5x regression to the host ring at best, a hang at worst.
+        # Refuse loudly instead. A process-SPANNING device path is not
+        # buildable on today's JAX: the coordination service hard-kills
+        # every surviving process when any task dies (observed: client.h
+        # "Terminating process because the JAX distributed service
+        # detected fatal errors" ~heartbeat_timeout after a peer death),
+        # which is the exact failure torchft exists to survive, and
+        # jax.distributed cannot be re-initialized per quorum. See
+        # docs/design/cross_group_backend.md for the full analysis and
+        # what would unlock it (the reference's NCCL tier has no such
+        # constraint because NCCL communicators are user-level rebuildable
+        # objects, /root/reference/torchft/process_group.py:95-107).
+        if jax.process_count() > 1:
+            raise RuntimeError(
+                "MeshWorld requires a single-controller deployment (all "
+                f"replica groups in one process); this runtime spans "
+                f"{jax.process_count()} processes. Use the host "
+                "communicator (HostCommunicator) for cross-group "
+                "collectives in multi-controller jobs — see "
+                "docs/design/cross_group_backend.md")
         self.num_groups = num_groups
         self.timeout_sec = timeout_sec
         self._lock = threading.Lock()
         self._pending: Dict[Tuple, _Collect] = {}
+        # Wedged-device-op watchdog (the reference's baby-PG role,
+        # /root/reference/torchft/process_group.py:511-741, re-thought for
+        # XLA): the rendezvous timer bounds waiting for PEERS, but the
+        # device-side reduction itself (_jit_tree_sum + device_put) runs a
+        # real XLA computation that cannot be cancelled once dispatched. It
+        # therefore runs on a sacrificial resolver thread with a deadline;
+        # on expiry every waiter's future fails immediately (the error
+        # latches into the commit vote) and the world is POISONED — the
+        # wedged computation still owns the resolver thread and possibly a
+        # device stream, so every later configure() demotes to the host
+        # ring, which keeps training alive without the device fast path.
+        # Generous by design: the deadline exists to catch WEDGED ops
+        # (which never finish), not slow ones — the first reduction also
+        # pays one-time XLA compilation, which must not poison a healthy
+        # runtime.
+        self.device_op_timeout_sec = max(120.0, 2 * timeout_sec)
+        self._poisoned: Optional[str] = None
+        # Several workers so concurrent distinct-key resolves don't queue
+        # behind each other — a queued resolve's wait would otherwise
+        # count against ITS deadline and a pair of merely-slow reductions
+        # could poison the world.
+        self._resolver = ThreadPoolExecutor(
+            max_workers=4, thread_name_prefix="mesh-resolve")
+
+    def poisoned(self) -> Optional[str]:
+        """Reason the device path was demoted, or None while healthy."""
+        return self._poisoned
+
+    def reset_poison(self) -> None:
+        """Operator escape hatch: re-arm the device path after a watchdog
+        demotion (e.g. the hang's cause — a bad peer, a driver stall — was
+        resolved out of band). The wedged computation's thread is not
+        recovered; communicators return to mesh mode at their next
+        full-membership configure."""
+        logger.warning("mesh watchdog: poison reset (%s)", self._poisoned)
+        self._poisoned = None
 
     # ------------------------------------------------------------ rendezvous
 
@@ -101,6 +162,7 @@ class MeshWorld:
         ``timeout_sec`` (default: the world's) if a peer never does
         (peer death -> commit vote)."""
         fut: Future = Future()
+        mismatch = None
         with self._lock:
             entry = self._pending.get(key)
             if entry is None:
@@ -113,25 +175,60 @@ class MeshWorld:
                 entry.timer.daemon = True
                 entry.timer.start()
             if entry.kind != kind or entry.world != world:
-                fut.set_exception(CommunicatorError(
+                # Protocol divergence: fail the WHOLE entry, not just this
+                # contributor — earlier arrivals' futures would otherwise
+                # park until the timer expires, delaying their commit-vote
+                # error latch by up to timeout_sec. Futures resolve outside
+                # the lock (their callbacks may re-enter the world).
+                mismatch = CommunicatorError(
                     f"rendezvous mismatch at {key}: {kind}/{world} vs "
-                    f"{entry.kind}/{entry.world}"))
-                return fut
-            entry.values[rank] = payload
-            entry.futures[rank] = (fut, payload)
-            entry.extra[rank] = extra
-            complete = len(entry.values) == world
+                    f"{entry.kind}/{entry.world}")
+                del self._pending[key]
+            else:
+                entry.values[rank] = payload
+                entry.futures[rank] = (fut, payload)
+                entry.extra[rank] = extra
+            complete = mismatch is None and len(entry.values) == world
             if complete:
                 del self._pending[key]
+        if mismatch is not None:
+            if entry.timer is not None:
+                entry.timer.cancel()
+            fut.set_exception(mismatch)
+            for f, _ in entry.futures.values():
+                if not f.done():
+                    f.set_exception(mismatch)
+            return fut
         if complete:
             if entry.timer is not None:
                 entry.timer.cancel()
             try:
-                self._resolve(entry)
+                if self._poisoned is not None:
+                    raise CommunicatorError(
+                        f"mesh device path poisoned: {self._poisoned}")
+                # Deadline the DEVICE work, not just the rendezvous: a
+                # dispatched XLA computation cannot be aborted, so a hang
+                # must not wedge the contributor threads (they hold the
+                # training loops' allreduce futures).
+                self._resolver.submit(self._resolve, entry).result(
+                    timeout=self.device_op_timeout_sec)
+            except FutureTimeout:
+                self._poisoned = (
+                    f"device-side collective exceeded "
+                    f"{self.device_op_timeout_sec}s deadline at {key}")
+                logger.error(
+                    "mesh watchdog: %s — demoting this runtime's "
+                    "cross-group path to the host ring", self._poisoned)
+                err = CommunicatorError(self._poisoned)
+                for f, _ in entry.futures.values():
+                    if not f.done():
+                        f.set_exception(err)
             except Exception as e:  # noqa: BLE001
                 for f, _ in entry.futures.values():
                     if not f.done():
-                        f.set_exception(CommunicatorError(str(e)))
+                        f.set_exception(
+                            e if isinstance(e, CommunicatorError)
+                            else CommunicatorError(str(e)))
         return fut
 
     def _expire(self, key: Tuple) -> None:
@@ -305,13 +402,22 @@ class MeshCommunicator(Communicator):
         self._rank = rank
         self._size = world_size
         self._prefix = store_addr
-        if world_size == self._mesh_world.num_groups:
+        poisoned = self._mesh_world.poisoned()
+        if world_size == self._mesh_world.num_groups and poisoned is None:
             # Full static membership: stay on device. No sockets are built;
             # stragglers from an old quorum key on the old prefix and expire.
             self._mode = "mesh"
             logger.info(
                 "mesh communicator: on-device path (rank=%d world=%d, %s)",
                 rank, world_size, store_addr)
+        elif poisoned is not None:
+            # Watchdog fired earlier: the device path may hold a wedged XLA
+            # computation; the host ring keeps the job training.
+            self._mode = "host"
+            logger.warning(
+                "mesh communicator: device path demoted (%s); using host "
+                "ring (rank=%d world=%d)", poisoned, rank, world_size)
+            self._fallback.configure(store_addr, rank, world_size)
         else:
             self._mode = "host"
             logger.info(
