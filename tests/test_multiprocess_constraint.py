@@ -1,0 +1,73 @@
+"""Pins the platform constraint that rules out a process-spanning device
+path (docs/design/cross_group_backend.md): in a multi-process
+``jax.distributed`` runtime, the coordination service hard-kills SURVIVING
+processes when any task dies — even while they execute purely local
+computations. A cross-group backend built on one shared runtime would
+therefore die with the first group failure, the exact event this framework
+exists to survive.
+
+If this test ever FAILS (the survivor outlives the peer's death), the
+platform has grown fail-soft semantics and tier 3 of the backend design
+becomes buildable — revisit the design doc.
+"""
+
+import socket
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+WORKER = textwrap.dedent("""
+    import os, sys, time
+    pid = int(sys.argv[1]); coord = sys.argv[2]
+    import jax
+    from jax.extend.backend import clear_backends
+    clear_backends()
+    jax.config.update("jax_num_cpu_devices", 2)
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(coordinator_address=coord, num_processes=2,
+                               process_id=pid,
+                               heartbeat_timeout_seconds=10)
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    mesh = Mesh(np.array(jax.devices()).reshape(2, 2), ("group", "intra"))
+    local = np.full((1, 4), float(pid + 1), np.float32)
+    sharding = NamedSharding(mesh, P("group", None))
+    garr = jax.make_array_from_process_local_data(sharding, local, (2, 4))
+    out = jax.jit(lambda x: jnp.sum(x, axis=0),
+                  out_shardings=NamedSharding(mesh, P()))(garr)
+    assert float(np.asarray(out.addressable_shards[0].data)[0]) == 3.0
+    print(f"[{pid}] allreduce ok", flush=True)
+    if pid == 1:
+        os._exit(1)  # the "replica group death"
+    f = jax.jit(lambda x: (x * 2).sum())
+    for i in range(20):  # purely LOCAL work; no cross-process collectives
+        time.sleep(2)
+        print(f"[0] local ok {float(f(jnp.arange(8.0)))}", flush=True)
+    print("[0] SURVIVED", flush=True)
+""")
+
+
+@pytest.mark.integration
+def test_peer_death_kills_survivor_in_shared_jax_runtime(tmp_path):
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        coord = f"127.0.0.1:{s.getsockname()[1]}"
+    script = tmp_path / "worker.py"
+    script.write_text(WORKER)
+    procs = [
+        subprocess.Popen([sys.executable, str(script), str(pid), coord],
+                         stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                         text=True)
+        for pid in (0, 1)
+    ]
+    out0, _ = procs[0].communicate(timeout=120)
+    procs[1].wait(timeout=30)
+    assert "[0] allreduce ok" in out0          # the shared path does work...
+    assert "local ok" in out0                  # ...and local work continues...
+    assert "[0] SURVIVED" not in out0          # ...until the service kills us
+    assert procs[0].returncode != 0, (
+        "survivor outlived peer death — the platform constraint has "
+        "lifted; revisit docs/design/cross_group_backend.md tier 3")
