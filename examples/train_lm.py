@@ -43,7 +43,8 @@ import optax  # noqa: E402
 from jax.sharding import NamedSharding  # noqa: E402
 
 from torchft_tpu import HostCommunicator, Manager  # noqa: E402
-from torchft_tpu.data import BatchIterator, DistributedSampler  # noqa: E402
+from torchft_tpu.data import (DistributedSampler, StatefulLoader,  # noqa: E402
+                              TokenFileDataset)
 from torchft_tpu.models import (Transformer, TransformerConfig,  # noqa: E402
                                 chunked_causal_lm_loss, tiny_config,
                                 tp_rules)
@@ -82,18 +83,39 @@ def main() -> None:
     mesh = make_mesh({"fsdp": n_dev // tp, "tp": tp})
     logger.info("group %d mesh: %s", replica_group, dict(mesh.shape))
 
-    # Synthetic corpus, sharded across replica groups by the 2D sampler.
-    rng = np.random.default_rng(0)
-    tokens_data = rng.integers(0, cfg.vocab_size,
-                               size=(4096, seq_len)).astype(np.int32)
+    # Storage-backed corpus: TOKENS_FILE points at a flat token .npy (your
+    # real pretraining data); otherwise a synthetic one is materialized
+    # once and memmapped like the real thing. The 2D sampler shards it
+    # across replica groups; the StatefulLoader prefetches off the page
+    # cache and checkpoints its exact stream position (the torchdata
+    # StatefulDataLoader role, reference train_ddp.py:53-57).
+    tokens_file = os.environ.get("TOKENS_FILE")
+    if not tokens_file:
+        tokens_file = os.path.join(
+            os.environ.get("DATA_DIR", "/tmp/torchft_tpu_data"),
+            f"synth_tokens_v{cfg.vocab_size}.npy")
+        if not os.path.exists(tokens_file):
+            # Atomic publish: groups on one host share DATA_DIR, and a
+            # concurrently starting peer must never memmap a half-written
+            # file — write per-group temp, then rename (last one wins,
+            # contents identical by the fixed seed).
+            rng = np.random.default_rng(0)
+            # (.npy suffix so np.save does not append one to the temp name)
+            tmp = f"{tokens_file}.{replica_group}.{os.getpid()}.tmp.npy"
+            TokenFileDataset.write(
+                tmp,
+                rng.integers(0, cfg.vocab_size, size=4096 * seq_len)
+                .astype(np.uint16 if cfg.vocab_size <= 65536 else np.int32))
+            os.replace(tmp, tokens_file)
+    dataset = TokenFileDataset(tokens_file, seq_len=seq_len)
     sampler = DistributedSampler(
-        dataset_size=len(tokens_data),
+        dataset_size=len(dataset),
         replica_group=replica_group,
         num_replica_groups=num_groups,
         batch_size=batch_size,
         seed=0,
     )
-    batches = BatchIterator({"tokens": tokens_data}, sampler)
+    batches = StatefulLoader(dataset, sampler, prefetch=2)
 
     def loss_fn(params, batch):
         # Chunked loss: the [B, S, vocab] logits tensor (LM training's
@@ -140,8 +162,10 @@ def main() -> None:
                                                  str(replica_group)))
         if path:
             user, mgr_state = checkpoint_io.load(
-                path, target=trainer.state_dict())
-            trainer.load_state_dict(user)
+                path, target={"trainer": trainer.state_dict(),
+                              "loader": batches.state_dict()})
+            trainer.load_state_dict(user["trainer"])
+            batches.load_state_dict(user["loader"])
             m.load_state_dict(mgr_state)
             logger.info("resumed from %s at step %d", path,
                         m.current_step())
@@ -156,7 +180,9 @@ def main() -> None:
 
             checkpoint_io.save(
                 os.path.join(ckpt_dir, str(replica_group), f"ckpt_{step}"),
-                trainer.state_dict(), m.state_dict())
+                {"trainer": trainer.state_dict(),
+                 "loader": batches.state_dict()},
+                m.state_dict())
         if step % 10 == 0:
             dt = time.perf_counter() - t0
             logger.info(
@@ -167,6 +193,7 @@ def main() -> None:
             t0 = time.perf_counter()
     logger.info("done: %d steps, %d batches committed",
                 m.current_step(), m.batches_committed())
+    batches.shutdown()
     trainer.shutdown()
 
 

@@ -1,0 +1,140 @@
+"""Storage-backed stateful input pipeline (VERDICT r2 #7).
+
+The reference delegates storage to torchvision/torchdata and documents a
+lossy-rejoin contract for the sampler (/root/reference/torchft/data.py:33-36)
+with exact resume via StatefulDataLoader (train_ddp.py:53-57). Here the
+memmap datasets + StatefulLoader play both roles; these tests pin:
+round-tripping through disk, O(batch) gathering, exact-position resume,
+disjoint cross-group sharding, and the lossy-rejoin story end to end.
+"""
+
+import numpy as np
+import pytest
+
+from torchft_tpu.data import (
+    DistributedSampler,
+    MemmapDataset,
+    StatefulLoader,
+    TokenFileDataset,
+)
+
+
+@pytest.fixture
+def corpus(tmp_path):
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(256, 8)).astype(np.float32)
+    y = rng.integers(0, 10, size=(256,)).astype(np.int32)
+    return MemmapDataset.write(str(tmp_path / "ds"), {"x": x, "y": y}), x, y
+
+
+class TestMemmapDataset:
+    def test_round_trip_and_gather(self, corpus):
+        ds, x, y = corpus
+        assert len(ds) == 256
+        idx = np.array([3, 200, 7])
+        batch = ds[idx]
+        np.testing.assert_array_equal(batch["x"], x[idx])
+        np.testing.assert_array_equal(batch["y"], y[idx])
+        # Gathered batches are real arrays (writable), not memmap views.
+        assert isinstance(batch["x"], np.ndarray)
+        assert not isinstance(batch["x"], np.memmap)
+
+    def test_fields_are_memmapped(self, corpus):
+        ds, _, _ = corpus
+        assert all(isinstance(a, np.memmap) for a in ds.arrays.values())
+
+    def test_ragged_fields_rejected(self, tmp_path):
+        with pytest.raises(ValueError, match="rows"):
+            MemmapDataset.write(str(tmp_path / "bad"),
+                                {"a": np.ones(4), "b": np.ones(5)})
+
+
+class TestTokenFileDataset:
+    def test_windows(self, tmp_path):
+        toks = np.arange(100, dtype=np.uint16)
+        path = str(tmp_path / "tokens.npy")
+        TokenFileDataset.write(path, toks)
+        ds = TokenFileDataset(path, seq_len=16)
+        assert len(ds) == 6  # 100 // 16
+        batch = ds[np.array([0, 5])]
+        assert batch["tokens"].dtype == np.int32
+        np.testing.assert_array_equal(batch["tokens"][0], np.arange(16))
+        np.testing.assert_array_equal(batch["tokens"][1],
+                                      np.arange(80, 96))
+
+
+def collect(loader, n):
+    return [next(loader) for _ in range(n)]
+
+
+class TestStatefulLoader:
+    def make(self, corpus, group=0, num_groups=2, prefetch=2):
+        ds, _, _ = corpus
+        sampler = DistributedSampler(len(ds), group, num_groups,
+                                     batch_size=8, seed=3)
+        return StatefulLoader(ds, sampler, prefetch=prefetch)
+
+    @pytest.mark.parametrize("prefetch", [0, 3])
+    def test_exact_resume(self, corpus, prefetch):
+        """state_dict after batch k resumes the stream at batch k+1,
+        regardless of how far the prefetcher has read ahead."""
+        a = self.make(corpus, prefetch=prefetch)
+        seen = collect(a, 5)
+        state = a.state_dict()
+        cont = collect(a, 4)
+        a.shutdown()
+
+        b = self.make(corpus, prefetch=prefetch)
+        b.load_state_dict(state)
+        resumed = collect(b, 4)
+        b.shutdown()
+        for p, q in zip(cont, resumed):
+            np.testing.assert_array_equal(p["x"], q["x"])
+        # And the pre-checkpoint stream differs from the post (sanity).
+        assert not np.array_equal(seen[0]["x"], cont[0]["x"])
+
+    def test_epochs_auto_advance_with_reshuffle(self, corpus):
+        ds, _, _ = corpus
+        sampler = DistributedSampler(len(ds), 0, 1, batch_size=32, seed=0)
+        loader = StatefulLoader(ds, sampler, prefetch=0)
+        epoch0 = np.concatenate(
+            [b["y"] for b in collect(loader, len(sampler))])
+        epoch1 = np.concatenate(
+            [b["y"] for b in collect(loader, len(sampler))])
+        assert sorted(epoch0.tolist()) == sorted(epoch1.tolist())
+        assert not np.array_equal(epoch0, epoch1)  # reshuffled
+        loader.shutdown()
+
+    def test_groups_shard_disjointly(self, corpus):
+        ds, x, _ = corpus
+        rows = []
+        for g in range(2):
+            loader = self.make(corpus, group=g)
+            got = np.concatenate([b["x"] for b in collect(loader, 4)])
+            loader.shutdown()
+            rows.append({tuple(r) for r in got})
+        assert not rows[0] & rows[1]
+
+    def test_lossy_rejoin_story(self, corpus):
+        """The end-to-end contract: a group checkpoints at batch 4, keeps
+        consuming to batch 9, dies, restarts from the checkpoint — the
+        resumed stream REPLAYS batches 5..9 exactly (lossy: those samples
+        are consumed twice), then continues deterministically."""
+        a = self.make(corpus)
+        collect(a, 4)
+        ckpt = a.state_dict()         # durable checkpoint at batch 4
+        tail_before_death = collect(a, 5)  # batches 5..9, then the crash
+        a.shutdown()
+
+        b = self.make(corpus)         # fresh process
+        b.load_state_dict(ckpt)
+        replayed = collect(b, 5)
+        b.shutdown()
+        for p, q in zip(tail_before_death, replayed):
+            np.testing.assert_array_equal(p["x"], q["x"])
+
+    def test_empty_shard_rejected(self, corpus):
+        ds, _, _ = corpus
+        sampler = DistributedSampler(4, 0, 2, batch_size=8)  # 2 rows < 8
+        with pytest.raises(ValueError, match="no batches"):
+            StatefulLoader(ds, sampler)

@@ -1,4 +1,5 @@
-"""Data sharding across the (local rank × replica group) grid.
+"""Data sharding across the (local rank × replica group) grid, plus the
+storage-backed stateful input pipeline.
 
 The reference's ``DistributedSampler`` (/root/reference/torchft/data.py:24-77)
 shards a dataset over a 2D grid by flattening it:
@@ -13,10 +14,28 @@ iterator instead of a torch Sampler: it yields index batches suitable for
 array slicing / grain-style loaders, with ``state_dict``/``load_state_dict``
 for the dataloader-checkpoint role torchdata's StatefulDataLoader plays in
 the reference example (``train_ddp.py:53-57``).
+
+Storage tier (the reference delegates this to torchvision/torchdata;
+BASELINE configs name real datasets, so the framework owes its own):
+
+* :class:`MemmapDataset` — a directory of ``.npy`` field files opened with
+  ``mmap_mode="r"``; batches are gathered straight off the page cache, so
+  host RAM stays O(batch) for any corpus size.
+* :class:`TokenFileDataset` — a flat token ``.npy`` sliced into fixed
+  ``seq_len`` windows, the LM-pretraining shape.
+* :class:`StatefulLoader` — sampler-driven iterator with background
+  prefetch and exact-position ``state_dict`` resume. Each yielded batch
+  carries the sampler state *as of after that batch*, so a checkpoint
+  taken at commit resumes the stream deterministically — while a group
+  that dies between checkpoints re-consumes the tail (the reference's
+  documented lossy-rejoin contract).
 """
 
 from __future__ import annotations
 
+import os
+import queue
+import threading
 from typing import Any, Dict, Iterator, Optional
 
 import numpy as np
@@ -107,6 +126,194 @@ class DistributedSampler:
         self.epoch = int(state["epoch"])
         self._batch_idx = int(state["batch_idx"])
         self.seed = int(state["seed"])
+
+
+class MemmapDataset:
+    """A directory of ``.npy`` field files, memory-mapped read-only.
+
+    ``write()`` materializes in-memory arrays once; training processes open
+    the same directory with zero host-RAM cost beyond the touched pages.
+    Indexing with a batch of row indices gathers those rows into fresh
+    arrays (the copy is the batch, not the corpus).
+    """
+
+    def __init__(self, path: str) -> None:
+        self.path = path
+        self.arrays: Dict[str, np.ndarray] = {}
+        n = None
+        for fn in sorted(os.listdir(path)):
+            if not fn.endswith(".npy"):
+                continue
+            arr = np.load(os.path.join(path, fn), mmap_mode="r")
+            self.arrays[fn[:-4]] = arr
+            if n is None:
+                n = len(arr)
+            elif len(arr) != n:
+                raise ValueError(
+                    f"field {fn}: {len(arr)} rows, expected {n}")
+        if not self.arrays:
+            raise ValueError(f"no .npy fields under {path}")
+        self._n = int(n)  # type: ignore[arg-type]
+
+    @staticmethod
+    def write(path: str, arrays: Dict[str, np.ndarray]) -> "MemmapDataset":
+        os.makedirs(path, exist_ok=True)
+        for name, arr in arrays.items():
+            np.save(os.path.join(path, f"{name}.npy"), np.asarray(arr))
+        return MemmapDataset(path)
+
+    def __len__(self) -> int:
+        return self._n
+
+    def __getitem__(self, idx: np.ndarray) -> Dict[str, np.ndarray]:
+        return {k: np.asarray(v[idx]) for k, v in self.arrays.items()}
+
+
+class TokenFileDataset:
+    """Fixed-length windows over a flat token file (LM pretraining shape).
+
+    ``tokens_path`` is a 1-D integer ``.npy`` (any integer dtype; windows
+    are returned as int32, the embedding-lookup dtype). Row ``i`` is the
+    non-overlapping window ``tokens[i*seq_len : (i+1)*seq_len]``.
+    """
+
+    def __init__(self, tokens_path: str, seq_len: int) -> None:
+        self.tokens = np.load(tokens_path, mmap_mode="r")
+        if self.tokens.ndim != 1:
+            raise ValueError("token file must be 1-D")
+        self.seq_len = seq_len
+        self._n = len(self.tokens) // seq_len
+
+    @staticmethod
+    def write(tokens_path: str, tokens: np.ndarray) -> None:
+        os.makedirs(os.path.dirname(tokens_path) or ".", exist_ok=True)
+        np.save(tokens_path, np.asarray(tokens))
+
+    def __len__(self) -> int:
+        return self._n
+
+    def __getitem__(self, idx: np.ndarray) -> Dict[str, np.ndarray]:
+        gather = (np.asarray(idx, np.int64)[:, None] * self.seq_len
+                  + np.arange(self.seq_len, dtype=np.int64)[None, :])
+        return {"tokens": np.asarray(self.tokens[gather], np.int32)}
+
+
+class StatefulLoader:
+    """Background-prefetching batch stream with exact-position resume.
+
+    Args:
+        dataset: anything with ``__len__`` and ``__getitem__(index_batch)
+            -> batch`` (:class:`MemmapDataset`, :class:`TokenFileDataset`,
+            or your own).
+        sampler: the 2D-sharded :class:`DistributedSampler`; epochs
+            auto-advance.
+        prefetch: batches read ahead on a daemon thread (storage latency
+            hides behind device compute). 0 disables the thread.
+
+    ``state_dict()`` describes the position *after the last batch this
+    loader yielded* — save it alongside the model at commit time and
+    ``load_state_dict()`` resumes the stream from exactly there. A crash
+    after the checkpoint re-consumes the since-then tail: the reference's
+    lossy-rejoin semantics (/root/reference/torchft/data.py:33-36), made
+    exact at every checkpoint boundary.
+    """
+
+    def __init__(self, dataset: Any, sampler: DistributedSampler,
+                 prefetch: int = 2) -> None:
+        self.dataset = dataset
+        self.sampler = sampler
+        self.prefetch = prefetch
+        if len(sampler) == 0:
+            raise ValueError(
+                "sampler yields no batches (dataset shard smaller than the "
+                "batch size); epochs would spin forever")
+        self._last_state = sampler.state_dict()
+        self._it: Optional[Iterator[np.ndarray]] = None
+        self._q: Optional[queue.Queue] = None
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    # ------------------------------------------------------------- iteration
+
+    def __iter__(self) -> "StatefulLoader":
+        return self
+
+    def _next_indices(self):
+        """Next index batch, auto-advancing epochs; plus the sampler state
+        capturing the position AFTER this batch. Holds ONE live iterator
+        per epoch — the sampler's ``__iter__`` shuffles the whole index
+        space, which must happen once per epoch, not once per batch."""
+        while True:
+            if self._it is None:
+                self._it = iter(self.sampler)
+            got = next(self._it, None)
+            if got is not None:
+                return got, self.sampler.state_dict()
+            self.sampler.set_epoch(self.sampler.epoch + 1)
+            self._it = None
+
+    def _prefetch_loop(self) -> None:
+        assert self._q is not None
+        while not self._stop.is_set():
+            try:
+                idx, state = self._next_indices()
+                item = (self.dataset[idx], state)
+            except Exception as e:  # noqa: BLE001
+                # Surface storage/sampler failures to the consumer — a
+                # silently dead prefetcher would leave __next__ parked on
+                # the queue forever.
+                item = e
+            while not self._stop.is_set():
+                try:
+                    self._q.put(item, timeout=0.2)
+                    break
+                except queue.Full:
+                    continue
+            if isinstance(item, Exception):
+                return
+
+    def __next__(self) -> Any:
+        if self.prefetch <= 0:
+            idx, state = self._next_indices()
+            self._last_state = state
+            return self.dataset[idx]
+        if self._thread is None:
+            self._stop.clear()
+            self._q = queue.Queue(maxsize=self.prefetch)
+            self._thread = threading.Thread(
+                target=self._prefetch_loop, daemon=True,
+                name="stateful-loader")
+            self._thread.start()
+        item = self._q.get()
+        if isinstance(item, Exception):
+            self._thread = None  # the loop exited; allow a fresh start
+            raise item
+        batch, state = item
+        self._last_state = state
+        return batch
+
+    # --------------------------------------------------------------- resume
+
+    def state_dict(self) -> Dict[str, int]:
+        return dict(self._last_state)
+
+    def load_state_dict(self, state: Dict[str, int]) -> None:
+        self._halt()
+        self.sampler.load_state_dict(state)
+        self._last_state = self.sampler.state_dict()
+        self._it = None  # the live epoch iterator predates the new position
+
+    def shutdown(self) -> None:
+        self._halt()
+
+    def _halt(self) -> None:
+        """Stop the prefetcher and discard read-ahead (its batches belong
+        to the superseded stream position)."""
+        if self._thread is not None:
+            self._stop.set()
+            self._thread.join(timeout=5)
+            self._thread = None
+        self._q = None
 
 
 class BatchIterator:
