@@ -55,6 +55,30 @@ class TestFlashAttention:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
                                        atol=2e-4, rtol=2e-4)
 
+    @pytest.mark.parametrize("s", [9, 100, 999])
+    def test_odd_seq_lens_pad_exactly(self, s):
+        """ADVICE r2: lengths with no sublane-aligned dividing tile are
+        end-padded (q and k equally) instead of leaning on Mosaic's
+        implicit padding; forward AND grads must match the reference
+        bitwise-closely."""
+        q, k, v = qkv(s=s)
+        ref = plain_attention(q, k, v, True)
+        out = flash_attention(q, k, v, True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+        gf = jax.grad(lambda q, k, v: jnp.sum(
+            flash_attention(q, k, v, True) ** 2), (0, 1, 2))(q, k, v)
+        gr = jax.grad(lambda q, k, v: jnp.sum(
+            plain_attention(q, k, v, True) ** 2), (0, 1, 2))(q, k, v)
+        for a, b_ in zip(gf, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                       atol=2e-4, rtol=2e-4)
+
+    def test_odd_seq_non_causal_raises(self):
+        q, k, v = qkv(s=999)
+        with pytest.raises(AssertionError, match="aligned"):
+            flash_attention(q, k, v, False)
+
     def test_grads_match_reference(self):
         q, k, v = qkv(s=16)
 

@@ -432,6 +432,37 @@ def _reference(q, k, v, causal):
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_core(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                causal: bool = True, block_q: Optional[int] = None,
+                block_k: Optional[int] = None,
+                interpret: Optional[bool] = None) -> jnp.ndarray:
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    out, _ = _flash_fwd(q, k, v, causal, block_q, block_k, interpret)
+    return out
+
+
+def _aligned_len(s: int) -> bool:
+    """True when the auto tile for ``s`` divides it and is sublane-aligned
+    (a multiple of 8) — the shapes the kernel lowers efficiently."""
+    b = _auto_block(s)
+    return s % b == 0 and b % 8 == 0
+
+
+def _seq_pad(s_q: int, s_k: int) -> int:
+    """Smallest pad (applied to BOTH q and k, keeping the end-aligned
+    causal offset ``s_k - s_q`` intact) that makes both lengths aligned.
+    Static Python over static shapes; the scan is bounded and trivially
+    cheap next to tracing."""
+    for delta in range(0, 2049):
+        if _aligned_len(s_q + delta) and _aligned_len(s_k + delta):
+            return delta
+    raise ValueError(
+        f"flash_attention: no common pad aligns s_q={s_q} and s_k={s_k} "
+        f"(their residues are incompatible); pad/mask the inputs "
+        f"externally or pass explicit block sizes")
+
+
 def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                     causal: bool = True, block_q: Optional[int] = None,
                     block_k: Optional[int] = None,
@@ -440,11 +471,35 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     dividing H — GQA/MQA kv heads are shared via kernel index maps, never
     materialized with a repeat. ``block_q/block_k=None`` auto-picks the
     largest power-of-two tile (<=1024) dividing the sequence;
-    ``interpret=None`` auto-selects interpreter mode off-TPU."""
-    if interpret is None:
-        interpret = jax.default_backend() != "tpu"
-    out, _ = _flash_fwd(q, k, v, causal, block_q, block_k, interpret)
-    return out
+    ``interpret=None`` auto-selects interpreter mode off-TPU.
+
+    Sequence lengths with no sublane-aligned dividing tile (e.g. S=999,
+    which would otherwise get a whole-sequence tile whose sublane dim is
+    not a multiple of 8, or S=6000, which has no power-of-two tile at all)
+    are zero-padded at the end — q and k/v by the same amount, so the
+    end-aligned causal mask is unchanged; padded keys sit after every real
+    query's window and padded query rows are sliced off, making padding
+    exact rather than relying on Mosaic's implicit handling. Only the
+    causal path pads (padded keys would corrupt non-causal rows); passing
+    EITHER block size explicitly bypasses padding, and the blocks must
+    then divide the unpadded lengths."""
+    s, sk = q.shape[1], k.shape[1]
+    if block_q is not None or block_k is not None:
+        # Any explicit block bypasses padding entirely: the caller is
+        # tiling by hand, and the kernel's divisibility assert should
+        # speak about THEIR lengths, not internally padded ones.
+        return _flash_core(q, k, v, causal, block_q, block_k, interpret)
+    delta = _seq_pad(s, sk)
+    if delta == 0:
+        return _flash_core(q, k, v, causal, block_q, block_k, interpret)
+    assert causal, (
+        f"flash_attention: non-causal attention requires aligned sequence "
+        f"lengths (got s_q={s}, s_k={sk}); pad the sequence to a multiple "
+        f"of 8 (<=1024) or 128 and mask externally")
+    pad = ((0, 0), (0, delta), (0, 0), (0, 0))
+    out = _flash_core(jnp.pad(q, pad), jnp.pad(k, pad), jnp.pad(v, pad),
+                      causal, block_q, block_k, interpret)
+    return out[:, :s]
 
 
 def _fwd_rule(q, k, v, causal, block_q, block_k, interpret):
@@ -464,7 +519,7 @@ def _bwd_rule(causal, block_q, block_k, interpret, res, g):
                       interpret)
 
 
-flash_attention.defvjp(_fwd_rule, _bwd_rule)
+_flash_core.defvjp(_fwd_rule, _bwd_rule)
 # Consumers (models.transformer.Attention) check this to skip the GQA
 # kv-head repeat — the kernel shares kv heads via its index maps.
 flash_attention.supports_gqa = True
