@@ -1,0 +1,36 @@
+# torchft_tpu image: builds the C++ control plane into a wheel, installs
+# it, and defaults to serving the lighthouse (the reference ships the same
+# shape, /root/reference/Dockerfile: rust build -> pip install -> runtime).
+#
+#   docker build -t torchft_tpu .
+#   docker run --rm -p 29510:29510 torchft_tpu \
+#       --bind 0.0.0.0:29510 --min-replicas 2
+#
+# Training containers use the same image with a different entrypoint:
+#   docker run --rm torchft_tpu python /app/examples/train_lm.py
+
+FROM python:3.12-slim AS build
+
+RUN apt-get update && apt-get install -y --no-install-recommends \
+        g++ cmake ninja-build protobuf-compiler libprotobuf-dev \
+    && rm -rf /var/lib/apt/lists/*
+
+WORKDIR /src
+COPY pyproject.toml setup.py README.md ./
+COPY torchft_tpu ./torchft_tpu
+RUN pip wheel . -w /wheels --no-deps
+
+FROM python:3.12-slim
+
+# libprotobuf is the control plane's only runtime shared-library dep.
+RUN apt-get update && apt-get install -y --no-install-recommends \
+        libprotobuf32 \
+    && rm -rf /var/lib/apt/lists/*
+
+COPY --from=build /wheels /wheels
+RUN pip install --no-cache-dir /wheels/*.whl jax flax optax numpy
+
+WORKDIR /app
+COPY examples ./examples
+
+ENTRYPOINT ["torchft_tpu_lighthouse"]

@@ -1,0 +1,47 @@
+"""Wheel build for torchft_tpu, including the compiled C++ control plane.
+
+The reference ships its native control plane inside the wheel via maturin
+(/root/reference/pyproject.toml build-system); here the cmake/ninja build
+runs as part of ``build_py`` and the resulting ``libtorchft_tpu_core.so``
+is placed into the wheel, so installed environments never need a compiler
+at import time (the dev-tree auto-build in ``_native.py`` remains the
+fallback for editable installs).
+"""
+
+import os
+import shutil
+import subprocess
+
+from setuptools import setup
+from setuptools.command.build_py import build_py
+from setuptools.dist import Distribution
+
+ROOT = os.path.dirname(os.path.abspath(__file__))
+CORE = os.path.join(ROOT, "torchft_tpu", "_core")
+LIB = os.path.join(CORE, "build", "libtorchft_tpu_core.so")
+
+
+class build_py_with_core(build_py):
+    def run(self):
+        super().run()
+        subprocess.run(
+            ["cmake", "-B", "build", "-G", "Ninja",
+             "-DCMAKE_BUILD_TYPE=Release"],
+            cwd=CORE, check=True)
+        subprocess.run(["ninja", "-C", "build", "torchft_tpu_core"],
+                       cwd=CORE, check=True)
+        dest = os.path.join(self.build_lib, "torchft_tpu", "_core", "build")
+        os.makedirs(dest, exist_ok=True)
+        shutil.copy2(LIB, dest)
+
+
+class BinaryDistribution(Distribution):
+    """The wheel carries a compiled .so: tag it for the platform, not
+    py3-none-any."""
+
+    def has_ext_modules(self):
+        return True
+
+
+setup(cmdclass={"build_py": build_py_with_core},
+      distclass=BinaryDistribution)

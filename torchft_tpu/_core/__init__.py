@@ -1,0 +1,3 @@
+# Marker package so the C++ control-plane sources (and the compiled
+# libtorchft_tpu_core.so) ship inside wheels as package data; the Python
+# bridge is torchft_tpu._native, which loads the library via ctypes.

@@ -56,7 +56,29 @@ def _stale() -> bool:
 
 def _load() -> ctypes.CDLL:
     if _stale():
-        _build_native()
+        try:
+            _build_native()
+        except Exception as e:  # noqa: BLE001
+            # Installed wheels ship a prebuilt .so whose mtime can trail
+            # the packaged sources (install order), and the site-packages
+            # tree may be read-only / compiler-less — the shipped library
+            # matches its shipped sources by construction, so use it.
+            # Without any library at all, the failure is real.
+            if not os.path.exists(_LIB_PATH):
+                raise RuntimeError(
+                    "torchft_tpu native core missing and in-place build "
+                    f"failed ({e}); install from a wheel or make "
+                    "cmake+ninja+protobuf available") from e
+            import logging
+
+            # Warning, not debug: if the sources were genuinely edited
+            # (dev tree without a toolchain) this loads a stale ABI, and a
+            # later crash would otherwise point nowhere near the cause.
+            logging.getLogger(__name__).warning(
+                "torchft_tpu: C++ sources look newer than the built core "
+                "but rebuilding failed (%s); loading existing %s — if you "
+                "edited the C++ sources, fix the toolchain and rebuild, "
+                "or calls may cross a stale ABI", e, _LIB_PATH)
     lib = ctypes.CDLL(_LIB_PATH)
 
     c = ctypes.c_char_p

@@ -1,0 +1,77 @@
+# Typed stubs for the ctypes bridge to the C++ control plane — the
+# reference ships the same for its pyo3 module
+# (/root/reference/torchft/torchft.pyi:1-28).
+from dataclasses import dataclass
+from typing import Optional
+
+class NativeError(RuntimeError): ...
+
+class Lighthouse:
+    def __init__(
+        self,
+        bind: str = ...,
+        min_replicas: int = ...,
+        join_timeout_ms: int = ...,
+        quorum_tick_ms: int = ...,
+        heartbeat_fresh_ms: int = ...,
+        heartbeat_grace_factor: int = ...,
+    ) -> None: ...
+    def address(self) -> str: ...
+    def status(self, timeout_ms: int = ...) -> dict: ...
+    def shutdown(self) -> None: ...
+
+class ManagerServer:
+    def __init__(
+        self,
+        replica_id: str,
+        lighthouse_addr: str,
+        store_addr: str = ...,
+        bind: str = ...,
+        world_size: int = ...,
+        heartbeat_ms: int = ...,
+    ) -> None: ...
+    def address(self) -> str: ...
+    def shutdown(self) -> None: ...
+
+class Store:
+    def __init__(self, bind: str = ...) -> None: ...
+    def address(self) -> str: ...
+    def shutdown(self) -> None: ...
+
+class StoreClient:
+    def __init__(self, address: str, connect_timeout_ms: int = ...) -> None: ...
+    def set(self, key: str, value: bytes) -> None: ...
+    def get(self, key: str, timeout_ms: int = ...) -> bytes: ...
+
+@dataclass
+class QuorumResult:
+    quorum_id: int
+    recover_manager_address: str
+    store_address: str
+    max_step: int
+    max_rank: Optional[int]
+    max_world_size: int
+    replica_rank: int
+    replica_world_size: int
+    heal: bool
+
+class ManagerClient:
+    def __init__(self, address: str, connect_timeout_ms: int = ...) -> None: ...
+    @property
+    def address(self) -> str: ...
+    def quorum(
+        self,
+        rank: int,
+        step: int,
+        checkpoint_server_addr: str,
+        timeout_ms: int = ...,
+    ) -> QuorumResult: ...
+    def checkpoint_address(self, rank: int, timeout_ms: int = ...) -> str: ...
+    def should_commit(
+        self,
+        rank: int,
+        step: int,
+        should_commit: bool,
+        timeout_ms: int = ...,
+    ) -> bool: ...
+    def kill(self, msg: str = ...) -> None: ...
