@@ -503,7 +503,12 @@ class TestFourGroupMesh:
                     load_state_dict=load, state_dict=save,
                     min_replica_size=n_groups, replica_id=f"m4_{g}",
                     lighthouse_addr=lh.address(), rank=0, world_size=1,
-                    timeout_ms=20_000, quorum_timeout_ms=20_000,
+                    # Generous: four groups jit-compile concurrently on
+                    # one CPU core before their first join; under full-
+                    # suite load the slowest straggler can exceed 20s and
+                    # the early joiners' parked quorum RPCs must outlive
+                    # it (observed flake at 20s).
+                    timeout_ms=60_000, quorum_timeout_ms=60_000,
                 ),
             )
             try:

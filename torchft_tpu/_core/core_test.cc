@@ -396,6 +396,61 @@ static void test_regrow_race_after_shrink() {
   printf("test_regrow_race_after_shrink ok\n");
 }
 
+// A clean shutdown's farewell beat clears the liveness record, so a
+// survivor's next quorum cut pays only the plain join_timeout — without
+// the farewell, the leaver's still-fresh beats would defer the cut by the
+// grace window (the restart-latency regression the farewell exists to
+// avoid). Crashes send no farewell and still get staleness-bounded grace.
+static void test_farewell_clears_grace() {
+  LighthouseOpt lopt;
+  lopt.bind = "127.0.0.1:0";
+  lopt.min_replicas = 1;
+  lopt.join_timeout_ms = 200;
+  lopt.quorum_tick_ms = 10;
+  lopt.heartbeat_fresh_ms = 2'000;   // long staleness: grace would bite
+  lopt.heartbeat_grace_factor = 10;  // cap 2s, >> join_timeout
+  Lighthouse lh(lopt);
+
+  auto join = [&](const std::string& id, int64_t step) {
+    RpcClient c(lh.address(), 2000);
+    LighthouseQuorumRequest req;
+    *req.mutable_requester() = member(id, step);
+    std::string resp, err;
+    assert(c.call(kLighthouseQuorum, req.SerializeAsString(), &resp, &err,
+                  10'000));
+    LighthouseQuorumResponse r;
+    assert(r.ParseFromString(resp));
+    return r.quorum();
+  };
+  auto beat = [&](const std::string& id, bool joining, bool leaving) {
+    RpcClient c(lh.address(), 2000);
+    LighthouseHeartbeatRequest req;
+    req.set_replica_id(id);
+    req.set_joining(joining);
+    req.set_leaving(leaving);
+    std::string resp, err;
+    assert(c.call(kLighthouseHeartbeat, req.SerializeAsString(), &resp,
+                  &err, 2'000));
+  };
+
+  std::thread j1([&] { join("a", 1); });
+  Quorum q1 = join("b", 1);
+  j1.join();
+  assert(q1.participants_size() == 2);
+
+  // b heartbeats (fresh for 2s) ... then says goodbye.
+  beat("b", false, false);
+  beat("b", false, true);
+
+  // a's next round must NOT wait out the grace cap for the departed b.
+  int64_t t0 = now_ms();
+  Quorum q2 = join("a", 2);
+  int64_t waited = now_ms() - t0;
+  assert(q2.participants_size() == 1);
+  assert(waited >= 200 && waited < 1'000);
+  printf("test_farewell_clears_grace ok (%lldms)\n", (long long)waited);
+}
+
 // Shutdown must not hang while a quorum RPC is parked at the lighthouse
 // waiting for a min_replicas that never arrives.
 static void test_shutdown_while_parked() {
@@ -442,6 +497,7 @@ int main() {
   test_fast_quorum_and_id_bump();
   test_heartbeat_straggler_grace();
   test_regrow_race_after_shrink();
+  test_farewell_clears_grace();
   test_shutdown_while_parked();
   printf("ALL CORE TESTS PASSED\n");
   return 0;

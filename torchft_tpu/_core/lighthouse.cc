@@ -255,9 +255,13 @@ bool Lighthouse::handle(uint8_t method, const std::string& req,
       }
       {
         std::lock_guard<std::mutex> lk(mu_);
-        auto& b = heartbeats_[r.replica_id()];
-        b.last_ms = now_ms();
-        if (r.joining()) b.last_joining_ms = b.last_ms;
+        if (r.leaving()) {
+          heartbeats_.erase(r.replica_id());
+        } else {
+          auto& b = heartbeats_[r.replica_id()];
+          b.last_ms = now_ms();
+          if (r.joining()) b.last_joining_ms = b.last_ms;
+        }
       }
       // A joining beat can lift a fast-quorum deferral the moment the
       // announcer lands in participants_ via its Quorum RPC; no tick needed

@@ -33,6 +33,18 @@ void ManagerServer::shutdown() {
   if (inflight) inflight->cancel();
   cv_.notify_all();
   if (heartbeat_thread_.joinable()) heartbeat_thread_.join();
+  // Farewell beat: clears this replica's liveness record so survivors'
+  // next quorum cut is not deferred by our still-fresh heartbeats (clean
+  // shutdowns say goodbye; crashes rely on staleness). Best-effort.
+  try {
+    RpcClient c(opt_.lighthouse_addr, 1'000);
+    LighthouseHeartbeatRequest r;
+    r.set_replica_id(opt_.replica_id);
+    r.set_leaving(true);
+    std::string resp, err;
+    c.call(kLighthouseHeartbeat, r.SerializeAsString(), &resp, &err, 1'000);
+  } catch (...) {
+  }
   server_->shutdown();
 }
 

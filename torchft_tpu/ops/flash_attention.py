@@ -8,8 +8,18 @@ because long-context is first-class in the TPU build and the plain
 attention in :mod:`torchft_tpu.models.transformer` is HBM-bound at long S.
 
 Measured (v5e, bf16, H=8 D=128, fwd+backward, auto tiles): S=16384 at
-32 ms / 59 TFLOP/s; S=65536 at 334 ms / 92 TFLOP/s (47% of bf16 peak) —
+~32 ms / ~60 TFLOP/s; S=65536 at 334 ms / 92 TFLOP/s (47% of bf16 peak) —
 dense attention at S=64k would need a 34 GB score matrix per head-batch.
+Where the remaining headroom is (profiled r3): the kernel is VPU-bound,
+not MXU-bound. Per [1024, 1024] k-step the two matmuls cost ~2.7 us of
+MXU while the online-softmax element passes (mask select, running-max,
+exp, row-sum) cost ~4+ us of VPU at full vector throughput, so the
+structure caps near ~35% of matmul peak at these shapes regardless of
+tiling (a [(bq, bk)] sweep confirms 1024x1024 is already optimal, and
+hoisting the mask behind lax.cond makes it WORSE — Mosaic serializes
+around scalar control flow). Head_dim matters more than tiles: d=128
+fills the MXU contraction; d=64 halves it (54% -> 68% step MFU on the
+bench transformer from the head shape alone).
 
 Kernel structure: grid (batch*heads, q_blocks, k_blocks). The innermost
 (k) grid dimension is sequential on a TPU core, so the running
@@ -70,15 +80,25 @@ def _fwd_kernel(*refs, causal: bool, scale: float, nkb: int, offset: int,
 
     @pl.when(diag_ok)
     def _compute():
-        q = q_ref[0].astype(jnp.float32) * scale          # [bq, d]
-        k = k_ref[0].astype(jnp.float32)                  # [bk, d]
-        v = v_ref[0].astype(jnp.float32)                  # [bk, d]
-        logits = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+        # Matmul inputs stay in the INPUT dtype (bf16 in training) with
+        # f32 accumulation — upcasting q/k/v first would push the MXU off
+        # its bf16 fast path and roughly halve kernel throughput at
+        # moderate S (measured: the S=2048 fwd+bwd at ~17% of bf16 peak
+        # with f32 operands). Softmax statistics stay f32 throughout.
+        q = q_ref[0]                                      # [bq, d]
+        k = k_ref[0]                                      # [bk, d]
+        v = v_ref[0]                                      # [bk, d]
+        logits = jnp.dot(q, k.T,
+                         preferred_element_type=jnp.float32) * scale
         if causal or dynamic_shift:
+            # Mask from two 1-D iotas and ONE broadcast compare: the mask
+            # is pure VPU overhead on every diagonal-adjacent block, and
+            # materializing two full [bq, bk] i32 iotas costs ~3x the
+            # passes of a [bq,1] vs [1,bk] broadcast.
             q_pos = offset + qi * bq + jax.lax.broadcasted_iota(
-                jnp.int32, (bq, bk), 0)
+                jnp.int32, (bq, 1), 0)
             k_pos = ki * bk + jax.lax.broadcasted_iota(
-                jnp.int32, (bq, bk), 1)
+                jnp.int32, (1, bk), 1)
             if dynamic_shift:
                 # Traced mask selector (ring attention): q_pos + shift >=
                 # k_pos. shift=0 → diagonal causal; shift >= s_k → full
@@ -93,7 +113,7 @@ def _fwd_kernel(*refs, causal: bool, scale: float, nkb: int, offset: int,
         m_ref[:] = m_new
         l_ref[:] = l_ref[:] * corr + jnp.sum(p, axis=-1, keepdims=True)
         acc_ref[:] = acc_ref[:] * corr + jnp.dot(
-            p, v, preferred_element_type=jnp.float32)
+            p.astype(v.dtype), v, preferred_element_type=jnp.float32)
 
     @pl.when(ki == nkb - 1)
     def _finalize():
@@ -135,8 +155,12 @@ def _flash_fwd(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     assert h % h_kv == 0, f"num_heads {h} not a multiple of kv heads {h_kv}"
     rep = h // h_kv
     scale = d ** -0.5
-    block_q = block_q or _auto_block(s)
-    block_k = block_k or _auto_block(k.shape[1])
+    # Wider heads need smaller tiles: the [bq, bk] f32 score/prob buffers
+    # plus the [b*, d] operand tiles must fit scoped VMEM (16 MB); at
+    # d > 128 a 1024-tile overflows it (observed: d=192 at 17.45M).
+    cap = 1024 if d <= 128 else 512
+    block_q = block_q or _auto_block(s, cap=cap)
+    block_k = block_k or _auto_block(k.shape[1], cap=cap)
     dynamic_shift = shift is not None
 
     def to_bh(x):
@@ -212,17 +236,19 @@ def _recompute_p_ds(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     (delta - g_lse) — d(lse)/d(logits) is the softmax itself."""
     bq = q_ref.shape[1]
     bk = k_ref.shape[1]
-    q = q_ref[0].astype(jnp.float32)                  # [bq, d]
-    k = k_ref[0].astype(jnp.float32)                  # [bk, d]
-    v = v_ref[0].astype(jnp.float32)                  # [bk, d]
-    do = do_ref[0].astype(jnp.float32)                # [bq, d]
+    # Native-dtype matmul inputs, f32 accumulation (see _fwd_kernel note).
+    q = q_ref[0]                                      # [bq, d]
+    k = k_ref[0]                                      # [bk, d]
+    v = v_ref[0]                                      # [bk, d]
+    do = do_ref[0]                                    # [bq, d]
     logits = jnp.dot(q, k.T,
                      preferred_element_type=jnp.float32) * scale
     if causal or shift_ref is not None:
+        # Same broadcast-compare mask as the forward (see _fwd_kernel).
         q_pos = offset + qi * bq + jax.lax.broadcasted_iota(
-            jnp.int32, (bq, bk), 0)
+            jnp.int32, (bq, 1), 0)
         k_pos = ki * bk + jax.lax.broadcasted_iota(
-            jnp.int32, (bq, bk), 1)
+            jnp.int32, (1, bk), 1)
         if shift_ref is not None:
             q_pos = q_pos + shift_ref[0, 0]
         logits = jnp.where(q_pos >= k_pos, logits, NEG_INF)
@@ -260,7 +286,7 @@ def _bwd_dq_kernel(*refs, causal: bool, scale: float, nkb: int,
         _, ds, _, k, _ = _recompute_p_ds(
             q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             qi, ki, causal, scale, offset, shift_ref)
-        acc_ref[:] += jnp.dot(ds, k,
+        acc_ref[:] += jnp.dot(ds.astype(k.dtype), k,
                               preferred_element_type=jnp.float32) * scale
 
     @pl.when(ki == nkb - 1)
@@ -295,9 +321,9 @@ def _bwd_dkdv_kernel(*refs, causal: bool, scale: float, nqb: int,
         p, ds, q, _, do = _recompute_p_ds(
             q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             qi, ki, causal, scale, offset, shift_ref)
-        dv_acc[:] += jnp.dot(p.T, do,
+        dv_acc[:] += jnp.dot(p.astype(do.dtype).T, do,
                              preferred_element_type=jnp.float32)
-        dk_acc[:] += jnp.dot(ds.T, q,
+        dk_acc[:] += jnp.dot(ds.astype(q.dtype).T, q,
                              preferred_element_type=jnp.float32) * scale
 
     @pl.when(qi == nqb - 1)
@@ -324,8 +350,9 @@ def _flash_bwd(q, k, v, out, lse, g, causal: bool, block_q: Optional[int],
     qh, kh, vh = to_bh(q), to_bh(k), to_bh(v)
     doh, oh = to_bh(g), to_bh(out)
     sk = kh.shape[1]
-    block_q = min(block_q or _auto_block(s), s)
-    block_k = min(block_k or _auto_block(sk), sk)
+    cap = 1024 if d <= 128 else 512  # see _flash_fwd's VMEM note
+    block_q = min(block_q or _auto_block(s, cap=cap), s)
+    block_k = min(block_k or _auto_block(sk, cap=cap), sk)
     nqb = s // block_q
     nkb = sk // block_k
     offset = sk - s
