@@ -266,11 +266,16 @@ class CheckpointServer:
     @classmethod
     def load_from_address(cls, address: str, target: T,
                           timeout_sec: float = 300.0,
-                          device_put: bool = True) -> T:
+                          device_put: bool = True,
+                          stats: Optional[dict] = None) -> T:
         """Fetch a peer's live checkpoint and restore it into ``target``'s
         structure (and shardings, when ``device_put``). Streams: each leaf
         is read off the socket into a preallocated buffer and device_put
-        before the next is read — healing never buffers the full payload."""
+        before the next is read — healing never buffers the full payload.
+
+        ``stats``, when given, is filled with ``{"bytes": <payload size>}``
+        so callers (Manager metrics) can report transfer volume without
+        re-parsing logs."""
         logger.info("fetching checkpoint from %s", address)
         t0 = time.perf_counter()
         with urllib.request.urlopen(address, timeout=timeout_sec) as resp:
@@ -281,4 +286,6 @@ class CheckpointServer:
         dt = time.perf_counter() - t0
         logger.info("checkpoint transfer: %.1f MB in %.2fs (%.0f MB/s)",
                     nbytes / 1e6, dt, nbytes / 1e6 / max(dt, 1e-9))
+        if stats is not None:
+            stats["bytes"] = float(nbytes)
         return out

@@ -164,6 +164,7 @@ class Manager:
         self._metrics: Dict[str, float] = {
             "quorum_count": 0, "quorum_ms_total": 0.0, "quorum_ms_last": 0.0,
             "reconfigure_count": 0, "heal_count": 0,
+            "heal_ms_total": 0.0, "heal_bytes_total": 0.0,
             "allreduce_count": 0, "allreduce_ms_total": 0.0,
             "commit_count": 0, "commit_ms_total": 0.0,
             "committed_steps": 0, "aborted_steps": 0,
@@ -348,17 +349,31 @@ class Manager:
                 "%s healing from %s at step %d",
                 self._replica_id, q.recover_manager_address, q.max_step,
             )
-            primary = ManagerClient(
-                q.recover_manager_address, connect_timeout_ms=self._timeout_ms
-            )
-            ckpt_addr = primary.checkpoint_address(
-                self._rank, timeout_ms=self._timeout_ms
-            )
-            target = self._manager_state_dict()
-            state = cast(
-                Dict[str, Any],
-                CheckpointServer.load_from_address(ckpt_addr, target),
-            )
+            heal_t0 = time.perf_counter()
+            heal_stats: Dict[str, float] = {}
+            try:
+                primary = ManagerClient(
+                    q.recover_manager_address,
+                    connect_timeout_ms=self._timeout_ms,
+                )
+                ckpt_addr = primary.checkpoint_address(
+                    self._rank, timeout_ms=self._timeout_ms
+                )
+                target = self._manager_state_dict()
+                state = cast(
+                    Dict[str, Any],
+                    CheckpointServer.load_from_address(
+                        ckpt_addr, target, stats=heal_stats),
+                )
+            finally:
+                # Failed heals count too: without this, an aborted fetch's
+                # seconds leak into whatever the caller's "unattributed"
+                # bucket is — the exact misattribution heal_ms_total exists
+                # to prevent.
+                self._record(
+                    heal_ms_total=(time.perf_counter() - heal_t0) * 1e3,
+                    heal_bytes_total=heal_stats.get("bytes", 0.0),
+                )
             # Manager metadata restores immediately on this thread; the user
             # pytree is staged and applied on the main thread at commit
             # (reference manager.py:391-396).
