@@ -183,7 +183,9 @@ def bench_single_group(steps: int = 20, segments: int = 3,
 
 def bench_multigroup(n_groups: int = 2, steps: int = 20,
                      hidden: int = 512,
-                     backend: str = "host") -> Dict[str, float]:
+                     backend: str = "host",
+                     bucket_bytes: int = 4 << 20,
+                     wire_dtype: Optional[Any] = None) -> Dict[str, float]:
     """N replica groups as threads, real cross-group gradient traffic.
 
     backend="host": device_get -> HostCommunicator ring allreduce over
@@ -228,6 +230,8 @@ def bench_multigroup(n_groups: int = 2, steps: int = 20,
                 state_dict=save, min_replica_size=n_groups, replica_id=gid,
                 lighthouse_addr=lh.address(), rank=0, world_size=1,
                 quorum_timeout_ms=30_000,
+                allreduce_bucket_bytes=bucket_bytes,
+                allreduce_wire_dtype=wire_dtype,
             ),
         )
         b = {"x": x, "y": y}
@@ -644,6 +648,14 @@ def main() -> None:
            "n_groups": mg["n_groups"], "backend": "host",
            "allreduce_ms_avg": round(mg["allreduce_ms_avg"], 2),
            "grad_mbytes": round(mg["grad_mbytes"], 2)})
+
+    mw = bench_multigroup(wire_dtype=jnp.bfloat16)
+    _emit({"metric": "multigroup_bf16_wire_steps_per_s",
+           "value": round(mw["steps_per_s"], 2), "unit": "steps/s",
+           "n_groups": mw["n_groups"], "backend": "host+bf16wire",
+           "allreduce_ms_avg": round(mw["allreduce_ms_avg"], 2),
+           "speedup_vs_exact": round(mw["steps_per_s"]
+                                     / max(mg["steps_per_s"], 1e-9), 2)})
 
     mm = bench_multigroup(backend="mesh")
     _emit({"metric": "multigroup_mesh_steps_per_s",

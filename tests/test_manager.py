@@ -10,6 +10,7 @@ recovery, spares participation, and 1/n numerics.
 
 from unittest.mock import MagicMock, patch
 
+import jax
 import numpy as np
 import pytest
 
@@ -44,7 +45,7 @@ def quorum_result(
 
 def make_manager(client, comm=None, use_async_quorum=True,
                  min_replica_size=2, world_size_mode=WorldSizeMode.DYNAMIC,
-                 load_state_dict=None, state_dict=None):
+                 load_state_dict=None, state_dict=None, **kwargs):
     return Manager(
         comm=comm or DummyCommunicator(),
         load_state_dict=load_state_dict or MagicMock(),
@@ -56,6 +57,7 @@ def make_manager(client, comm=None, use_async_quorum=True,
         world_size=1,
         replica_id="testgroup",
         _manager_client=client,
+        **kwargs,
     )
 
 
@@ -430,6 +432,132 @@ class TestNumerics:
             assert out["g"][0] == 2
         finally:
             m.shutdown()
+
+    @pytest.mark.parametrize("bucket_bytes", [1, 64, 1 << 20])
+    def test_bucketed_matches_single(self, bucket_bytes):
+        """The pipelined bucketed host allreduce is numerically identical
+        to the single-shot path (VERDICT r3 #2: numerics-unchanged test).
+        bucket_bytes=1 forces one bucket per leaf; 1MB collapses to a
+        single bucket (the old behavior)."""
+        import threading as _t
+
+        from torchft_tpu._native import Store
+        from torchft_tpu.backends.host import HostCommunicator
+
+        store = Store(bind="127.0.0.1:0")
+        world = 2
+        rng = np.random.default_rng(0)
+        tree = {
+            "a": rng.normal(size=(17, 3)).astype(np.float32),
+            "b": rng.normal(size=(130,)).astype(np.float32),
+            "c": {"d": rng.normal(size=(5,)).astype(np.float64),
+                  "e": np.arange(6, dtype=np.int64)},
+        }
+        expected = {  # mean of (tree, 2*tree) = 1.5*tree; int floor-divides
+            "a": tree["a"] * 1.5,
+            "b": tree["b"] * 1.5,
+            "c": {"d": tree["c"]["d"] * 1.5,
+                  "e": (tree["c"]["e"] * 3) // 2},
+        }
+        results = [None] * world
+        errors = []
+
+        def run(rank):
+            client = MagicMock()
+            client.quorum.return_value = quorum_result(
+                store_address=store.address(),
+                max_rank=rank, max_world_size=world,
+                replica_rank=rank, replica_world_size=world)
+            client.should_commit.return_value = True
+            m = make_manager(
+                client, comm=HostCommunicator(timeout_sec=30),
+                allreduce_bucket_bytes=bucket_bytes)
+            try:
+                m.step()
+                scaled = jax.tree_util.tree_map(
+                    lambda a: a * (rank + 1), tree)
+                results[rank] = m.allreduce(scaled).result(timeout=30)
+                assert m.should_commit()
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+            finally:
+                m.shutdown()
+
+        threads = [_t.Thread(target=run, args=(r,)) for r in range(world)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        alive = [t for t in threads if t.is_alive()]
+        store.shutdown()
+        assert not alive, "pipelined allreduce deadlocked"
+        assert not errors, errors
+        for out in results:
+            assert out is not None, "worker produced no result"
+            flat_out = jax.tree_util.tree_leaves(out)
+            flat_exp = jax.tree_util.tree_leaves(expected)
+            assert len(flat_out) == len(flat_exp)
+            for o, e in zip(flat_out, flat_exp):
+                np.testing.assert_array_equal(np.asarray(o), e)
+
+    def test_bf16_wire_compression_close_to_exact(self):
+        """allreduce_wire_dtype=bfloat16 quantizes each local contribution
+        once; the sum/scale stay f32, so the result tracks the exact mean
+        within bf16 rounding (~3 decimal digits)."""
+        import threading as _t
+
+        import jax.numpy as jnp
+
+        from torchft_tpu._native import Store
+        from torchft_tpu.backends.host import HostCommunicator
+
+        store = Store(bind="127.0.0.1:0")
+        world = 2
+        rng = np.random.default_rng(1)
+        base = rng.normal(size=(257,)).astype(np.float32)
+        results = [None] * world
+        errors = []
+
+        def run(rank):
+            client = MagicMock()
+            client.quorum.return_value = quorum_result(
+                store_address=store.address(),
+                max_rank=rank, max_world_size=world,
+                replica_rank=rank, replica_world_size=world)
+            client.should_commit.return_value = True
+            m = Manager(
+                comm=HostCommunicator(timeout_sec=30),
+                load_state_dict=MagicMock(),
+                state_dict=lambda: {},
+                min_replica_size=2, rank=0, world_size=1,
+                replica_id=f"wire{rank}",
+                allreduce_wire_dtype=jnp.bfloat16,
+                _manager_client=client,
+            )
+            try:
+                m.step()
+                tree = {"g": jnp.asarray(base * (rank + 1))}
+                results[rank] = m.allreduce(tree).result(timeout=30)
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+            finally:
+                m.shutdown()
+
+        threads = [_t.Thread(target=run, args=(r,)) for r in range(world)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        alive = [t for t in threads if t.is_alive()]
+        store.shutdown()
+        assert not alive, "wire-compressed allreduce deadlocked"
+        assert not errors, errors
+        for out in results:
+            assert out is not None, "worker produced no result"
+            # Callers must get their original dtype back, not the wire one.
+            assert np.dtype(out["g"].dtype) == np.float32
+            got = np.asarray(out["g"])
+            np.testing.assert_allclose(got, base * 1.5, rtol=1e-2, atol=1e-2)
 
     def test_state_dict_roundtrip(self):
         client = MagicMock()
