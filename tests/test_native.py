@@ -176,6 +176,26 @@ def test_heartbeat_grace_options_plumbed():
         lh.shutdown()
 
 
+def test_eviction_option_plumbed():
+    """The fast-eviction knob reaches the C++ lighthouse (the eviction
+    semantics are covered by core_test.cc); factor=0 disables it and must
+    still form quorums."""
+    for factor in (0, 3):
+        lh = Lighthouse(bind="127.0.0.1:0", min_replicas=1,
+                        join_timeout_ms=50, quorum_tick_ms=10,
+                        eviction_staleness_factor=factor)
+        try:
+            m = ManagerServer(f"evict{factor}", lh.address(),
+                              bind="127.0.0.1:0", world_size=1)
+            c = ManagerClient(m.address())
+            q = c.quorum(rank=0, step=1, checkpoint_server_addr="x",
+                         timeout_ms=10_000)
+            assert q.replica_world_size == 1
+            m.shutdown()
+        finally:
+            lh.shutdown()
+
+
 def test_step_retry_gets_fresh_rounds():
     """After a failed commit the Manager retries the SAME step; both the
     quorum and the vote must run fresh rounds, not replay the stale result

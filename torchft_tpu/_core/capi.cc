@@ -45,7 +45,8 @@ void tft_free(void* p) { free(p); }
 void* tft_lighthouse_new(const char* bind, uint64_t min_replicas,
                          int64_t join_timeout_ms, int64_t quorum_tick_ms,
                          int64_t heartbeat_fresh_ms,
-                         int64_t heartbeat_grace_factor, char** err) {
+                         int64_t heartbeat_grace_factor,
+                         int64_t eviction_staleness_factor, char** err) {
   try {
     LighthouseOpt opt;
     opt.bind = bind;
@@ -54,6 +55,7 @@ void* tft_lighthouse_new(const char* bind, uint64_t min_replicas,
     opt.quorum_tick_ms = quorum_tick_ms;
     opt.heartbeat_fresh_ms = heartbeat_fresh_ms;
     opt.heartbeat_grace_factor = heartbeat_grace_factor;
+    opt.eviction_staleness_factor = eviction_staleness_factor;
     return new Lighthouse(opt);
   } catch (const std::exception& e) {
     fail(err, e.what());

@@ -169,6 +169,42 @@ bool Lighthouse::quorum_valid_locked() const {
     if (all_present) return true;
   }
   if (participants_.size() < opt_.min_replicas) return false;
+  // Fast eviction: the round is shrinking, nobody alive is being excluded
+  // (pending_alive is false), and every missing previous member is provably
+  // gone — beats stale by >= eviction_staleness_factor * heartbeat_fresh_ms,
+  // or farewell'd (record erased). Waiting join_timeout_ms for a crashed
+  // process to show up only stalls the survivors; cut now. An alive member
+  // keeps beating from its dedicated heartbeat thread even while wedged, so
+  // it still gets the full straggler wait below.
+  if (has_prev_quorum_ && !pending_alive &&
+      opt_.eviction_staleness_factor > 0) {
+    const int64_t stale_ms =
+        opt_.eviction_staleness_factor * opt_.heartbeat_fresh_ms;
+    bool any_missing = false;
+    bool all_missing_gone = true;
+    for (const auto& m : prev_quorum_.participants()) {
+      if (participants_.count(m.replica_id())) continue;
+      any_missing = true;
+      auto hb = heartbeats_.find(m.replica_id());
+      if (hb == heartbeats_.end()) {
+        // Provably gone only via explicit farewell; a member that never
+        // beat gets the join-timeout benefit of the doubt (it may be a
+        // non-beating client whose re-join is racing this round).
+        if (!departed_.count(m.replica_id())) {
+          all_missing_gone = false;
+          break;
+        }
+        continue;
+      }
+      int64_t latest =
+          std::max(hb->second.last_ms, hb->second.last_joining_ms);
+      if (latest >= 0 && now - latest < stale_ms) {
+        all_missing_gone = false;
+        break;
+      }
+    }
+    if (any_missing && all_missing_gone) return true;
+  }
   // Membership is changing (or an alive joiner is en route): give
   // stragglers join_timeout_ms — or the grace cap when pending-alive —
   // measured from the first join of this round, before forming the
@@ -195,6 +231,12 @@ bool Lighthouse::tick() {
       int64_t latest = std::max(it->second.last_ms, it->second.last_joining_ms);
       if (now - latest > keep_ms && !prev_ids.count(it->first))
         it = heartbeats_.erase(it);
+      else
+        ++it;
+    }
+    for (auto it = departed_.begin(); it != departed_.end();) {
+      if (now - it->second > keep_ms && !prev_ids.count(it->first))
+        it = departed_.erase(it);
       else
         ++it;
     }
@@ -232,6 +274,10 @@ bool Lighthouse::handle(uint8_t method, const std::string& req,
       std::unique_lock<std::mutex> lk(mu_);
       if (participants_.empty()) first_join_ms_ = now_ms();
       participants_[r.requester().replica_id()] = {r.requester(), now_ms()};
+      // A join is proof of life: clear any stale farewell from a previous
+      // incarnation of this id, or fast eviction would treat the live,
+      // re-joined (possibly never-beating) member as provably gone.
+      departed_.erase(r.requester().replica_id());
       int64_t entry_seq = broadcast_seq_;
       tick();  // proactive: don't wait for the tick thread if already valid
       while (broadcast_seq_ == entry_seq && !shutdown_) {
@@ -257,10 +303,12 @@ bool Lighthouse::handle(uint8_t method, const std::string& req,
         std::lock_guard<std::mutex> lk(mu_);
         if (r.leaving()) {
           heartbeats_.erase(r.replica_id());
+          departed_[r.replica_id()] = now_ms();
         } else {
           auto& b = heartbeats_[r.replica_id()];
           b.last_ms = now_ms();
           if (r.joining()) b.last_joining_ms = b.last_ms;
+          departed_.erase(r.replica_id());  // back from the dead
         }
       }
       // A joining beat can lift a fast-quorum deferral the moment the

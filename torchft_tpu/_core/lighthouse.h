@@ -46,6 +46,17 @@ struct LighthouseOpt {
   // heartbeat_grace_factor = 1 to disable (reference behavior).
   int64_t heartbeat_fresh_ms = 500;
   int64_t heartbeat_grace_factor = 4;
+  // Fast eviction (inverse of the grace deferral): when every previous-
+  // quorum member missing from this round is *provably* gone — its latest
+  // heartbeat is staler than eviction_staleness_factor * heartbeat_fresh_ms,
+  // or it said farewell (leaving beat erases its record) — the shrunken
+  // quorum cuts immediately instead of granting stragglers join_timeout_ms.
+  // With the defaults (3 * 500ms) a crashed group stalls survivors ~1.5s
+  // rather than the 60s binary-default join timeout. A wedged-but-alive
+  // group still beats from its heartbeat thread, so it gets the full
+  // timeout (and grace). The reference can't do this: its heartbeats are
+  // dashboard-only (src/lighthouse.rs:378-391). 0 disables.
+  int64_t eviction_staleness_factor = 3;
 };
 
 class Lighthouse {
@@ -90,6 +101,11 @@ class Lighthouse {
     int64_t last_joining_ms = -1;  // heartbeat with joining=true
   };
   std::map<std::string, Beat> heartbeats_;  // replica_id -> last seen
+  // Clean goodbyes (leaving-flagged beats). A missing member is *provably*
+  // gone only if it farewell'd or its beats went stale; a member that never
+  // beat at all gets the plain join-timeout benefit of the doubt (it may be
+  // a non-beating client racing its first join). replica_id -> farewell ms.
+  std::map<std::string, int64_t> departed_;
   bool shutdown_ = false;
 
   std::thread tick_thread_;

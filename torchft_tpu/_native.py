@@ -90,7 +90,7 @@ def _load() -> ctypes.CDLL:
     lib.tft_free.argtypes = [vp]
     lib.tft_free.restype = None
 
-    lib.tft_lighthouse_new.argtypes = [c, u64, i64, i64, i64, i64,
+    lib.tft_lighthouse_new.argtypes = [c, u64, i64, i64, i64, i64, i64,
                                        ctypes.POINTER(vp)]
     lib.tft_lighthouse_new.restype = vp
     lib.tft_lighthouse_address.argtypes = [vp]
@@ -200,19 +200,28 @@ class Lighthouse:
     def __init__(self, bind: str = "0.0.0.0:0", min_replicas: int = 1,
                  join_timeout_ms: int = 100, quorum_tick_ms: int = 100,
                  heartbeat_fresh_ms: int = 500,
-                 heartbeat_grace_factor: int = 4):
+                 heartbeat_grace_factor: int = 4,
+                 eviction_staleness_factor: int = 3):
         """``heartbeat_fresh_ms``/``heartbeat_grace_factor``: a previous
         member absent from the join round but heartbeating within
         ``heartbeat_fresh_ms`` extends the straggler wait to
         ``heartbeat_grace_factor * join_timeout_ms`` (it is alive and en
         route; cutting it out forks the job into split quorums). Factor 1
-        restores reference behavior (heartbeats visualized only)."""
+        restores reference behavior (heartbeats visualized only).
+
+        ``eviction_staleness_factor``: the inverse lever — when every
+        previous member missing from a round is provably gone (beats staler
+        than ``eviction_staleness_factor * heartbeat_fresh_ms``, or clean
+        farewell), the shrunken quorum cuts immediately instead of waiting
+        ``join_timeout_ms``. 0 disables (reference behavior: a crashed
+        group stalls survivors for the full join timeout)."""
         err = ctypes.c_void_p()
         self._h = _check_handle(
             lib().tft_lighthouse_new(bind.encode(), min_replicas,
                                      join_timeout_ms, quorum_tick_ms,
                                      heartbeat_fresh_ms,
                                      heartbeat_grace_factor,
+                                     eviction_staleness_factor,
                                      ctypes.byref(err)), err)
 
     def address(self) -> str:

@@ -15,6 +15,7 @@ class Lighthouse:
         quorum_tick_ms: int = ...,
         heartbeat_fresh_ms: int = ...,
         heartbeat_grace_factor: int = ...,
+        eviction_staleness_factor: int = ...,
     ) -> None: ...
     def address(self) -> str: ...
     def status(self, timeout_ms: int = ...) -> dict: ...

@@ -36,6 +36,11 @@ def main(argv: list[str] | None = None) -> None:
                         help="straggler wait extends to factor * "
                         "join_timeout while such a member keeps beating "
                         "(1 = reference behavior)")
+    parser.add_argument("--eviction-staleness-factor", type=int, default=3,
+                        help="cut a shrunken quorum immediately when every "
+                        "missing member's beats are staler than factor * "
+                        "heartbeat_fresh_ms (0 = wait the full join "
+                        "timeout, reference behavior)")
     args = parser.parse_args(argv)
 
     logging.basicConfig(level=logging.INFO)
@@ -46,6 +51,7 @@ def main(argv: list[str] | None = None) -> None:
         quorum_tick_ms=args.quorum_tick_ms,
         heartbeat_fresh_ms=args.heartbeat_fresh_ms,
         heartbeat_grace_factor=args.heartbeat_grace_factor,
+        eviction_staleness_factor=args.eviction_staleness_factor,
     )
     logging.info("lighthouse listening on %s (dashboard: http://%s/)",
                  lh.address(), lh.address())

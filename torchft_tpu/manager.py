@@ -507,13 +507,23 @@ class Manager:
             put thread:       1/n scale + device_put of i-1 (H2D)
 
         so wire transfer, device fetch, and device restore all overlap
-        instead of running back-to-back. Per-element numerics are identical
-        to the single-shot path (same rank-order adds, same 1/n), asserted
-        by tests/test_manager.py::TestNumerics::test_bucketed_matches_single.
+        instead of running back-to-back. Results are bitwise identical
+        across ranks (every rank runs the same bucket schedule and ring
+        order). At world_size 2 they are also bitwise identical to the
+        single-shot path (two-term sums are order-insensitive; asserted by
+        tests/test_manager.py::TestNumerics::test_bucketed_matches_single);
+        at world_size >= 3 ring chunk boundaries shift with bucketing, so
+        per-element accumulation *order* can differ from the single-shot
+        path by last-ulp rounding — the same reorder tolerance any ring
+        collective already implies across world sizes.
+
+        The ``allreduce_ms_total`` metric for this path spans the whole
+        exchange — device fetch, ring, scale, and device restore — i.e.
+        the full cross-group cost a step pays; the on-device mesh path's
+        metric covers only its single fused reduction.
         """
         n = max(self.num_participants(), 1)
         participating = self.is_participating()
-        buckets = _make_buckets(leaves, self._bucket_bytes)
         ar_t0 = time.perf_counter()
 
         # Optional wire compression (allreduce_wire_dtype, e.g. bfloat16):
@@ -524,21 +534,35 @@ class Manager:
         # the only rounding is one bf16 quantization of each local
         # contribution, the standard gradient-compression tradeoff the
         # reference lacks entirely (round-3 verdict weak #3).
+        wire = self._wire_dtype
+
+        def compressible(leaf: Any) -> bool:
+            return (wire is not None and isinstance(leaf, jax.Array)
+                    and jnp.issubdtype(leaf.dtype, jnp.floating)
+                    and np.dtype(leaf.dtype).itemsize > wire.itemsize)
+
         fetch = leaves
-        if participating and self._wire_dtype is not None:
-            wire = self._wire_dtype
-            cidx = [
-                i for i, leaf in enumerate(leaves)
-                if isinstance(leaf, jax.Array)
-                and jnp.issubdtype(leaf.dtype, jnp.floating)
-                and np.dtype(leaf.dtype).itemsize > wire.itemsize
-            ]
+        if participating and wire is not None:
+            cidx = [i for i, leaf in enumerate(leaves) if compressible(leaf)]
             if cidx:
                 compressed = _compress_leaves(
                     [leaves[i] for i in cidx], str(wire))
                 fetch = list(leaves)
                 for i, c in zip(cidx, compressed):
                     fetch[i] = c
+
+        # Bucket by *wire* bytes — compressed sizes for compressible leaves
+        # — so each bucket actually moves ~bucket_bytes over the D2H leg it
+        # exists to amortize. Sizes come from leaf METADATA (not from
+        # `fetch`, which healing/spare ranks leave uncompressed): every rank
+        # must derive the identical bucket schedule or the ring wedges on
+        # mismatched payload boundaries.
+        def wire_nbytes(leaf: Any) -> int:
+            dt = np.dtype(wire) if compressible(leaf) else np.dtype(
+                getattr(leaf, "dtype", None) or np.asarray(leaf).dtype)
+            return int(np.prod(np.shape(leaf)) or 1) * dt.itemsize
+        buckets = _make_buckets(
+            [wire_nbytes(leaf) for leaf in leaves], self._bucket_bytes)
         agg: Future = Future()
         out_leaves: list = [None] * len(leaves)
         lock = threading.Lock()
@@ -588,9 +612,7 @@ class Manager:
                             finish_bucket, idx, f.result())
                     except Exception as e2:  # executor shut down mid-step
                         if not agg.done():
-                            agg.set_exception(
-                                e2 if isinstance(e2, Exception)
-                                else RuntimeError(str(e2)))
+                            agg.set_exception(e2)
             return cb
 
         # Stage 1, on the caller thread: fetch bucket i+1 while the comm
@@ -827,17 +849,16 @@ def _zero_like(leaf: Any) -> np.ndarray:
     )
 
 
-def _make_buckets(leaves: list, bucket_bytes: int) -> list:
-    """Greedy split of a leaf list into index buckets of >= ``bucket_bytes``
-    each (except possibly the last), preserving leaf order so every rank
-    produces an identical bucket schedule."""
+def _make_buckets(sizes: list, bucket_bytes: int) -> list:
+    """Greedy split of per-leaf byte sizes into index buckets of >=
+    ``bucket_bytes`` each (except possibly the last), preserving leaf order
+    so every rank produces an identical bucket schedule."""
     buckets: list = []
     cur: list = []
     cur_bytes = 0
-    for i, leaf in enumerate(leaves):
-        dt = getattr(leaf, "dtype", None) or np.asarray(leaf).dtype
+    for i, nbytes in enumerate(sizes):
         cur.append(i)
-        cur_bytes += int(np.prod(np.shape(leaf)) or 1) * np.dtype(dt).itemsize
+        cur_bytes += int(nbytes)
         if cur_bytes >= bucket_bytes:
             buckets.append(cur)
             cur, cur_bytes = [], 0
