@@ -95,6 +95,13 @@ char* tft_manager_address(void* h) {
   return dup_str(((ManagerServer*)h)->address());
 }
 
+void tft_manager_set_status(void* h, const char* metrics_json,
+                            int64_t heal_count, int64_t committed_steps,
+                            int64_t aborted_steps) {
+  ((ManagerServer*)h)->set_status(metrics_json, heal_count, committed_steps,
+                                  aborted_steps);
+}
+
 void tft_manager_shutdown(void* h) { ((ManagerServer*)h)->shutdown(); }
 
 void tft_manager_free(void* h) { delete (ManagerServer*)h; }

@@ -69,29 +69,6 @@ static std::string url_encode(const std::string& s) {
   return out;
 }
 
-static std::string json_escape(const std::string& s) {
-  std::string out;
-  out.reserve(s.size());
-  for (unsigned char c : s) {
-    switch (c) {
-      case '"': out += "\\\""; break;
-      case '\\': out += "\\\\"; break;
-      case '\n': out += "\\n"; break;
-      case '\r': out += "\\r"; break;
-      case '\t': out += "\\t"; break;
-      default:
-        if (c < 0x20) {
-          char buf[8];
-          snprintf(buf, sizeof buf, "\\u%04x", c);
-          out += buf;
-        } else {
-          out += (char)c;
-        }
-    }
-  }
-  return out;
-}
-
 std::string Lighthouse::status_json(const StatusResponse& r) {
   std::string out = "{\"quorum_id\":" + std::to_string(r.quorum_id()) +
                     ",\"quorum_age_ms\":" + std::to_string(r.quorum_age_ms()) +
@@ -104,6 +81,9 @@ std::string Lighthouse::status_json(const StatusResponse& r) {
            "\",\"step\":" + std::to_string(m.member().step()) +
            ",\"world_size\":" + std::to_string(m.member().world_size()) +
            ",\"heartbeat_age_ms\":" + std::to_string(m.heartbeat_age_ms()) +
+           ",\"heal_count\":" + std::to_string(m.heal_count()) +
+           ",\"committed_steps\":" + std::to_string(m.committed_steps()) +
+           ",\"aborted_steps\":" + std::to_string(m.aborted_steps()) +
            "}";
   }
   out += "],\"joining\":[";
@@ -308,6 +288,9 @@ bool Lighthouse::handle(uint8_t method, const std::string& req,
           auto& b = heartbeats_[r.replica_id()];
           b.last_ms = now_ms();
           if (r.joining()) b.last_joining_ms = b.last_ms;
+          b.heal_count = r.heal_count();
+          b.committed_steps = r.committed_steps();
+          b.aborted_steps = r.aborted_steps();
           departed_.erase(r.replica_id());  // back from the dead
         }
       }
@@ -344,9 +327,14 @@ void Lighthouse::status_locked(StatusResponse* out) const {
       auto* ms = out->add_members();
       *ms->mutable_member() = m;
       auto it = heartbeats_.find(m.replica_id());
-      ms->set_heartbeat_age_ms(it == heartbeats_.end() || it->second.last_ms < 0
-                                   ? -1
-                                   : now_ms() - it->second.last_ms);
+      if (it == heartbeats_.end() || it->second.last_ms < 0) {
+        ms->set_heartbeat_age_ms(-1);
+      } else {
+        ms->set_heartbeat_age_ms(now_ms() - it->second.last_ms);
+        ms->set_heal_count(it->second.heal_count);
+        ms->set_committed_steps(it->second.committed_steps);
+        ms->set_aborted_steps(it->second.aborted_steps);
+      }
     }
   }
   for (const auto& [id, _] : participants_) out->add_joining(id);
@@ -424,7 +412,8 @@ std::string Lighthouse::handle_http(const std::string& request) {
        << "<p>quorum_id: " << st.quorum_id()
        << " &middot; age: " << st.quorum_age_ms() << "ms</p>"
        << "<table border=1 cellpadding=4><tr><th>replica</th><th>step</th>"
-       << "<th>world</th><th>heartbeat age</th><th></th></tr>";
+       << "<th>world</th><th>heartbeat age</th><th>heals</th>"
+       << "<th>committed</th><th>aborted</th><th></th></tr>";
     int64_t max_step = 0;
     for (const auto& m : st.members())
       max_step = std::max(max_step, m.member().step());
@@ -434,7 +423,9 @@ std::string Lighthouse::handle_http(const std::string& request) {
       os << "<tr" << (recovering ? " style='background:#fdd'" : "") << "><td>"
          << id << "</td><td>" << m.member().step() << "</td><td>"
          << m.member().world_size() << "</td><td>" << m.heartbeat_age_ms()
-         << "ms</td>"
+         << "ms</td><td>" << m.heal_count() << "</td><td>"
+         << m.committed_steps() << "</td><td>" << m.aborted_steps()
+         << "</td>"
          << "<td><form method=post action='/replica/"
          << url_encode(m.member().replica_id())
          << "/kill'><button>kill</button></form></td></tr>";

@@ -99,6 +99,11 @@ class Lighthouse {
   struct Beat {
     int64_t last_ms = -1;          // any heartbeat
     int64_t last_joining_ms = -1;  // heartbeat with joining=true
+    // Operational counters piggybacked on beats (see proto heal_count),
+    // surfaced on the dashboard / status.json per member.
+    int64_t heal_count = 0;
+    int64_t committed_steps = 0;
+    int64_t aborted_steps = 0;
   };
   std::map<std::string, Beat> heartbeats_;  // replica_id -> last seen
   // Clean goodbyes (leaving-flagged beats). A missing member is *provably*

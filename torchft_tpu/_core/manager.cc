@@ -5,15 +5,56 @@
 
 #include <algorithm>
 #include <cstdio>
+#include <sstream>
 #include <vector>
 
 namespace torchft_tpu {
 
 ManagerServer::ManagerServer(const ManagerOpt& opt) : opt_(opt) {
   server_ = std::make_unique<RpcServer>(
-      opt.bind, [this](uint8_t m, const std::string& req, std::string* resp,
-                       std::string* err) { return handle(m, req, resp, err); });
+      opt.bind,
+      [this](uint8_t m, const std::string& req, std::string* resp,
+             std::string* err) { return handle(m, req, resp, err); },
+      [this](const std::string& req) { return handle_http(req); });
   heartbeat_thread_ = std::thread([this] { heartbeat_loop(); });
+}
+
+void ManagerServer::set_status(const std::string& metrics_json,
+                               int64_t heal_count, int64_t committed_steps,
+                               int64_t aborted_steps) {
+  std::lock_guard<std::mutex> lk(mu_);
+  metrics_json_ = metrics_json;
+  heal_count_ = heal_count;
+  committed_steps_ = committed_steps;
+  aborted_steps_ = aborted_steps;
+}
+
+// GET /metrics.json on the manager RPC port: the Python Manager's last
+// pushed metrics snapshot (empty object before the first commit). The
+// lighthouse serves cluster-level status the same one-port way.
+std::string ManagerServer::handle_http(const std::string& request) {
+  std::string body;
+  std::string content_type = "application/json";
+  if (request.rfind("GET /metrics.json", 0) == 0 ||
+      request.rfind("GET / ", 0) == 0) {
+    std::string metrics;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      metrics = metrics_json_.empty() ? "{}" : metrics_json_;
+    }
+    // replica_id is operator-supplied config, not attacker-controlled, but
+    // escape it anyway; metrics is already JSON from the Python layer.
+    body = "{\"replica_id\":\"" + json_escape(opt_.replica_id) +
+           "\",\"status\":" + metrics + "}";
+  } else {
+    body = "{\"error\":\"unknown path; try GET /metrics.json\"}";
+  }
+  std::ostringstream resp;
+  resp << "HTTP/1.1 200 OK\r\nContent-Type: " << content_type
+       << "\r\nContent-Length: " << body.size()
+       << "\r\nConnection: close\r\n\r\n"
+       << body;
+  return resp.str();
 }
 
 ManagerServer::~ManagerServer() { shutdown(); }
@@ -54,11 +95,15 @@ void ManagerServer::heartbeat_loop() {
   std::unique_ptr<RpcClient> client;
   while (true) {
     bool joining;
+    int64_t heals, committed, aborted;
     {
       std::unique_lock<std::mutex> lk(mu_);
       cv_.wait_for(lk, std::chrono::milliseconds(opt_.heartbeat_ms));
       if (shutdown_) return;
       joining = quorum_inflight_ > 0;
+      heals = heal_count_;
+      committed = committed_steps_;
+      aborted = aborted_steps_;
     }
     try {
       if (!client)
@@ -66,6 +111,9 @@ void ManagerServer::heartbeat_loop() {
       LighthouseHeartbeatRequest r;
       r.set_replica_id(opt_.replica_id);
       r.set_joining(joining);
+      r.set_heal_count(heals);
+      r.set_committed_steps(committed);
+      r.set_aborted_steps(aborted);
       std::string resp, err;
       if (!client->call(kLighthouseHeartbeat, r.SerializeAsString(), &resp,
                         &err, 1'000))

@@ -1,6 +1,7 @@
 #include "rpc.h"
 
 #include <arpa/inet.h>
+#include <cstdio>
 #include <fcntl.h>
 #include <netdb.h>
 #include <netinet/in.h>
@@ -322,6 +323,29 @@ bool RpcClient::call(uint8_t method, const std::string& req, std::string* resp,
   }
   *err = "transport: rpc to " + address_ + " failed (timeout or disconnect)";
   return false;
+}
+
+std::string json_escape(const std::string& s) {
+  std::string out;
+  out.reserve(s.size());
+  for (unsigned char c : s) {
+    switch (c) {
+      case '"': out += "\\\""; break;
+      case '\\': out += "\\\\"; break;
+      case '\n': out += "\\n"; break;
+      case '\r': out += "\\r"; break;
+      case '\t': out += "\\t"; break;
+      default:
+        if (c < 0x20) {
+          char buf[8];
+          snprintf(buf, sizeof buf, "\\u%04x", c);
+          out += buf;
+        } else {
+          out += (char)c;
+        }
+    }
+  }
+  return out;
 }
 
 }  // namespace torchft_tpu

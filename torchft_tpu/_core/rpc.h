@@ -118,6 +118,9 @@ class RpcClient {
 };
 
 // --- small net utils (shared with the checkpoint/http bits) ---
+// JSON string-escape for hand-built status bodies (quotes, backslashes,
+// and control characters).
+std::string json_escape(const std::string& s);
 int net_listen(const std::string& bind, std::string* bound_addr);
 int net_connect(const std::string& address, int64_t timeout_ms);
 bool net_read_exact(int fd, void* buf, size_t n);

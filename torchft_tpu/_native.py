@@ -104,6 +104,8 @@ def _load() -> ctypes.CDLL:
     lib.tft_manager_address.restype = vp
     lib.tft_manager_shutdown.argtypes = [vp]
     lib.tft_manager_free.argtypes = [vp]
+    lib.tft_manager_set_status.argtypes = [vp, c, i64, i64, i64]
+    lib.tft_manager_set_status.restype = None
 
     lib.tft_store_new.argtypes = [c, ctypes.POINTER(vp)]
     lib.tft_store_new.restype = vp
@@ -260,6 +262,16 @@ class ManagerServer:
 
     def address(self) -> str:
         return _take_str(lib().tft_manager_address(self._h))
+
+    def set_status(self, metrics_json: str, heal_count: int = 0,
+                   committed_steps: int = 0, aborted_steps: int = 0) -> None:
+        """Push an operational snapshot: ``metrics_json`` is served verbatim
+        at ``GET http://<manager addr>/metrics.json``; the scalar counters
+        ride the lighthouse heartbeat so the dashboard shows per-member
+        heal/commit/abort columns."""
+        lib().tft_manager_set_status(self._h, metrics_json.encode(),
+                                     heal_count, committed_steps,
+                                     aborted_steps)
 
     def shutdown(self) -> None:
         if self._h:

@@ -32,6 +32,13 @@ class ManagerServer:
         heartbeat_ms: int = ...,
     ) -> None: ...
     def address(self) -> str: ...
+    def set_status(
+        self,
+        metrics_json: str,
+        heal_count: int = ...,
+        committed_steps: int = ...,
+        aborted_steps: int = ...,
+    ) -> None: ...
     def shutdown(self) -> None: ...
 
 class Store:
