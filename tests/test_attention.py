@@ -11,6 +11,10 @@ from torchft_tpu.ops import flash_attention
 from torchft_tpu.parallel import make_mesh
 from torchft_tpu.parallel.ring_attention import make_ring_attention
 
+# Compile-heavy tier: pallas interpret mode + sharded jit dominate suite
+# wall-clock; scripts/test.sh runs these after the fast unit tier.
+pytestmark = pytest.mark.heavy
+
 
 def qkv(b=2, s=32, h=4, d=16, seed=0, dtype=jnp.float32):
     ks = jax.random.split(jax.random.key(seed), 3)
@@ -75,8 +79,9 @@ class TestFlashAttention:
                                        atol=2e-4, rtol=2e-4)
 
     def test_odd_seq_non_causal_raises(self):
+        # ValueError (not assert): must survive `python -O`.
         q, k, v = qkv(s=999)
-        with pytest.raises(AssertionError, match="aligned"):
+        with pytest.raises(ValueError, match="aligned"):
             flash_attention(q, k, v, False)
 
     def test_grads_match_reference(self):

@@ -7,8 +7,14 @@ from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
+import pytest
+
 from bench import (bench_diloco, bench_long_context,  # noqa: E402
                    bench_multigroup, bench_recovery, bench_transformer)
+
+
+# Multi-group lighthouse/manager scenarios: integration tier.
+pytestmark = pytest.mark.integration
 
 
 class TestBenchScenarios:

@@ -24,6 +24,10 @@ from torchft_tpu.parallel import (
     shard_tree,
 )
 
+# Compile-heavy tier: pallas interpret mode + sharded jit dominate suite
+# wall-clock; scripts/test.sh runs these after the fast unit tier.
+pytestmark = pytest.mark.heavy
+
 
 class TestMesh:
     def test_default_1d(self):

@@ -1,6 +1,7 @@
 """Pipeline parallelism + MoE/expert parallelism tests (8-device CPU mesh)."""
 
 import jax
+import pytest
 import jax.numpy as jnp
 import numpy as np
 import optax
@@ -16,6 +17,10 @@ from torchft_tpu.parallel.pipeline import (
     stack_layer_params,
     transformer_pipeline_forward,
 )
+
+# Compile-heavy tier: pallas interpret mode + sharded jit dominate suite
+# wall-clock; scripts/test.sh runs these after the fast unit tier.
+pytestmark = pytest.mark.heavy
 
 
 def small_cfg(**kw):
