@@ -46,7 +46,8 @@ void* tft_lighthouse_new(const char* bind, uint64_t min_replicas,
                          int64_t join_timeout_ms, int64_t quorum_tick_ms,
                          int64_t heartbeat_fresh_ms,
                          int64_t heartbeat_grace_factor,
-                         int64_t eviction_staleness_factor, char** err) {
+                         int64_t eviction_staleness_factor,
+                         const char* auth_token, char** err) {
   try {
     LighthouseOpt opt;
     opt.bind = bind;
@@ -56,6 +57,7 @@ void* tft_lighthouse_new(const char* bind, uint64_t min_replicas,
     opt.heartbeat_fresh_ms = heartbeat_fresh_ms;
     opt.heartbeat_grace_factor = heartbeat_grace_factor;
     opt.eviction_staleness_factor = eviction_staleness_factor;
+    opt.auth_token = auth_token ? auth_token : "";
     return new Lighthouse(opt);
   } catch (const std::exception& e) {
     fail(err, e.what());
@@ -75,7 +77,8 @@ void tft_lighthouse_free(void* h) { delete (Lighthouse*)h; }
 
 void* tft_manager_new(const char* replica_id, const char* lighthouse_addr,
                       const char* bind, const char* store_addr,
-                      uint64_t world_size, int64_t heartbeat_ms, char** err) {
+                      uint64_t world_size, int64_t heartbeat_ms,
+                      const char* auth_token, char** err) {
   try {
     ManagerOpt opt;
     opt.replica_id = replica_id;
@@ -84,6 +87,7 @@ void* tft_manager_new(const char* replica_id, const char* lighthouse_addr,
     opt.store_addr = store_addr;
     opt.world_size = world_size;
     opt.heartbeat_ms = heartbeat_ms;
+    opt.auth_token = auth_token ? auth_token : "";
     return new ManagerServer(opt);
   } catch (const std::exception& e) {
     fail(err, e.what());

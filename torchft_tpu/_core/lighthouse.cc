@@ -391,8 +391,18 @@ std::string Lighthouse::handle_http(const std::string& request) {
         std::string resp, err;
         KillRequest kr;
         kr.set_msg("killed from lighthouse dashboard");
-        c.call(kManagerKill, kr.SerializeAsString(), &resp, &err, 2'000);
-        body = "killed " + id;
+        kr.set_auth_token(opt_.auth_token);
+        bool ok = c.call(kManagerKill, kr.SerializeAsString(), &resp, &err,
+                         2'000);
+        // The target exits before replying on success, so a TRANSPORT
+        // error is the expected success shape; an APPLICATION error (e.g.
+        // the manager's token gate refusing) means the replica is still
+        // alive and the operator must see why.
+        if (ok || err.rfind("transport:", 0) == 0) {
+          body = "killed " + id;
+        } else {
+          body = "kill of " + id + " refused: " + err;
+        }
       } catch (const std::exception& e) {
         body = "kill of " + id + " failed: " + e.what();
       }

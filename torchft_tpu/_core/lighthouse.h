@@ -57,6 +57,10 @@ struct LighthouseOpt {
   // timeout (and grace). The reference can't do this: its heartbeats are
   // dashboard-only (src/lighthouse.rs:378-391). 0 disables.
   int64_t eviction_staleness_factor = 3;
+  // Shared job secret forwarded in dashboard-initiated Kill RPCs so
+  // token-gated managers accept them. (The dashboard itself is read-only
+  // apart from kill; put it behind your VPC firewall regardless.)
+  std::string auth_token;
 };
 
 class Lighthouse {

@@ -171,6 +171,14 @@ bool ManagerServer::handle(uint8_t method, const std::string& req,
     case kManagerKill: {
       KillRequest r;
       r.ParseFromString(req);
+      if (!opt_.auth_token.empty() && r.auth_token() != opt_.auth_token) {
+        fprintf(stderr,
+                "torchft_tpu manager [%s]: Kill RPC REFUSED (bad token)\n",
+                opt_.replica_id.c_str());
+        fflush(stderr);
+        *err = "kill refused: missing/bad auth token";
+        return false;
+      }
       fprintf(stderr, "torchft_tpu manager [%s]: Kill RPC received: %s\n",
               opt_.replica_id.c_str(), r.msg().c_str());
       fflush(stderr);

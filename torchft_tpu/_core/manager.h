@@ -35,6 +35,9 @@ struct ManagerOpt {
   uint64_t world_size = 1;  // local ranks in this replica group
   int64_t heartbeat_ms = 100;
   int64_t connect_timeout_ms = 10'000;
+  // When non-empty, Kill RPCs must carry the matching token (the RPC
+  // hard-exits the process). Empty = reference behavior (no gate).
+  std::string auth_token;
 };
 
 class ManagerServer {

@@ -90,7 +90,7 @@ def _load() -> ctypes.CDLL:
     lib.tft_free.argtypes = [vp]
     lib.tft_free.restype = None
 
-    lib.tft_lighthouse_new.argtypes = [c, u64, i64, i64, i64, i64, i64,
+    lib.tft_lighthouse_new.argtypes = [c, u64, i64, i64, i64, i64, i64, c,
                                        ctypes.POINTER(vp)]
     lib.tft_lighthouse_new.restype = vp
     lib.tft_lighthouse_address.argtypes = [vp]
@@ -98,7 +98,8 @@ def _load() -> ctypes.CDLL:
     lib.tft_lighthouse_shutdown.argtypes = [vp]
     lib.tft_lighthouse_free.argtypes = [vp]
 
-    lib.tft_manager_new.argtypes = [c, c, c, c, u64, i64, ctypes.POINTER(vp)]
+    lib.tft_manager_new.argtypes = [c, c, c, c, u64, i64, c,
+                                    ctypes.POINTER(vp)]
     lib.tft_manager_new.restype = vp
     lib.tft_manager_address.argtypes = [vp]
     lib.tft_manager_address.restype = vp
@@ -203,7 +204,8 @@ class Lighthouse:
                  join_timeout_ms: int = 100, quorum_tick_ms: int = 100,
                  heartbeat_fresh_ms: int = 500,
                  heartbeat_grace_factor: int = 4,
-                 eviction_staleness_factor: int = 3):
+                 eviction_staleness_factor: int = 3,
+                 auth_token: str = ""):
         """``heartbeat_fresh_ms``/``heartbeat_grace_factor``: a previous
         member absent from the join round but heartbeating within
         ``heartbeat_fresh_ms`` extends the straggler wait to
@@ -216,7 +218,10 @@ class Lighthouse:
         than ``eviction_staleness_factor * heartbeat_fresh_ms``, or clean
         farewell), the shrunken quorum cuts immediately instead of waiting
         ``join_timeout_ms``. 0 disables (reference behavior: a crashed
-        group stalls survivors for the full join timeout)."""
+        group stalls survivors for the full join timeout).
+
+        ``auth_token``: shared job secret forwarded in dashboard Kill RPCs
+        so token-gated managers accept them."""
         err = ctypes.c_void_p()
         self._h = _check_handle(
             lib().tft_lighthouse_new(bind.encode(), min_replicas,
@@ -224,6 +229,7 @@ class Lighthouse:
                                      heartbeat_fresh_ms,
                                      heartbeat_grace_factor,
                                      eviction_staleness_factor,
+                                     auth_token.encode(),
                                      ctypes.byref(err)), err)
 
     def address(self) -> str:
@@ -252,13 +258,17 @@ class ManagerServer:
 
     def __init__(self, replica_id: str, lighthouse_addr: str,
                  store_addr: str = "", bind: str = "0.0.0.0:0",
-                 world_size: int = 1, heartbeat_ms: int = 100):
+                 world_size: int = 1, heartbeat_ms: int = 100,
+                 auth_token: str = ""):
+        """``auth_token``: when non-empty, Kill RPCs must carry the
+        matching token or are refused (the RPC hard-exits the process)."""
         err = ctypes.c_void_p()
         self._h = _check_handle(
             lib().tft_manager_new(replica_id.encode(),
                                   lighthouse_addr.encode(), bind.encode(),
                                   store_addr.encode(), world_size,
-                                  heartbeat_ms, ctypes.byref(err)), err)
+                                  heartbeat_ms, auth_token.encode(),
+                                  ctypes.byref(err)), err)
 
     def address(self) -> str:
         return _take_str(lib().tft_manager_address(self._h))

@@ -16,6 +16,7 @@ class Lighthouse:
         heartbeat_fresh_ms: int = ...,
         heartbeat_grace_factor: int = ...,
         eviction_staleness_factor: int = ...,
+        auth_token: str = ...,
     ) -> None: ...
     def address(self) -> str: ...
     def status(self, timeout_ms: int = ...) -> dict: ...
@@ -30,6 +31,7 @@ class ManagerServer:
         bind: str = ...,
         world_size: int = ...,
         heartbeat_ms: int = ...,
+        auth_token: str = ...,
     ) -> None: ...
     def address(self) -> str: ...
     def set_status(

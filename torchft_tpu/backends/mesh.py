@@ -131,10 +131,11 @@ class MeshWorld:
         # runtime.
         self.device_op_timeout_sec = max(120.0, 2 * timeout_sec)
         self._poisoned: Optional[str] = None
-        # Several workers so concurrent distinct-key resolves don't queue
-        # behind each other — a queued resolve's wait would otherwise
-        # count against ITS deadline and a pair of merely-slow reductions
-        # could poison the world.
+        # Several workers so concurrent distinct-key resolves usually run
+        # immediately; when all are busy, a queued resolve's deadline
+        # clock only starts once a worker picks it up (see the started
+        # event in contribute), so saturation delays work but can never
+        # falsely poison the device path.
         self._resolver = ThreadPoolExecutor(
             max_workers=4, thread_name_prefix="mesh-resolve")
 
@@ -209,9 +210,29 @@ class MeshWorld:
                 # Deadline the DEVICE work, not just the rendezvous: a
                 # dispatched XLA computation cannot be aborted, so a hang
                 # must not wedge the contributor threads (they hold the
-                # training loops' allreduce futures).
-                self._resolver.submit(self._resolve, entry).result(
-                    timeout=self.device_op_timeout_sec)
+                # training loops' allreduce futures). The deadline clock
+                # starts when the resolver actually BEGINS executing —
+                # queue wait behind busy workers is bounded separately and
+                # fails without poisoning (sustained healthy load must not
+                # read as a wedged device).
+                started = threading.Event()
+
+                def run_resolve(entry=entry):
+                    started.set()
+                    self._resolve(entry)
+
+                task = self._resolver.submit(run_resolve)
+                if not started.wait(timeout=self.device_op_timeout_sec):
+                    # Cancel only wins if no worker picked it up; on the
+                    # race where one just did, fall through and deadline
+                    # the now-running resolve instead — two threads must
+                    # never race to settle the same collective's futures.
+                    if task.cancel():
+                        raise CommunicatorError(
+                            f"mesh resolver pool saturated for "
+                            f"{self.device_op_timeout_sec}s before {key} "
+                            f"could start (earlier device ops running)")
+                task.result(timeout=self.device_op_timeout_sec)
             except FutureTimeout:
                 self._poisoned = (
                     f"device-side collective exceeded "

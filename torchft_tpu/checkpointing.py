@@ -110,14 +110,26 @@ class CheckpointServer:
             the whole transfer (reference behavior: commit blocks until
             in-flight downloads finish). Only for donors too memory-tight
             for the default snapshot copy.
+        bind_host: interface to listen on. Default binds all interfaces
+            like the reference (checkpointing.py serves 0.0.0.0); set to an
+            internal/VPC address on shared networks — this server streams
+            full model weights to anyone who can connect.
+        auth_token: when set, every GET must carry
+            ``Authorization: Bearer <token>`` or is refused with 401.
+            Healers send it automatically when the Manager is constructed
+            with the same token (``TORCHFT_AUTH_TOKEN``).
     """
 
     def __init__(self, state_fn: Callable[[], T],
                  send_timeout_sec: float = 120.0,
-                 lock_streaming: bool = False) -> None:
+                 lock_streaming: bool = False,
+                 bind_host: str = "0.0.0.0",
+                 auth_token: Optional[str] = None) -> None:
         self._state_fn = state_fn
         self._send_timeout_sec = send_timeout_sec
         self._lock_streaming = lock_streaming
+        self._auth_token = auth_token
+        self._bind_host = bind_host
         # One condition guards the tiny critical sections: the step window,
         # the snapshot cache, and the in-flight stream count.
         self._cond = threading.Condition()
@@ -136,6 +148,15 @@ class CheckpointServer:
                 logger.debug("checkpoint http: " + fmt, *args)
 
             def do_GET(self) -> None:
+                if ckpt_server._auth_token is not None:
+                    import hmac
+                    got = self.headers.get("Authorization", "")
+                    want = f"Bearer {ckpt_server._auth_token}"
+                    # Constant-time compare: plain != short-circuits and
+                    # leaks the token prefix via response timing.
+                    if not hmac.compare_digest(got, want):
+                        self.send_error(401, "missing/bad bearer token")
+                        return
                 prefix = "/checkpoint/"
                 if not self.path.startswith(prefix):
                     self.send_error(404, "unknown path")
@@ -204,7 +225,7 @@ class CheckpointServer:
                         srv._inflight -= 1
                         srv._cond.notify_all()
 
-        self._server = _CheckpointHTTPServer(("0.0.0.0", 0), Handler)
+        self._server = _CheckpointHTTPServer((bind_host, 0), Handler)
         self._thread = threading.Thread(
             target=self._server.serve_forever, daemon=True,
             name="checkpoint-server")
@@ -225,9 +246,15 @@ class CheckpointServer:
         return self._snap[1], self._snap[2]
 
     def address(self) -> str:
-        """Dialable HTTP URL for the current step's checkpoint."""
+        """Dialable HTTP URL for the current step's checkpoint. When bound
+        to a specific interface, that address is what peers can actually
+        reach — advertising the hostname's primary interface would hand
+        healers a connection-refused URL."""
         port = self._server.server_address[1]
-        return f"http://{advertise_host()}:{port}/checkpoint/{self._step}"
+        host = (self._bind_host
+                if self._bind_host not in ("", "0.0.0.0", "::")
+                else advertise_host())
+        return f"http://{host}:{port}/checkpoint/{self._step}"
 
     def allow_checkpoint(self, step: int) -> None:
         """Open the serve window for ``step`` (called at step start, while
@@ -267,7 +294,8 @@ class CheckpointServer:
     def load_from_address(cls, address: str, target: T,
                           timeout_sec: float = 300.0,
                           device_put: bool = True,
-                          stats: Optional[dict] = None) -> T:
+                          stats: Optional[dict] = None,
+                          auth_token: Optional[str] = None) -> T:
         """Fetch a peer's live checkpoint and restore it into ``target``'s
         structure (and shardings, when ``device_put``). Streams: each leaf
         is read off the socket into a preallocated buffer and device_put
@@ -278,7 +306,10 @@ class CheckpointServer:
         re-parsing logs."""
         logger.info("fetching checkpoint from %s", address)
         t0 = time.perf_counter()
-        with urllib.request.urlopen(address, timeout=timeout_sec) as resp:
+        req = urllib.request.Request(address)
+        if auth_token is not None:
+            req.add_header("Authorization", f"Bearer {auth_token}")
+        with urllib.request.urlopen(req, timeout=timeout_sec) as resp:
             nbytes = int(resp.headers.get("Content-Length", 0))
             out = load_pytree_from(
                 resp, target,

@@ -308,10 +308,23 @@ class StatefulLoader:
 
     def _halt(self) -> None:
         """Stop the prefetcher and discard read-ahead (its batches belong
-        to the superseded stream position)."""
+        to the superseded stream position).
+
+        A prefetch thread that outlives its join timeout (a storage read
+        wedged past 5s) is an ERROR, not a shrug: proceeding would let the
+        zombie keep advancing the very sampler a load_state_dict is about
+        to rewrite — and a restarted thread would then race it, silently
+        corrupting the resumed position. Refuse instead; the caller can
+        retry the halt once storage unwedges."""
         if self._thread is not None:
             self._stop.set()
             self._thread.join(timeout=5)
+            if self._thread.is_alive():
+                raise RuntimeError(
+                    "StatefulLoader: prefetch thread did not stop within "
+                    "5s (storage read wedged?); refusing to mutate the "
+                    "sampler under a live reader — retry shutdown/"
+                    "load_state_dict once the read completes")
             self._thread = None
         self._q = None
 

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import argparse
 import logging
+import os
 import signal
 import threading
 
@@ -41,6 +42,10 @@ def main(argv: list[str] | None = None) -> None:
                         "missing member's beats are staler than factor * "
                         "heartbeat_fresh_ms (0 = wait the full join "
                         "timeout, reference behavior)")
+    parser.add_argument("--auth-token",
+                        default=os.environ.get("TORCHFT_AUTH_TOKEN", ""),
+                        help="shared job secret forwarded in dashboard "
+                        "Kill RPCs (env TORCHFT_AUTH_TOKEN)")
     args = parser.parse_args(argv)
 
     logging.basicConfig(level=logging.INFO)
@@ -52,6 +57,7 @@ def main(argv: list[str] | None = None) -> None:
         heartbeat_fresh_ms=args.heartbeat_fresh_ms,
         heartbeat_grace_factor=args.heartbeat_grace_factor,
         eviction_staleness_factor=args.eviction_staleness_factor,
+        auth_token=args.auth_token,
     )
     logging.info("lighthouse listening on %s (dashboard: http://%s/)",
                  lh.address(), lh.address())

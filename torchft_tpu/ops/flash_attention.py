@@ -519,10 +519,14 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     delta = _seq_pad(s, sk)
     if delta == 0:
         return _flash_core(q, k, v, causal, block_q, block_k, interpret)
-    assert causal, (
-        f"flash_attention: non-causal attention requires aligned sequence "
-        f"lengths (got s_q={s}, s_k={sk}); pad the sequence to a multiple "
-        f"of 8 (<=1024) or 128 and mask externally")
+    if not causal:
+        # ValueError, not assert: under `python -O` an assert is stripped
+        # and the zero-padding below would silently include padded keys in
+        # every row's softmax — wrong numerics instead of an error.
+        raise ValueError(
+            f"flash_attention: non-causal attention requires aligned "
+            f"sequence lengths (got s_q={s}, s_k={sk}); pad the sequence "
+            f"to a multiple of 8 (<=1024) or 128 and mask externally")
     pad = ((0, 0), (0, delta), (0, 0), (0, 0))
     out = _flash_core(jnp.pad(q, pad), jnp.pad(k, pad), jnp.pad(v, pad),
                       causal, block_q, block_k, interpret)
