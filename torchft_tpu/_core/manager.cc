@@ -171,7 +171,19 @@ bool ManagerServer::handle(uint8_t method, const std::string& req,
     case kManagerKill: {
       KillRequest r;
       r.ParseFromString(req);
-      if (!opt_.auth_token.empty() && r.auth_token() != opt_.auth_token) {
+      // Fixed-time compare (mirrors the Python side's hmac.compare_digest
+      // on the checkpoint path): std::string::operator!= short-circuits
+      // and would leak the token prefix via refusal timing.
+      auto token_ok = [&]() {
+        const std::string& a = opt_.auth_token;
+        const std::string& b = r.auth_token();
+        unsigned char diff = a.size() == b.size() ? 0 : 1;
+        for (size_t i = 0; i < a.size(); i++)
+          diff |= (unsigned char)a[i] ^
+                  (unsigned char)(i < b.size() ? b[i] : 0);
+        return diff == 0;
+      };
+      if (!opt_.auth_token.empty() && !token_ok()) {
         fprintf(stderr,
                 "torchft_tpu manager [%s]: Kill RPC REFUSED (bad token)\n",
                 opt_.replica_id.c_str());

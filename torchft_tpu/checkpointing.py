@@ -254,6 +254,8 @@ class CheckpointServer:
         host = (self._bind_host
                 if self._bind_host not in ("", "0.0.0.0", "::")
                 else advertise_host())
+        if ":" in host:  # bare IPv6 literals need brackets in URLs
+            host = f"[{host}]"
         return f"http://{host}:{port}/checkpoint/{self._step}"
 
     def allow_checkpoint(self, step: int) -> None:

@@ -7,19 +7,35 @@ accumulators with bf16 inputs. This is new scope relative to the reference
 because long-context is first-class in the TPU build and the plain
 attention in :mod:`torchft_tpu.models.transformer` is HBM-bound at long S.
 
-Measured (v5e, bf16, H=8 D=128, fwd+backward, auto tiles): S=16384 at
-~32 ms / ~60 TFLOP/s; S=65536 at 334 ms / 92 TFLOP/s (47% of bf16 peak) —
-dense attention at S=64k would need a 34 GB score matrix per head-batch.
-Where the remaining headroom is (profiled r3): the kernel is VPU-bound,
-not MXU-bound. Per [1024, 1024] k-step the two matmuls cost ~2.7 us of
-MXU while the online-softmax element passes (mask select, running-max,
-exp, row-sum) cost ~4+ us of VPU at full vector throughput, so the
-structure caps near ~35% of matmul peak at these shapes regardless of
-tiling (a [(bq, bk)] sweep confirms 1024x1024 is already optimal, and
-hoisting the mask behind lax.cond makes it WORSE — Mosaic serializes
-around scalar control flow). Head_dim matters more than tiles: d=128
-fills the MXU contraction; d=64 halves it (54% -> 68% step MFU on the
-bench transformer from the head shape alone).
+Measured (v5e, bf16, H=8 D=128, fwd+backward, auto tiles): the round-3
+kernel ran S=16384 at ~32 ms; two round-4 structural changes took the
+same shape to ~28.6 ms (1.33x, interleaved A/B on one chip — absolute
+TFLOP/s through the tunneled chip drifts, ratios are trustworthy):
+
+1. **Interior blocks skip the mask entirely.** The kernel is VPU-bound
+   (per [1024,1024] k-step: ~2.7 us MXU for the two matmuls vs ~4+ us of
+   VPU element passes), and the causal mask's iota/compare/select passes
+   measured 33% of per-block time — yet below-diagonal blocks are fully
+   visible. Each kernel now has two pl.when instantiations of the same
+   body (masked for diagonal-adjacent blocks, plain for interior), so
+   only ~nqb of the ~nqb^2/2 computed blocks pay for masking. (This is
+   distinct from the r3 experiment that hoisted the mask behind a
+   per-tile lax.cond *inside* one body — that serialized and lost.)
+2. **Fused backward** (_bwd_fused_kernel): dq no longer runs as a
+   separate kernel recomputing (logits, p, dp, ds) — one kernel does
+   5 matmuls + 1 exp per block instead of the split path's 7 + 2, with
+   dq accumulated across the outer k-grid via an aliased
+   read-modify-write HBM buffer. Verified against the split path on
+   hardware (dv bit-identical, dq/dk within bf16 rounding);
+   TORCHFT_FLASH_FUSED_BWD=0 falls back.
+
+A (bq, bk) sweep re-confirms 1024x1024 optimal post-fusion (512x1024 is
+5% worse, everything smaller much worse). Head_dim matters more than
+tiles: d=128 fills the MXU contraction; d=64 halves it (54% -> 68% step
+MFU on the bench transformer from the head shape alone). Remaining
+ceiling: per unmasked block the 7 remaining matmuls cost ~19 us MXU
+against ~37 us of irreducible VPU softmax passes (exp, running max/sum,
+rescale) — further gains need fewer VPU passes per element, not tiling.
 
 Kernel structure: grid (batch*heads, q_blocks, k_blocks). The innermost
 (k) grid dimension is sequential on a TPU core, so the running
@@ -52,6 +68,47 @@ NEG_INF = -1e30
 _LANES = 128  # TPU vector lane count
 
 
+def _block_visibility(qi, ki, bq, bk, offset, causal, shift_ref):
+    """Block-level mask bounds shared by every kernel (forward, split
+    backward, fused backward) so their masking can never desynchronize.
+
+    Returns ``(diag_ok, full_vis)``: the block has any visible entry /
+    every entry visible. ``offset = s_k - s_q`` end-aligns queries; a
+    traced ``shift_ref`` (ring attention) slides the boundary as data —
+    the bounds stay scalar compares either way, so fully-masked blocks
+    are skipped and fully-visible blocks take the unmasked path even
+    when the mask VALUES are traced."""
+    if shift_ref is not None:
+        shift = shift_ref[0, 0]
+        diag_ok = (qi * bq + bq - 1 + offset + shift >= ki * bk)
+        full_vis = (qi * bq + offset + shift >= ki * bk + bk - 1)
+    elif causal:
+        diag_ok = (qi * bq + bq - 1 + offset >= ki * bk)
+        full_vis = (qi * bq + offset >= ki * bk + bk - 1)
+    else:
+        diag_ok = True
+        full_vis = True
+    return diag_ok, full_vis
+
+
+def _dual_instantiate(compute, causal, shift_ref, diag_ok, full_vis):
+    """Emit ``compute(apply_mask)`` twice behind complementary pl.when
+    predicates: the masked body only for diagonal-adjacent blocks, the
+    plain body for fully-visible ones (the mask's iota/compare/select
+    passes measured 33% of per-block time — the kernels are VPU-bound).
+    Non-causal static kernels have no mask and get one unguarded body."""
+    if causal or shift_ref is not None:
+        @pl.when(jnp.logical_and(diag_ok, jnp.logical_not(full_vis)))
+        def _compute_masked():
+            compute(True)
+
+        @pl.when(jnp.logical_and(diag_ok, full_vis))
+        def _compute_plain():
+            compute(False)
+    else:
+        compute(False)
+
+
 def _fwd_kernel(*refs, causal: bool, scale: float, nkb: int, offset: int,
                 dynamic_shift: bool):
     if dynamic_shift:
@@ -71,15 +128,21 @@ def _fwd_kernel(*refs, causal: bool, scale: float, nkb: int, offset: int,
         l_ref[:] = jnp.zeros_like(l_ref)
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    # Causal: blocks strictly above the diagonal contribute nothing.
-    # ``offset = s_k - s_q`` end-aligns queries to the last s_q key
-    # positions (decode convention; matches _reference's tril(k=s_k-s_q)).
-    # With a traced shift the mask is data, so every block computes.
-    diag_ok = jnp.logical_or(not causal or dynamic_shift,
-                             qi * bq + bq - 1 + offset >= ki * bk)
+    diag_ok, full_vis = _block_visibility(
+        qi, ki, bq, bk, offset, causal, shift_ref)
 
-    @pl.when(diag_ok)
-    def _compute():
+    def _softmax_update(logits, v):
+        m_prev = m_ref[:]
+        m_new = jnp.maximum(m_prev,
+                            jnp.max(logits, axis=-1, keepdims=True))
+        p = jnp.exp(logits - m_new)
+        corr = jnp.exp(m_prev - m_new)
+        m_ref[:] = m_new
+        l_ref[:] = l_ref[:] * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * corr + jnp.dot(
+            p.astype(v.dtype), v, preferred_element_type=jnp.float32)
+
+    def _compute(apply_mask: bool):
         # Matmul inputs stay in the INPUT dtype (bf16 in training) with
         # f32 accumulation — upcasting q/k/v first would push the MXU off
         # its bf16 fast path and roughly halve kernel throughput at
@@ -90,7 +153,7 @@ def _fwd_kernel(*refs, causal: bool, scale: float, nkb: int, offset: int,
         v = v_ref[0]                                      # [bk, d]
         logits = jnp.dot(q, k.T,
                          preferred_element_type=jnp.float32) * scale
-        if causal or dynamic_shift:
+        if apply_mask:
             # Mask from two 1-D iotas and ONE broadcast compare: the mask
             # is pure VPU overhead on every diagonal-adjacent block, and
             # materializing two full [bq, bk] i32 iotas costs ~3x the
@@ -105,15 +168,9 @@ def _fwd_kernel(*refs, causal: bool, scale: float, nkb: int, offset: int,
                 # attention; shift <= -s_q → fully blocked.
                 q_pos = q_pos + shift_ref[0, 0]
             logits = jnp.where(q_pos >= k_pos, logits, NEG_INF)
-        m_prev = m_ref[:]
-        m_new = jnp.maximum(m_prev,
-                            jnp.max(logits, axis=-1, keepdims=True))
-        p = jnp.exp(logits - m_new)
-        corr = jnp.exp(m_prev - m_new)
-        m_ref[:] = m_new
-        l_ref[:] = l_ref[:] * corr + jnp.sum(p, axis=-1, keepdims=True)
-        acc_ref[:] = acc_ref[:] * corr + jnp.dot(
-            p.astype(v.dtype), v, preferred_element_type=jnp.float32)
+        _softmax_update(logits, v)
+
+    _dual_instantiate(_compute, causal, shift_ref, diag_ok, full_vis)
 
     @pl.when(ki == nkb - 1)
     def _finalize():
@@ -227,7 +284,7 @@ def _flash_fwd(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
 
 def _recompute_p_ds(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     qi, ki, causal: bool, scale: float, offset: int,
-                    shift_ref=None):
+                    shift_ref=None, apply_mask: bool = True):
     """Shared backward recompute: rebuild the probability tile from
     (q, k, lse) under the same end-aligned causal mask as the forward and
     form ds = p * (dp - delta). Used by both the dq and dk/dv kernels so
@@ -243,7 +300,7 @@ def _recompute_p_ds(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     do = do_ref[0]                                    # [bq, d]
     logits = jnp.dot(q, k.T,
                      preferred_element_type=jnp.float32) * scale
-    if causal or shift_ref is not None:
+    if apply_mask and (causal or shift_ref is not None):
         # Same broadcast-compare mask as the forward (see _fwd_kernel).
         q_pos = offset + qi * bq + jax.lax.broadcasted_iota(
             jnp.int32, (bq, 1), 0)
@@ -278,16 +335,18 @@ def _bwd_dq_kernel(*refs, causal: bool, scale: float, nkb: int,
     def _init():
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    diag_ok = jnp.logical_or(not causal or dynamic_shift,
-                             qi * bq + bq - 1 + offset >= ki * bk)
+    diag_ok, full_vis = _block_visibility(
+        qi, ki, bq, bk, offset, causal, shift_ref)
 
-    @pl.when(diag_ok)
-    def _compute():
+    def _compute(apply_mask: bool):
         _, ds, _, k, _ = _recompute_p_ds(
             q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-            qi, ki, causal, scale, offset, shift_ref)
+            qi, ki, causal, scale, offset, shift_ref,
+            apply_mask=apply_mask)
         acc_ref[:] += jnp.dot(ds.astype(k.dtype), k,
                               preferred_element_type=jnp.float32) * scale
+
+    _dual_instantiate(_compute, causal, shift_ref, diag_ok, full_vis)
 
     @pl.when(ki == nkb - 1)
     def _finalize():
@@ -313,18 +372,86 @@ def _bwd_dkdv_kernel(*refs, causal: bool, scale: float, nqb: int,
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
-    diag_ok = jnp.logical_or(not causal or dynamic_shift,
-                             qi * bq + bq - 1 + offset >= ki * bk)
+    diag_ok, full_vis = _block_visibility(
+        qi, ki, bq, bk, offset, causal, shift_ref)
 
-    @pl.when(diag_ok)
-    def _compute():
+    def _compute(apply_mask: bool):
         p, ds, q, _, do = _recompute_p_ds(
             q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-            qi, ki, causal, scale, offset, shift_ref)
+            qi, ki, causal, scale, offset, shift_ref,
+            apply_mask=apply_mask)
         dv_acc[:] += jnp.dot(p.astype(do.dtype).T, do,
                              preferred_element_type=jnp.float32)
         dk_acc[:] += jnp.dot(ds.astype(q.dtype).T, q,
                              preferred_element_type=jnp.float32) * scale
+
+    _dual_instantiate(_compute, causal, shift_ref, diag_ok, full_vis)
+
+    @pl.when(qi == nqb - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _bwd_fused_kernel(*refs, causal: bool, scale: float, nqb: int,
+                      offset: int, dynamic_shift: bool):
+    """One backward kernel for dq, dk AND dv.
+
+    The split kernels each recompute (logits, p, dp, ds) per block — the
+    exp alone is ~a third of a block's VPU time, and the kernel is
+    VPU-bound. Fusing computes them ONCE: per (k-block, q-block) step this
+    does 5 matmuls + 1 exp instead of the split path's 7 matmuls + 2 exps.
+
+    Grid (bh, ki, qi): dk/dv accumulate in VMEM scratch across the inner
+    qi sweep (as before); dq accumulates ACROSS the outer ki dimension
+    through an HBM read-modify-write — the dq buffer is passed as both
+    input and output (input_output_aliases) and every step writes
+    ``dq_out = dq_in + contribution``. The write of (ki, qi)'s dq block
+    and the prefetch of (ki+1, qi)'s are nqb steps apart, so the pipeline
+    never races a block against itself; _flash_bwd gates the fused path
+    on nqb >= 4 and falls back to the split kernels below it.
+    """
+    if dynamic_shift:
+        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_in, shift_ref, \
+            dk_ref, dv_ref, dq_ref, dk_acc, dv_acc = refs
+    else:
+        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_in, \
+            dk_ref, dv_ref, dq_ref, dk_acc, dv_acc = refs
+        shift_ref = None
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+    bq = q_ref.shape[1]
+    bk = k_ref.shape[1]
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    diag_ok, full_vis = _block_visibility(
+        qi, ki, bq, bk, offset, causal, shift_ref)
+
+    def _compute(apply_mask: bool):
+        p, ds, q, k, do = _recompute_p_ds(
+            q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+            qi, ki, causal, scale, offset, shift_ref,
+            apply_mask=apply_mask)
+        dv_acc[:] += jnp.dot(p.astype(do.dtype).T, do,
+                             preferred_element_type=jnp.float32)
+        dk_acc[:] += jnp.dot(ds.astype(q.dtype).T, q,
+                             preferred_element_type=jnp.float32) * scale
+        dq_ref[0] = dq_in[0] + jnp.dot(
+            ds.astype(k.dtype), k,
+            preferred_element_type=jnp.float32) * scale
+
+    _dual_instantiate(_compute, causal, shift_ref, diag_ok, full_vis)
+
+    if causal or dynamic_shift:
+        # Skipped block: the dq out-window still gets copied back to HBM,
+        # so it must carry the running value through unchanged.
+        @pl.when(jnp.logical_not(diag_ok))
+        def _passthrough():
+            dq_ref[0] = dq_in[0]
 
     @pl.when(qi == nqb - 1)
     def _finalize():
@@ -385,6 +512,67 @@ def _flash_bwd(q, k, v, out, lse, g, causal: bool, block_q: Optional[int],
         in_specs.append(pl.BlockSpec((1, _LANES), lambda bh, i, j: (0, 0)))
         inputs.append(shift_arr)
 
+    # Specs in (bh, k-block, q-block) grid order + output reshapers,
+    # shared by the fused kernel and the split dk/dv kernel.
+    q_spec2 = pl.BlockSpec((1, block_q, d), lambda bh, j, i: (bh, i, 0))
+    k_in_spec2 = pl.BlockSpec((1, block_k, d),
+                              lambda bh, j, i: (kv_row(bh), j, 0))
+    k_out_spec2 = pl.BlockSpec((1, block_k, d), lambda bh, j, i: (bh, j, 0))
+    row_spec2 = pl.BlockSpec((1, block_q, _LANES),
+                             lambda bh, j, i: (bh, i, 0))
+
+    def from_bh(x, seq):
+        return x.reshape(b, h, seq, d).transpose(0, 2, 1, 3)
+
+    def kv_from_bh(x, seq):
+        # [b*h, seq, d] per query head -> sum the rep heads sharing each
+        # kv head -> [b, seq, h_kv, d]
+        x = x.reshape(b, h_kv, rep, seq, d)
+        x = x.astype(jnp.float32).sum(axis=2)
+        return x.transpose(0, 2, 1, 3).astype(k.dtype)
+
+    def pack(dq, dk, dv):
+        if rep == 1:
+            return from_bh(dq, s), from_bh(dk, sk), from_bh(dv, sk)
+        return from_bh(dq, s), kv_from_bh(dk, sk), kv_from_bh(dv, sk)
+
+    # Fused backward (dq+dk+dv in one kernel, one recompute per block)
+    # whenever the q-grid is deep enough for the dq read-modify-write to
+    # be pipeline-safe (see _bwd_fused_kernel); the split kernels below
+    # remain the short-sequence fallback. TORCHFT_FLASH_FUSED_BWD=0 is
+    # the operational kill-switch back to the split kernels.
+    import os
+    fused_ok = os.environ.get("TORCHFT_FLASH_FUSED_BWD", "1") != "0"
+    if nqb >= 4 and fused_ok:
+        in_specs2 = [q_spec2, k_in_spec2, k_in_spec2, q_spec2, row_spec2,
+                     row_spec2, q_spec2]
+        inputs2 = [qh, kh, vh, doh, lse_l, delta_l,
+                   jnp.zeros((b * h, s, d), jnp.float32)]
+        if dynamic_shift:
+            in_specs2.append(
+                pl.BlockSpec((1, _LANES), lambda bh, j, i: (0, 0)))
+            inputs2.append(shift_arr)
+        dk, dv, dq = pl.pallas_call(
+            functools.partial(_bwd_fused_kernel, causal=causal,
+                              scale=scale, nqb=nqb, offset=offset,
+                              dynamic_shift=dynamic_shift),
+            out_shape=[
+                jax.ShapeDtypeStruct((b * h, sk, d), k.dtype),
+                jax.ShapeDtypeStruct((b * h, sk, d), v.dtype),
+                jax.ShapeDtypeStruct((b * h, s, d), jnp.float32),
+            ],
+            grid=(b * h, nkb, nqb),
+            in_specs=in_specs2,
+            out_specs=[k_out_spec2, k_out_spec2, q_spec2],
+            scratch_shapes=[
+                pltpu.VMEM((block_k, d), jnp.float32),
+                pltpu.VMEM((block_k, d), jnp.float32),
+            ],
+            input_output_aliases={6: 2},  # dq buffer: read-modify-write
+            interpret=interpret,
+        )(*inputs2)
+        return pack(dq.astype(q.dtype), dk, dv)
+
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, causal=causal, scale=scale,
                           nkb=nkb, offset=offset,
@@ -401,12 +589,6 @@ def _flash_bwd(q, k, v, out, lse, g, causal: bool, block_q: Optional[int],
     # Outputs are per QUERY head (each grid row writes its own block, no
     # cross-row accumulation hazards); GQA reduces over the rep query
     # heads sharing a kv head afterwards, outside the kernel.
-    q_spec2 = pl.BlockSpec((1, block_q, d), lambda bh, j, i: (bh, i, 0))
-    k_in_spec2 = pl.BlockSpec((1, block_k, d),
-                              lambda bh, j, i: (kv_row(bh), j, 0))
-    k_out_spec2 = pl.BlockSpec((1, block_k, d), lambda bh, j, i: (bh, j, 0))
-    row_spec2 = pl.BlockSpec((1, block_q, _LANES),
-                             lambda bh, j, i: (bh, i, 0))
     in_specs2 = [q_spec2, k_in_spec2, k_in_spec2, q_spec2, row_spec2,
                  row_spec2]
     inputs2 = [qh, kh, vh, doh, lse_l, delta_l]
@@ -431,19 +613,7 @@ def _flash_bwd(q, k, v, out, lse, g, causal: bool, block_q: Optional[int],
         interpret=interpret,
     )(*inputs2)
 
-    def from_bh(x, seq):
-        return x.reshape(b, h, seq, d).transpose(0, 2, 1, 3)
-
-    def kv_from_bh(x, seq):
-        # [b*h, seq, d] per query head -> sum the rep heads sharing each
-        # kv head -> [b, seq, h_kv, d]
-        x = x.reshape(b, h_kv, rep, seq, d)
-        x = x.astype(jnp.float32).sum(axis=2)
-        return x.transpose(0, 2, 1, 3).astype(k.dtype)
-
-    if rep == 1:
-        return from_bh(dq, s), from_bh(dk, sk), from_bh(dv, sk)
-    return from_bh(dq, s), kv_from_bh(dk, sk), kv_from_bh(dv, sk)
+    return pack(dq, dk, dv)
 
 
 def _reference(q, k, v, causal):
