@@ -417,14 +417,15 @@ def bench_long_context(seq_len: int = 16_384, heads: int = 8,
 # --------------------------------------------------------------- scenario 2c
 
 def bench_diloco(n_groups: int = 2, sync_every: int = 8,
-                 rounds: int = 4, hidden: int = 512) -> Dict[str, float]:
+                 rounds: int = 4, hidden: int = 512,
+                 streaming_fragments: int = 0) -> Dict[str, float]:
     """DiLoCo local SGD (BASELINE.md config 5): inner steps touch no
     cross-group interconnect at all; only every ``sync_every``-th step
     pays an outer allreduce of the parameter delta. Reports the measured
     inner-step rate vs the per-step-DDP rate on the same model
     (bench_multigroup), i.e. the communication-reduction payoff."""
     from torchft_tpu import HostCommunicator, Lighthouse, Manager
-    from torchft_tpu.local_sgd import DiLoCoTrainer
+    from torchft_tpu.local_sgd import DiLoCoTrainer, StreamingDiLoCoTrainer
     from torchft_tpu.models import MLP
 
     lh = Lighthouse(bind="127.0.0.1:0", min_replicas=n_groups,
@@ -443,7 +444,12 @@ def bench_diloco(n_groups: int = 2, sync_every: int = 8,
     results: Dict[str, float] = {}
 
     def worker(gid: str) -> None:
-        t = DiLoCoTrainer(
+        cls = DiLoCoTrainer
+        kwargs = {}
+        if streaming_fragments:
+            cls = StreamingDiLoCoTrainer
+            kwargs["fragments"] = streaming_fragments
+        t = cls(
             loss_fn=loss_fn, inner_tx=optax.sgd(0.05), params=params0,
             manager_factory=lambda load, save: Manager(
                 comm=HostCommunicator(timeout_sec=30), load_state_dict=load,
@@ -452,6 +458,7 @@ def bench_diloco(n_groups: int = 2, sync_every: int = 8,
                 quorum_timeout_ms=30_000,
             ),
             sync_every=sync_every,
+            **kwargs,
         )
         b = {"x": x, "y": y}
         # warm: one full outer round (compile + first quorum)
@@ -671,6 +678,15 @@ def main() -> None:
            "sync_every": dl["sync_every"],
            "speedup_vs_ddp": round(dl["inner_steps_per_s"]
                                    / max(mg["steps_per_s"], 1e-9), 2)})
+
+    # bench_diloco(streaming_fragments=K) swaps the plain trainer for the
+    # streaming variant (importable for experiments; no CLI plumbing). It
+    # is deliberately NOT a headline metric on this rig: streaming trades
+    # K-fold more (fixed-cost) control rounds for byte smoothing + compute
+    # overlap, a trade that only pays when DCN transfer bytes and inner
+    # compute dominate the fixed round cost — on a tunneled single-chip
+    # localhost loop the fixed costs dominate and streaming measures
+    # strictly worse (see StreamingDiLoCoTrainer's docstring).
 
     lc = bench_long_context()
     _emit({"metric": "long_context_tokens_per_s",

@@ -10,7 +10,44 @@ import optax
 import pytest
 
 from torchft_tpu import HostCommunicator, Lighthouse, Manager
-from torchft_tpu.local_sgd import DiLoCoTrainer
+from torchft_tpu.local_sgd import DiLoCoTrainer, StreamingDiLoCoTrainer
+
+
+class FakeManager:
+    """Stateful stand-in for the streaming schedule tests: echo allreduce,
+    always-commit, commit-gated step counter like the real Manager."""
+
+    def __init__(self):
+        self.step_calls = 0
+        self.allreduce_calls = 0
+        self._step = 0
+        self._should_step = True
+        self.commit_result = True
+
+    def step(self):
+        self.step_calls += 1
+        if self._should_step:
+            self._step += 1
+
+    def wait_quorum(self):
+        pass
+
+    def current_step(self):
+        return self._step
+
+    def allreduce(self, tree):
+        self.allreduce_calls += 1
+        return echo_allreduce(tree)
+
+    def should_commit(self):
+        self._should_step = self.commit_result
+        return self.commit_result
+
+    def is_healing(self):
+        return False
+
+    def shutdown(self):
+        pass
 
 
 def echo_allreduce(tree):
@@ -31,6 +68,101 @@ def make_trainer(manager, sync_every=4):
         sync_every=sync_every,
         jit=False,
     )
+
+
+def make_streaming(manager, sync_every=4, fragments=2):
+    def loss_fn(params, batch):
+        return (jnp.mean((params["w"] - batch) ** 2)
+                + jnp.mean((params["b"] - batch[:2]) ** 2))
+
+    return StreamingDiLoCoTrainer(
+        loss_fn=loss_fn,
+        inner_tx=optax.sgd(0.1),
+        params={"b": jnp.zeros(2), "w": jnp.zeros(4)},
+        manager_factory=lambda load, save: manager,
+        sync_every=sync_every,
+        fragments=fragments,
+        jit=False,
+    )
+
+
+class TestStreamingUnit:
+    def test_schedule_launch_collect_overlap(self):
+        """Every interval: collect the in-flight fragment (None on the
+        first), launch the next. Rounds = launches; commits lag launches
+        by one interval — the overlap."""
+        fm = FakeManager()
+        t = make_streaming(fm, sync_every=4, fragments=2)  # interval 2
+        target = jnp.full(4, 1.0)
+        seen = [t.train_step(target)[1] for _ in range(8)]
+        assert seen == [None, None, None, True, None, True, None, True]
+        assert fm.step_calls == 4  # launches at steps 2, 4, 6, 8
+        assert fm.allreduce_calls == 4
+        assert t._pending is not None  # one round always in flight
+        assert t.flush() is True
+        assert t._pending is None
+
+    def test_fragments_rotate_with_round_counter(self):
+        fm = FakeManager()
+        t = make_streaming(fm, sync_every=4, fragments=2)
+        target = jnp.full(4, 1.0)
+        frags = []
+        for _ in range(4):
+            t.train_step(target)
+            t.train_step(target)
+            frags.append(t._pending[0])
+        assert frags == [1, 0, 1, 0]  # round % fragments
+
+    def test_only_synced_fragment_anchor_moves(self):
+        fm = FakeManager()
+        t = make_streaming(fm, sync_every=4, fragments=2)
+        target = jnp.full(4, 1.0)
+        for _ in range(2):
+            t.train_step(target)   # launch frag 1 (round 1)
+        frag = t._pending[0]
+        anchor_before = jax.device_get(t.anchor)
+        for _ in range(2):
+            t.train_step(target)   # collect frag `frag`, launch next
+        anchor_after = jax.device_get(t.anchor)
+        # leaves of the synced fragment moved, the others did not
+        leaves_b, _ = jax.tree_util.tree_flatten(anchor_before)
+        leaves_a, _ = jax.tree_util.tree_flatten(anchor_after)
+        moved = [not np.allclose(x, y) for x, y in zip(leaves_b, leaves_a)]
+        for i in range(len(moved)):
+            assert moved[i] == (i in t._frag_idx[frag])
+
+    def test_aborted_round_retries_same_fragment(self):
+        fm = FakeManager()
+        fm.commit_result = False
+        t = make_streaming(fm, sync_every=4, fragments=2)
+        target = jnp.full(4, 1.0)
+        for _ in range(2):
+            t.train_step(target)
+        first_frag = t._pending[0]
+        anchor_before = jax.device_get(t.anchor)
+        _, committed = t.train_step(target) or (None, None)
+        _, committed = t.train_step(target)
+        assert committed is False
+        np.testing.assert_allclose(
+            jax.tree_util.tree_leaves(jax.device_get(t.anchor))[0],
+            jax.tree_util.tree_leaves(anchor_before)[0])
+        # the retry launches the SAME fragment (step did not bump)
+        assert t._pending[0] == first_frag
+        # recovery: next round commits and the anchor moves
+        fm.commit_result = True
+        for _ in range(2):
+            t.train_step(target)
+        assert t.flush() is True
+
+    def test_fragment_split_balanced_nonempty(self):
+        from torchft_tpu.local_sgd import _fragment_leaves
+        leaves = [np.zeros(2), np.zeros(4)]
+        assert _fragment_leaves(leaves, 2) == [[0], [1]]
+        leaves = [np.zeros(100), np.zeros(1), np.zeros(1), np.zeros(1)]
+        groups = _fragment_leaves(leaves, 3)
+        assert [i for g in groups for i in g] == [0, 1, 2, 3]
+        assert all(g for g in groups)
+        assert _fragment_leaves([np.zeros(1)], 3) == [[0], [], []]
 
 
 class TestDiLoCoUnit:
@@ -94,6 +226,124 @@ class TestDiLoCoUnit:
 
 @pytest.mark.integration
 class TestDiLoCoIntegration:
+    def test_streaming_two_groups_anchors_identical(self):
+        """Streaming DiLoCo: params drift locally by design, but every
+        committed fragment round must land the same anchor on every group
+        (the fragment schedule derives from the shared round counter)."""
+        lh = Lighthouse(bind="127.0.0.1:0", min_replicas=2,
+                        join_timeout_ms=1000, quorum_tick_ms=50)
+
+        def run_group(group):
+            def loss_fn(params, batch):
+                return jnp.mean((params["w"] - batch) ** 2
+                                ) + jnp.mean((params["b"] - batch[:2]) ** 2)
+
+            t = StreamingDiLoCoTrainer(
+                loss_fn=loss_fn,
+                inner_tx=optax.sgd(0.05),
+                params={"w": jnp.zeros(4), "b": jnp.zeros(2)},
+                manager_factory=lambda load, save: Manager(
+                    comm=HostCommunicator(timeout_sec=15),
+                    load_state_dict=load,
+                    state_dict=save,
+                    min_replica_size=2,
+                    replica_id=f"sdiloco{group}",
+                    lighthouse_addr=lh.address(),
+                    rank=0, world_size=1,
+                    timeout_ms=15_000, quorum_timeout_ms=15_000,
+                ),
+                sync_every=4,
+                fragments=2,
+            )
+            target = jnp.full(4, float(group + 1))
+            try:
+                while t.manager.current_step() < 4:  # 4 fragment rounds
+                    t.train_step(target)
+                t.flush()
+                return jax.device_get(t.anchor)
+            finally:
+                t.shutdown()
+
+        try:
+            with ThreadPoolExecutor(max_workers=2) as pool:
+                futs = [pool.submit(run_group, g) for g in range(2)]
+                results = [f.result(timeout=120) for f in futs]
+        finally:
+            lh.shutdown()
+        np.testing.assert_array_equal(results[0]["w"], results[1]["w"])
+        np.testing.assert_array_equal(results[0]["b"], results[1]["b"])
+        assert float(np.abs(results[0]["w"]).mean()) > 0
+
+    def test_streaming_death_and_heal_keeps_anchors_identical(self):
+        """Kill+restart a group mid-stream: the rejoiner must pick the
+        quorum-agreed fragment (not one derived from its stale local
+        step), heal, and land bit-identical anchors. Guards the
+        fragment-id-from-pre-quorum-step bug."""
+        total = 6
+        lh = Lighthouse(bind="127.0.0.1:0", min_replicas=1,
+                        join_timeout_ms=500, quorum_tick_ms=20)
+
+        def make(group):
+            def loss_fn(params, batch):
+                return (jnp.mean((params["w"] - batch) ** 2)
+                        + jnp.mean((params["b"] - batch[:2]) ** 2))
+
+            return StreamingDiLoCoTrainer(
+                loss_fn=loss_fn,
+                inner_tx=optax.sgd(0.05),
+                params={"w": jnp.zeros(4), "b": jnp.zeros(2)},
+                manager_factory=lambda load, save: Manager(
+                    comm=HostCommunicator(timeout_sec=15),
+                    load_state_dict=load,
+                    state_dict=save,
+                    min_replica_size=1,
+                    replica_id=f"shl{group}",
+                    lighthouse_addr=lh.address(),
+                    rank=0, world_size=1,
+                    timeout_ms=15_000, quorum_timeout_ms=15_000,
+                ),
+                sync_every=4,
+                fragments=2,
+            )
+
+        def survivor():
+            t = make(0)
+            target = jnp.full(4, 1.0)
+            try:
+                while t.manager.current_step() < total:
+                    t.train_step(target)
+                t.flush()
+                return jax.device_get(t.anchor)
+            finally:
+                t.shutdown()
+
+        def victim():
+            t = make(1)
+            target = jnp.full(4, 2.0)
+            try:
+                while t.manager.current_step() < 2:
+                    t.train_step(target)
+            finally:
+                t.shutdown()  # dies
+            t = make(1)  # restart: fresh params, must rejoin + heal
+            try:
+                while t.manager.current_step() < total:
+                    t.train_step(target)
+                t.flush()
+                assert t.manager.metrics()["heal_count"] >= 1
+                return jax.device_get(t.anchor)
+            finally:
+                t.shutdown()
+
+        try:
+            with ThreadPoolExecutor(max_workers=2) as pool:
+                fa, fb = pool.submit(survivor), pool.submit(victim)
+                a, b_res = fa.result(timeout=180), fb.result(timeout=180)
+        finally:
+            lh.shutdown()
+        np.testing.assert_array_equal(a["w"], b_res["w"])
+        np.testing.assert_array_equal(a["b"], b_res["b"])
+
     def test_two_groups_converge_identically(self):
         lh = Lighthouse(bind="127.0.0.1:0", min_replicas=2,
                         join_timeout_ms=1000, quorum_tick_ms=50)

@@ -28,7 +28,8 @@ from torchft_tpu.communicator import (
 from torchft_tpu.backends.host import HostCommunicator
 from torchft_tpu.backends.mesh import MeshCommunicator, MeshWorld
 from torchft_tpu.data import BatchIterator, DistributedSampler
-from torchft_tpu.local_sgd import DiLoCoTrainer, diloco_outer_optimizer
+from torchft_tpu.local_sgd import (DiLoCoTrainer, StreamingDiLoCoTrainer,
+                                   diloco_outer_optimizer)
 from torchft_tpu.manager import Manager, WorldSizeMode
 from torchft_tpu.optim import FTOptimizer, OptimizerWrapper
 
@@ -38,6 +39,7 @@ __all__ = [
     "Communicator",
     "CommunicatorError",
     "DiLoCoTrainer",
+    "StreamingDiLoCoTrainer",
     "DistributedSampler",
     "diloco_outer_optimizer",
     "DummyCommunicator",

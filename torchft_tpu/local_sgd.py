@@ -141,3 +141,242 @@ class DiLoCoTrainer:
 
     def shutdown(self) -> None:
         self.manager.shutdown()
+
+
+def _fragment_leaves(leaves: list, fragments: int) -> list:
+    """Split leaf indices into ``fragments`` contiguous groups balanced by
+    byte size. Deterministic (every process computes the identical split)
+    and non-empty whenever there are at least ``fragments`` leaves: a
+    group closes when it reaches its fair share of the REMAINING bytes,
+    or when the remaining leaves are exactly one-per-remaining-group."""
+    import numpy as np
+
+    sizes = [int(np.prod(np.shape(leaf) or (1,)))
+             * np.dtype(getattr(leaf, "dtype", None)
+                        or np.asarray(leaf).dtype).itemsize
+             for leaf in leaves]
+    groups: list = []
+    cur: list = []
+    cur_bytes = 0
+    remaining = sum(sizes)
+    for i, nbytes in enumerate(sizes):
+        cur.append(i)
+        cur_bytes += nbytes
+        groups_after = fragments - len(groups) - 1
+        leaves_left = len(sizes) - i - 1
+        groups_left = fragments - len(groups)
+        if groups_after > 0 and (
+            cur_bytes >= remaining / groups_left
+            or leaves_left <= groups_after
+        ):
+            groups.append(cur)
+            remaining -= cur_bytes
+            cur, cur_bytes = [], 0
+    if cur:
+        groups.append(cur)
+    while len(groups) < fragments:  # more fragments than leaves
+        groups.append([])
+    return groups
+
+
+class StreamingDiLoCoTrainer(DiLoCoTrainer):
+    """DiLoCo with the outer communication OVERLAPPED and SMOOTHED:
+    parameters are split into ``fragments`` leaf groups, and each outer
+    exchange syncs ONE fragment while the next ``sync_every/fragments``
+    inner steps keep training — the DCN transfer of a fragment rides under
+    compute instead of stalling the loop, and bandwidth is a steady trickle
+    of 1/K-model-size transfers rather than a full-model burst every H
+    steps (the streaming-DiLoCo recipe; upstream torchft grew the same
+    capability after the reference snapshot this project matches).
+
+    Per-fragment schedule and consistency: the fragment synced by an outer
+    round is ``round_number % fragments`` — the manager's commit-gated step
+    counter, which quorum/healing already keep identical across groups, so
+    every group always averages the SAME leaf set. When a fragment's
+    averaged delta arrives (collected at the next sync point), the outer
+    optimizer advances that fragment's anchor and the live params keep the
+    local progress made while the transfer was in flight:
+    ``params_f = anchor_f' + (params_f - params_f_at_send)``. A healed
+    group discards in-flight local progress for the restored fragment
+    (``params_f = anchor_f'``), exactly like the synchronous trainer.
+
+    Fault tolerance is unchanged: each fragment round is a full
+    quorum/allreduce/commit round, aborted rounds retry the same fragment,
+    and healing restores the complete state at round granularity.
+
+    **When it pays (measured):** streaming runs ``fragments``-times more
+    control rounds per window, each with the full fixed cost (quorum RPC,
+    device→host dispatch, ring rendezvous), to move 1/K of the bytes per
+    round under 1/K of the compute. It wins when transfer bytes and inner
+    compute dominate that fixed cost — big models on real DCN between pod
+    slices. On a fixed-cost-dominated link it strictly loses (on this
+    project's tunneled single-chip bench rig: 0.16x the plain DiLoCo inner
+    rate at hidden=512/K=4 — use :class:`DiLoCoTrainer` there).
+    """
+
+    def __init__(
+        self,
+        loss_fn: Callable[[Any, Any], Any],
+        inner_tx: optax.GradientTransformation,
+        params: Any,
+        manager_factory: Callable[..., Manager],
+        outer_tx: Optional[optax.GradientTransformation] = None,
+        sync_every: int = 16,
+        fragments: int = 4,
+        jit: bool = True,
+    ) -> None:
+        if sync_every % fragments:
+            raise ValueError("sync_every must be divisible by fragments")
+        self.fragments = fragments
+        self.interval = sync_every // fragments
+        leaves, self._treedef = jax.tree_util.tree_flatten(params)
+        self._frag_idx = _fragment_leaves(leaves, fragments)
+        # In-flight fragment round: (fragment_id, allreduce future,
+        # params-at-send leaf list). Must exist before super().__init__
+        # wires the manager to state_dict/load_state_dict.
+        self._pending: Optional[Tuple[int, Any, list]] = None
+        # Per-fragment outer state over the fragment's leaf list (a leaf
+        # list is a pytree): fragment updates must not touch the momentum
+        # of leaves that did not sync this round.
+        outer = outer_tx or diloco_outer_optimizer()
+        self.outer_states = [
+            outer.init([leaves[i] for i in idx]) for idx in self._frag_idx
+        ]
+
+        def frag_delta(anchor_f: list, params_f: list) -> list:
+            return [a - b for a, b in zip(anchor_f, params_f)]
+
+        def frag_outer(anchor_f: list, ostate, avg_f: list):
+            updates, ostate = outer.update(avg_f, ostate, anchor_f)
+            return optax.apply_updates(anchor_f, updates), ostate
+
+        def frag_merge(anchor_new: list, params_f: list,
+                       at_send: list) -> list:
+            # Global correction + local progress made during the flight.
+            return [a + (p - s)
+                    for a, p, s in zip(anchor_new, params_f, at_send)]
+
+        self._frag_delta = jax.jit(frag_delta) if jit else frag_delta
+        self._frag_outer = jax.jit(frag_outer) if jit else frag_outer
+        self._frag_merge = jax.jit(frag_merge) if jit else frag_merge
+
+        # Shared plumbing (inner step, params/anchor/inner_state, manager
+        # wiring, shutdown) comes from DiLoCoTrainer; the full-tree
+        # outer_state it initializes goes unused here (the per-fragment
+        # states above replace it).
+        super().__init__(loss_fn, inner_tx, params, manager_factory,
+                         outer_tx=outer_tx, sync_every=sync_every, jit=jit)
+
+    # ------------------------------------------------------------------ api
+
+    def _leaves(self, tree: Any) -> list:
+        return jax.tree_util.tree_flatten(tree)[0]
+
+    def _rebuild(self, leaves: list) -> Any:
+        return jax.tree_util.tree_unflatten(self._treedef, leaves)
+
+    def train_step(self, batch: Any) -> Tuple[Any, Optional[bool]]:
+        """One inner step; every ``sync_every/fragments``-th call collects
+        the in-flight fragment (if any) and launches the next one. Returns
+        ``(loss, committed)`` — ``None`` when no fragment round completed
+        this call."""
+        self.params, self.inner_state, loss = self._inner_step(
+            self.params, self.inner_state, batch)
+        self.local_steps += 1
+        committed: Optional[bool] = None
+        if self.local_steps % self.interval == 0:
+            committed = self.collect_pending()
+            self.launch_fragment()
+        return loss, committed
+
+    def outer_round(self) -> bool:
+        """Streaming equivalent of one outer exchange: collect the
+        in-flight fragment round (if any), then launch the next one."""
+        committed = self.collect_pending()
+        self.launch_fragment()
+        return bool(committed)
+
+    def launch_fragment(self) -> int:
+        """Start the next fragment's outer round: the fragment's
+        pseudo-gradient is handed to the cross-group allreduce and inner
+        steps continue while the transfer flies."""
+        m = self.manager
+        m.step()
+        # The fragment id must be the QUORUM-AGREED round, not the
+        # pre-quorum local step: an async-healing rejoiner's step counter
+        # is rewritten to the survivors' max_step on the quorum thread,
+        # and choosing the fragment before that lands would feed a
+        # different leaf set into the same ring than everyone else.
+        # (Manager.allreduce joins the quorum future anyway, so this
+        # costs no overlap.)
+        m.wait_quorum()
+        frag = m.current_step() % self.fragments
+        idx = self._frag_idx[frag]
+        a = self._leaves(self.anchor)
+        p = self._leaves(self.params)
+        anchor_f = [a[i] for i in idx]
+        params_f = [p[i] for i in idx]
+        pseudo = self._frag_delta(anchor_f, params_f)
+        fut = m.allreduce(pseudo)
+        self._pending = (frag, fut, params_f)
+        return frag
+
+    def collect_pending(self) -> Optional[bool]:
+        """Resolve the in-flight fragment round: commit vote, advance the
+        fragment's anchor, merge the correction into live params."""
+        if self._pending is None:
+            return None
+        m = self.manager
+        frag, fut, at_send = self._pending
+        self._pending = None
+        avg_f = fut.result()
+        committed = m.should_commit()  # may heal this holder in-place
+        if not committed:
+            logger.warning("fragment round %d (frag %d) aborted; "
+                           "continuing locally", m.current_step(), frag)
+            return False
+        healed = m.is_healing()
+        idx = self._frag_idx[frag]
+        a = self._leaves(self.anchor)
+        p = self._leaves(self.params)
+        anchor_f = [a[i] for i in idx]
+        new_anchor_f, self.outer_states[frag] = self._frag_outer(
+            anchor_f, self.outer_states[frag], avg_f)
+        if healed:
+            # Restored state: take the synchronized values outright.
+            new_params_f = list(new_anchor_f)
+        else:
+            params_f = [p[i] for i in idx]
+            new_params_f = self._frag_merge(new_anchor_f, params_f, at_send)
+        for j, i in enumerate(idx):
+            a[i] = new_anchor_f[j]
+            p[i] = new_params_f[j]
+        self.anchor = self._rebuild(a)
+        self.params = self._rebuild(p)
+        return True
+
+    def flush(self) -> Optional[bool]:
+        """Drain the in-flight round (end of training / before a durable
+        checkpoint)."""
+        return self.collect_pending()
+
+    # ------------------------------------------------- state (for healing)
+
+    def state_dict(self) -> Any:
+        return {
+            "params": self.params,
+            "anchor": self.anchor,
+            "inner_state": self.inner_state,
+            "outer_states": self.outer_states,
+            "local_steps": self.local_steps,
+        }
+
+    def load_state_dict(self, state: Any) -> None:
+        self.params = state["params"]
+        self.anchor = state["anchor"]
+        self.inner_state = state["inner_state"]
+        self.outer_states = state["outer_states"]
+        self.local_steps = int(state["local_steps"])
+
+    def shutdown(self) -> None:
+        self.manager.shutdown()
