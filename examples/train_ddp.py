@@ -59,6 +59,17 @@ def main() -> None:
     total_steps = int(os.environ.get("TOTAL_STEPS", 200))
     batch_size = int(os.environ.get("BATCH_SIZE", 64))
 
+    # Self-contained single-group mode: with no TORCHFT_LIGHTHOUSE and
+    # only one group, embed the quorum server instead of requiring the
+    # operator to start one (multi-group runs must share one).
+    embedded_lh = None
+    if "TORCHFT_LIGHTHOUSE" not in os.environ and num_groups == 1:
+        from torchft_tpu import Lighthouse
+        embedded_lh = Lighthouse(bind="127.0.0.1:0", min_replicas=1,
+                                 join_timeout_ms=200, quorum_tick_ms=20)
+        os.environ["TORCHFT_LIGHTHOUSE"] = embedded_lh.address()
+        logger.info("embedded lighthouse at %s", embedded_lh.address())
+
     data = make_dataset()
     sampler = DistributedSampler(
         dataset_size=len(data["y"]),
@@ -116,6 +127,8 @@ def main() -> None:
     logger.info("done: %d steps, %d batches committed",
                 m.current_step(), m.batches_committed())
     trainer.shutdown()
+    if embedded_lh is not None:
+        embedded_lh.shutdown()
 
 
 if __name__ == "__main__":

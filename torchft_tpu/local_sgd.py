@@ -261,11 +261,12 @@ class StreamingDiLoCoTrainer(DiLoCoTrainer):
         self._frag_merge = jax.jit(frag_merge) if jit else frag_merge
 
         # Shared plumbing (inner step, params/anchor/inner_state, manager
-        # wiring, shutdown) comes from DiLoCoTrainer; the full-tree
-        # outer_state it initializes goes unused here (the per-fragment
-        # states above replace it).
+        # wiring, shutdown) comes from DiLoCoTrainer.
         super().__init__(loss_fn, inner_tx, params, manager_factory,
                          outer_tx=outer_tx, sync_every=sync_every, jit=jit)
+        # The base class's full-tree outer momentum is replaced by the
+        # per-fragment states; holding it would pin a model-size buffer.
+        self.outer_state = None
 
     # ------------------------------------------------------------------ api
 
@@ -378,5 +379,3 @@ class StreamingDiLoCoTrainer(DiLoCoTrainer):
         self.outer_states = state["outer_states"]
         self.local_steps = int(state["local_steps"])
 
-    def shutdown(self) -> None:
-        self.manager.shutdown()
