@@ -27,7 +27,8 @@ from torchft_tpu.communicator import (
 )
 from torchft_tpu.backends.host import HostCommunicator
 from torchft_tpu.backends.mesh import MeshCommunicator, MeshWorld
-from torchft_tpu.data import BatchIterator, DistributedSampler
+from torchft_tpu.data import (BatchIterator, DistributedSampler,
+                              ElasticBatchIterator, ElasticSampler)
 from torchft_tpu.local_sgd import (DiLoCoTrainer, StreamingDiLoCoTrainer,
                                    diloco_outer_optimizer)
 from torchft_tpu.manager import Manager, WorldSizeMode
@@ -41,6 +42,8 @@ __all__ = [
     "DiLoCoTrainer",
     "StreamingDiLoCoTrainer",
     "DistributedSampler",
+    "ElasticBatchIterator",
+    "ElasticSampler",
     "diloco_outer_optimizer",
     "DummyCommunicator",
     "ErrorSwallowingCommunicator",

@@ -329,6 +329,101 @@ class StatefulLoader:
         self._q = None
 
 
+class ElasticSampler:
+    """Membership-elastic index batches: data sharding that follows the
+    quorum instead of a static group count.
+
+    The reference's sampler (and :class:`DistributedSampler` above) shards
+    by a FIXED ``num_replica_groups``; when a group dies, its shard simply
+    goes unvisited for the rest of the epoch (lossy by design,
+    /root/reference/torchft/data.py:33-36). This sampler instead assigns
+    each participating group one **slot** of a single global batch stream:
+
+        slot = manager.batches_committed() + manager.participant_rank()
+
+    ``batches_committed`` advances by ``num_participants`` exactly when a
+    step commits (all groups agree on it — it is part of the manager's
+    healed state), and participant ranks partition ``[0, n)`` within the
+    quorum, so:
+
+    * every world size partitions the stream with no static configuration;
+    * an **aborted** step redraws the same slots (nothing was consumed);
+    * a membership change re-partitions from the next step on — at most
+      ONE step's slots are drawn twice or skipped around the change
+      (the draw may race the async quorum), versus whole shards lost
+      per epoch with static sharding;
+    * healing/benched groups (``participant_rank() is None``) draw a
+      throwaway batch (their gradients are zeroed anyway).
+
+    Shuffling permutes the epoch deterministically from ``(seed, epoch)``,
+    so every group computes identical permutations with no coordination.
+
+    Call :meth:`next_indices` once per training step, ideally right
+    before ``train_step`` (drawing late in the step narrows the
+    membership-change race window).
+    """
+
+    def __init__(self, dataset_size: int, manager: Any,
+                 batch_size: int = 1, shuffle: bool = True,
+                 seed: int = 0) -> None:
+        if dataset_size < batch_size:
+            raise ValueError("dataset smaller than one batch")
+        self.dataset_size = dataset_size
+        self.manager = manager
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.seed = seed
+        self.batches_per_epoch = dataset_size // batch_size
+        self._perm_cache: Dict[int, np.ndarray] = {}
+
+    def _perm(self, epoch: int) -> np.ndarray:
+        perm = self._perm_cache.get(epoch)
+        if perm is None:
+            if self.shuffle:
+                perm = np.random.default_rng(
+                    (self.seed, epoch)).permutation(self.dataset_size)
+            else:
+                perm = np.arange(self.dataset_size)
+            # Keep at most this epoch and its predecessor (stragglers
+            # around a wrap), not an unbounded history.
+            self._perm_cache = {
+                e: p for e, p in self._perm_cache.items() if e == epoch - 1
+            }
+            self._perm_cache[epoch] = perm
+        return perm
+
+    def next_indices(self) -> np.ndarray:
+        """Index batch for this group's slot of the current step."""
+        rank = self.manager.participant_rank()
+        slot = self.manager.batches_committed() + (rank or 0)
+        epoch, pos = divmod(slot, self.batches_per_epoch)
+        perm = self._perm(int(epoch))
+        lo = pos * self.batch_size
+        return perm[lo:lo + self.batch_size]
+
+    def epoch(self) -> int:
+        return int(self.manager.batches_committed()
+                   // self.batches_per_epoch)
+
+
+class ElasticBatchIterator:
+    """Batch stream over in-memory arrays driven by an
+    :class:`ElasticSampler` — draw exactly once per training step."""
+
+    def __init__(self, arrays: Any, sampler: ElasticSampler) -> None:
+        self.arrays = arrays
+        self.sampler = sampler
+
+    def __iter__(self) -> "ElasticBatchIterator":
+        return self
+
+    def __next__(self) -> Any:
+        import jax
+
+        idx = self.sampler.next_indices()
+        return jax.tree_util.tree_map(lambda a: a[idx], self.arrays)
+
+
 class BatchIterator:
     """Infinite batch stream over in-memory arrays using a
     :class:`DistributedSampler`, auto-advancing epochs — convenience for

@@ -870,6 +870,14 @@ class Manager:
         ``manager.py:508-518``)."""
         return self._participating_world_size
 
+    def participant_rank(self) -> Optional[int]:
+        """This group's rank among the step's participants, or ``None``
+        while healing/benched. Drives elastic data sharding
+        (:class:`~torchft_tpu.data.ElasticSampler`)."""
+        if self._participating_rank is None or self._healing:
+            return None
+        return self._participating_rank
+
     def is_participating(self) -> bool:
         """False while healing (async) or benched as a spare (reference
         ``manager.py:520-532``)."""
