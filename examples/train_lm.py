@@ -170,15 +170,22 @@ def main() -> None:
             logger.info("resumed from %s at step %d", path,
                         m.current_step())
 
+    # Async writer: durable saves snapshot on-device in milliseconds and
+    # serialize/write on a background thread — the step loop never stalls
+    # for the device fetch or the disk (keep=3 retains a rollback window).
+    ckpt_writer = None
+    if ckpt_dir:
+        from torchft_tpu.checkpoint_io import AsyncCheckpointer
+
+        ckpt_writer = AsyncCheckpointer(keep=3)
+
     t0 = time.perf_counter()
     while m.current_step() < total_steps:
         batch = next(batches)
         loss, committed = trainer.train_step(batch)
         step = m.current_step()
-        if ckpt_dir and committed and step % ckpt_every == 0:
-            from torchft_tpu import checkpoint_io
-
-            checkpoint_io.save(
+        if ckpt_writer is not None and committed and step % ckpt_every == 0:
+            ckpt_writer.save_async(
                 os.path.join(ckpt_dir, str(replica_group), f"ckpt_{step}"),
                 {"trainer": trainer.state_dict(),
                  "loader": batches.state_dict()},
@@ -193,8 +200,14 @@ def main() -> None:
             t0 = time.perf_counter()
     logger.info("done: %d steps, %d batches committed",
                 m.current_step(), m.batches_committed())
-    batches.shutdown()
-    trainer.shutdown()
+    try:
+        if ckpt_writer is not None:
+            ckpt_writer.shutdown()  # drain the in-flight durable save;
+            # raises if the final write failed — teardown still runs so
+            # the manager farewells the lighthouse cleanly.
+    finally:
+        batches.shutdown()
+        trainer.shutdown()
 
 
 if __name__ == "__main__":

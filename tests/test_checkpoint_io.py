@@ -105,3 +105,78 @@ class TestLatest:
         assert checkpoint_io.latest(str(tmp_path), prefix="model_") == str(
             tmp_path / "model_4")
         assert checkpoint_io.latest(str(tmp_path)) is None
+
+
+class TestAsyncCheckpointer:
+    def test_matches_sync_save(self, tmp_path):
+        from torchft_tpu.checkpoint_io import AsyncCheckpointer
+
+        state = {"w": jnp.arange(6, dtype=jnp.float32), "n": np.int64(3)}
+        mgr = {"step": 7, "batches_committed": 12}
+        ck = AsyncCheckpointer()
+        try:
+            fut = ck.save_async(str(tmp_path / "ckpt_7"), state, mgr)
+            assert fut.result(timeout=30) == str(tmp_path / "ckpt_7")
+            user, m = checkpoint_io.load(str(tmp_path / "ckpt_7"), target=state,
+                                device_put=False)
+            np.testing.assert_array_equal(user["w"], np.arange(6))
+            assert m == mgr
+        finally:
+            ck.shutdown()
+
+    def test_snapshot_survives_mutation_after_call(self, tmp_path):
+        """The on-device snapshot is taken at save_async time: replacing
+        (or deleting) the caller's arrays afterwards must not change what
+        lands on disk — the donation-safety contract."""
+        from torchft_tpu.checkpoint_io import AsyncCheckpointer
+
+        w = jnp.arange(4, dtype=jnp.float32)
+        state = {"w": w}
+        ck = AsyncCheckpointer()
+        try:
+            fut = ck.save_async(str(tmp_path / "ckpt_1"), state)
+            w.delete()  # simulate a donated buffer being consumed
+            fut.result(timeout=30)
+            user, _ = checkpoint_io.load(str(tmp_path / "ckpt_1"),
+                                target={"w": jnp.zeros(4)},
+                                device_put=False)
+            np.testing.assert_array_equal(user["w"], np.arange(4))
+        finally:
+            ck.shutdown()
+
+    def test_serializes_overlapping_saves_and_prunes(self, tmp_path):
+        from torchft_tpu.checkpoint_io import AsyncCheckpointer
+
+        ck = AsyncCheckpointer(keep=2)
+        try:
+            for step in range(5):
+                ck.save_async(str(tmp_path / f"ckpt_{step}"),
+                              {"w": jnp.full(2, float(step))},
+                              {"step": step, "batches_committed": step})
+            ck.wait()
+            names = sorted(p.name for p in tmp_path.iterdir()
+                           if p.name.startswith("ckpt_"))
+            assert names == ["ckpt_3", "ckpt_4"]
+            assert checkpoint_io.latest(str(tmp_path)) == str(tmp_path / "ckpt_4")
+        finally:
+            ck.shutdown()
+
+    def test_write_failure_surfaces_on_next_call(self, tmp_path):
+        from torchft_tpu.checkpoint_io import AsyncCheckpointer
+
+        ck = AsyncCheckpointer()
+        try:
+            bad = tmp_path / "noexist" / "sub"
+            # Make the directory un-creatable by occupying the parent path
+            # with a FILE.
+            (tmp_path / "noexist").write_text("a file, not a dir")
+            fut = ck.save_async(str(bad / "ckpt_1"), {"w": jnp.zeros(2)})
+            with pytest.raises(Exception):
+                fut.result(timeout=30)
+            with pytest.raises(RuntimeError, match="previous async"):
+                ck.save_async(str(tmp_path / "ckpt_2"), {"w": jnp.zeros(2)})
+            # the latched error clears; subsequent saves work
+            f2 = ck.save_async(str(tmp_path / "ckpt_3"), {"w": jnp.zeros(2)})
+            assert f2.result(timeout=30)
+        finally:
+            ck.shutdown()
