@@ -149,6 +149,40 @@ class TestHostCommunicator:
         for c in comms:
             c.shutdown()
 
+    def test_allreduce_config_skew_fails_fast(self, store):
+        # Mismatched (bucket_bytes, wire_dtype) across groups would wedge
+        # every bucketed ring collective on mismatched collective counts;
+        # the fingerprint check (set by Manager, verified during the store
+        # rendezvous) must surface it as a clear error instead.
+        addr = store.address()
+        comms = [HostCommunicator(timeout_sec=5) for _ in range(2)]
+        comms[0].allreduce_config_fingerprint = "bucket_bytes=4194304;bf16"
+        comms[1].allreduce_config_fingerprint = "bucket_bytes=1048576;None"
+
+        def run(rank):
+            comms[rank].configure(f"{addr}/qskew", rank, 2)
+
+        with pytest.raises(RuntimeError, match="allreduce config skew"):
+            _run_ranks(2, run)
+        for c in comms:
+            c.shutdown()
+
+    def test_matching_config_fingerprint_passes(self, store):
+        addr = store.address()
+        comms = [HostCommunicator(timeout_sec=30) for _ in range(2)]
+        for c in comms:
+            c.allreduce_config_fingerprint = "bucket_bytes=4194304;None"
+
+        def run(rank):
+            comms[rank].configure(f"{addr}/qok", rank, 2)
+            return comms[rank].allreduce(
+                {"a": np.ones(4, np.float32)}).result(timeout=30)
+
+        for out in _run_ranks(2, run):
+            np.testing.assert_allclose(out["a"], np.full(4, 2.0))
+        for c in comms:
+            c.shutdown()
+
     def test_allreduce_mean(self, store):
         addr = store.address()
         comms = [HostCommunicator(timeout_sec=30) for _ in range(2)]

@@ -171,6 +171,27 @@ class HostCommunicator(Communicator):
         store = StoreClient(host_port, connect_timeout_ms=int(
             self._timeout * 1000))
 
+        # Allreduce-config skew check (set by Manager before configure):
+        # every rank must derive the identical bucket schedule from
+        # (allreduce_bucket_bytes, allreduce_wire_dtype) or the ring wedges
+        # on mismatched collective counts with no diagnostic. Publish this
+        # rank's fingerprint and compare against rank 0's over the store
+        # we're already connected to — a mismatch is a launch bug, so fail
+        # loudly now instead of degenerating into timeout/abort loops.
+        fp = getattr(self, "allreduce_config_fingerprint", None)
+        if fp is not None:
+            store.set(f"{prefix}/arcfg/{rank}", fp.encode())
+            anchor = store.get(f"{prefix}/arcfg/0", timeout_ms=int(
+                self._timeout * 1000)).decode()
+            if anchor != fp:
+                raise RuntimeError(
+                    f"allreduce config skew: this group has [{fp}] but "
+                    f"replica rank 0 announced [{anchor}]. All groups must "
+                    "be launched with identical allreduce_bucket_bytes / "
+                    "allreduce_wire_dtype or every bucketed ring "
+                    "collective will wedge."
+                )
+
         listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         listener.bind(("0.0.0.0", 0))

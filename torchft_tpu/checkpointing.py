@@ -153,8 +153,14 @@ class CheckpointServer:
                     got = self.headers.get("Authorization", "")
                     want = f"Bearer {ckpt_server._auth_token}"
                     # Constant-time compare: plain != short-circuits and
-                    # leaks the token prefix via response timing.
-                    if not hmac.compare_digest(got, want):
+                    # leaks the token prefix via response timing. Compare as
+                    # bytes — compare_digest raises TypeError on non-ASCII
+                    # str, which an attacker could trigger with a latin-1
+                    # header to crash the handler instead of getting a 401.
+                    if not hmac.compare_digest(
+                        got.encode("latin-1", "replace"),
+                        want.encode("latin-1", "replace"),
+                    ):
                         self.send_error(401, "missing/bad bearer token")
                         return
                 prefix = "/checkpoint/"

@@ -358,9 +358,14 @@ class ElasticSampler:
     Shuffling permutes the epoch deterministically from ``(seed, epoch)``,
     so every group computes identical permutations with no coordination.
 
-    Call :meth:`next_indices` once per training step, ideally right
-    before ``train_step`` (drawing late in the step narrows the
-    membership-change race window).
+    Call :meth:`next_indices` exactly once per training step, AFTER
+    ``manager.step()`` has been called for that step — ``step()`` is where
+    ``batches_committed`` lazily advances, so a draw taken before it lags
+    the commit counter by one step (and draws step 1's slots twice). With
+    :class:`~torchft_tpu.parallel.FTTrainer`, don't call this yourself:
+    pass the iterator's ``__next__`` (or any zero-arg callable) as the
+    ``batch`` argument and the trainer draws at the right point. Drawing
+    late in the step also narrows the membership-change race window.
     """
 
     def __init__(self, dataset_size: int, manager: Any,

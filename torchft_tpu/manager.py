@@ -389,6 +389,17 @@ class Manager:
                 self._replica_id, q.quorum_id, q.replica_rank,
                 q.replica_world_size,
             )
+            # Fail fast on allreduce-config skew: the bucketed host
+            # allreduce derives its bucket schedule from per-Manager config
+            # (allreduce_bucket_bytes / allreduce_wire_dtype); groups
+            # launched with mismatched values would wedge every ring
+            # collective on mismatched bucket counts with no diagnostic.
+            # The fingerprint rides the backend's own store rendezvous
+            # (backends/host.py) — no extra connection, and the on-device
+            # mesh path (which never buckets) never pays for it.
+            setattr(self._comm, "allreduce_config_fingerprint",
+                    f"bucket_bytes={self._bucket_bytes};"
+                    f"wire_dtype={self._wire_dtype}")
             self._comm.configure(
                 store_prefixed, q.replica_rank, q.replica_world_size
             )
@@ -614,6 +625,18 @@ class Manager:
         lock = threading.Lock()
         pending = [len(buckets)]
 
+        # Completion races: the caller thread, the comm callback, and the
+        # put executor can all try to settle `agg` (first error wins). A
+        # bare `if not agg.done(): agg.set_exception(...)` is check-then-act
+        # across threads — the loser raises InvalidStateError *inside the
+        # comm backend's callback dispatch*, surfacing as an unrelated
+        # backend error. Settle through one helper that absorbs the race.
+        def settle_exception(e: BaseException) -> None:
+            try:
+                agg.set_exception(e)
+            except BaseException:  # already settled by another thread
+                pass
+
         def finish_bucket(idx: list, reduced: list) -> None:
             try:
                 scaled = {i: div_by_count(a, n)
@@ -639,26 +662,26 @@ class Manager:
                         allreduce_ms_total=(
                             time.perf_counter() - ar_t0) * 1e3,
                     )
-                    agg.set_result(
-                        jax.tree_util.tree_unflatten(treedef, out_leaves))
+                    try:
+                        agg.set_result(
+                            jax.tree_util.tree_unflatten(treedef, out_leaves))
+                    except BaseException:  # a bucket error settled it first
+                        pass
             except Exception as e:  # noqa: BLE001
-                if not agg.done():
-                    agg.set_exception(e)
+                settle_exception(e)
 
         def on_bucket(idx: list) -> Callable[[Future], None]:
             def cb(f: Future) -> None:
                 e = f.exception()
                 if e is not None:
-                    if not agg.done():
-                        agg.set_exception(e)
+                    settle_exception(e)
                     return
                 if not agg.done():
                     try:
                         self._put_executor.submit(
                             finish_bucket, idx, f.result())
                     except Exception as e2:  # executor shut down mid-step
-                        if not agg.done():
-                            agg.set_exception(e2)
+                        settle_exception(e2)
             return cb
 
         # Stage 1, on the caller thread: fetch bucket i+1 while the comm

@@ -541,6 +541,18 @@ def _flash_bwd(q, k, v, out, lse, g, causal: bool, block_q: Optional[int],
     # be pipeline-safe (see _bwd_fused_kernel); the split kernels below
     # remain the short-sequence fallback. TORCHFT_FLASH_FUSED_BWD=0 is
     # the operational kill-switch back to the split kernels.
+    #
+    # SAFETY CONTRACT for the nqb >= 4 gate: the dq accumulation relies on
+    # input_output_aliases HBM read-modify-write whose correctness depends
+    # on Mosaic's write-back-vs-prefetch distance along the innermost (q)
+    # grid axis. nqb >= 4 is an EMPIRICAL margin (measured safe on v5e at
+    # block_q=512), not a documented Pallas guarantee, and interpret-mode
+    # tests cannot catch a real-device race. Revisit whenever (a) jaxlib /
+    # libtpu is upgraded, (b) block_q or the grid order changes, or (c) a
+    # new tile shape is enabled — by running the hardware split-vs-fused
+    # comparison (tests/test_attention.py::TestFusedBwdHardware, marked
+    # `nightly`; skips without a TPU) which re-validates dq on every
+    # nightly TPU run rather than as a one-off.
     import os
     fused_ok = os.environ.get("TORCHFT_FLASH_FUSED_BWD", "1") != "0"
     if nqb >= 4 and fused_ok:

@@ -1,0 +1,83 @@
+"""Hardware re-validation of the fused flash-attention backward.
+
+The fused backward's dq accumulation is an HBM read-modify-write through
+``input_output_aliases`` whose safety rests on Mosaic's write-back vs
+prefetch distance — an empirical property (the ``nqb >= 4`` gate in
+``flash_attention.py``), not a documented guarantee, and one that
+interpret-mode tests can never exercise. This module is the recurring
+real-device check the gate's comment promises: it runs the SAME backward
+twice on hardware — fused (``TORCHFT_FLASH_FUSED_BWD=1``) and split
+(``=0``) — and compares dq/dk/dv. A pipelining race corrupts dq by whole
+tiles, so any mismatch beyond last-ulp accumulation noise fails loudly.
+
+Exit codes: 0 = match, 75 = no TPU available (skip), 1 = MISMATCH (do not
+ship; set ``TORCHFT_FLASH_FUSED_BWD=0`` operationally until fixed).
+
+Run nightly via ``tests/test_attention.py::TestFusedBwdHardware`` (marker
+``nightly``), and manually after any jaxlib/libtpu upgrade or block-shape
+change: ``python -m torchft_tpu.ops.fused_bwd_check``.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+SKIP = 75
+
+
+def _grads(q, k, v, use_fused: bool):
+    import jax
+
+    os.environ["TORCHFT_FLASH_FUSED_BWD"] = "1" if use_fused else "0"
+    from torchft_tpu.ops.flash_attention import flash_attention
+
+    def loss(q, k, v):
+        return flash_attention(q, k, v, causal=True).astype("float32").sum()
+
+    # jit cache would reuse the first variant's trace if the env var were
+    # read at trace time under the same signature; it is read at trace
+    # time, so trace each variant fresh.
+    return jax.jit(jax.grad(loss, argnums=(0, 1, 2)))(q, k, v)
+
+
+def main() -> int:
+    import jax
+
+    if jax.default_backend() not in ("tpu", "axon"):
+        print(f"fused_bwd_check: no TPU backend "
+              f"({jax.default_backend()}); skipping", file=sys.stderr)
+        return SKIP
+    import jax.numpy as jnp
+    import numpy as np
+
+    # Deep q grid (nqb = s/block_q = 8 >= 4) so the fused path is taken.
+    b, s, h, d = 1, 4096, 8, 128
+    ks = jax.random.split(jax.random.key(0), 3)
+    q, k, v = (jax.random.normal(kk, (b, s, h, d), jnp.bfloat16)
+               for kk in ks)
+
+    fused = _grads(q, k, v, use_fused=True)
+    split = _grads(q, k, v, use_fused=False)
+    worst = 0.0
+    for name, a, bb in zip(("dq", "dk", "dv"), fused, split):
+        diff = float(jnp.max(jnp.abs(
+            a.astype(jnp.float32) - bb.astype(jnp.float32))))
+        scale = float(jnp.max(jnp.abs(bb.astype(jnp.float32)))) or 1.0
+        rel = diff / scale
+        worst = max(worst, rel)
+        print(f"fused_bwd_check: {name} max_abs_diff={diff:.3e} "
+              f"rel={rel:.3e}")
+    # Both paths accumulate dq in f32 over the same k-block order; a
+    # pipelining race corrupts whole tiles (rel ~ O(1)). 1e-3 leaves room
+    # for bf16 recompute noise while catching any real corruption.
+    if worst > 1e-3:
+        print("fused_bwd_check: MISMATCH — possible dq RMW race; set "
+              "TORCHFT_FLASH_FUSED_BWD=0 and investigate", file=sys.stderr)
+        return 1
+    print("fused_bwd_check: OK (fused == split on hardware)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

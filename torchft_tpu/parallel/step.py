@@ -181,8 +181,17 @@ class FTTrainer:
         (async dispatch + quorum thread), joining at the cross-group
         allreduce — the reference's ``use_async_quorum`` overlap
         (``manager.py:323-332``).
+
+        ``batch`` may be a zero-arg callable (e.g. an
+        :class:`~torchft_tpu.data.ElasticBatchIterator`'s ``__next__``): it
+        is invoked AFTER ``manager.step()``, which is when
+        ``batches_committed`` lazily advances — an elastic sampler drawn
+        before the step would lag the commit counter by one step and draw
+        step 1's slots twice. Plain array batches are unaffected.
         """
         self.manager.step()
+        if callable(batch):
+            batch = batch()
         if self._batch_sharding is not None:
             batch = jax.device_put(batch, self._batch_sharding)
 
