@@ -180,17 +180,36 @@ class HostCommunicator(Communicator):
         # loudly now instead of degenerating into timeout/abort loops.
         fp = getattr(self, "allreduce_config_fingerprint", None)
         if fp is not None:
+            tmo = int(self._timeout * 1000)
             store.set(f"{prefix}/arcfg/{rank}", fp.encode())
-            anchor = store.get(f"{prefix}/arcfg/0", timeout_ms=int(
-                self._timeout * 1000)).decode()
-            if anchor != fp:
-                raise RuntimeError(
+
+            def skew(who: str, other: str) -> RuntimeError:
+                return RuntimeError(
                     f"allreduce config skew: this group has [{fp}] but "
-                    f"replica rank 0 announced [{anchor}]. All groups must "
-                    "be launched with identical allreduce_bucket_bytes / "
+                    f"{who} announced [{other}]. All groups must be "
+                    "launched with identical allreduce_bucket_bytes / "
                     "allreduce_wire_dtype or every bucketed ring "
                     "collective will wedge."
                 )
+
+            if rank == 0:
+                # Rank 0 IS the anchor, so it must verify the others —
+                # otherwise a skewed launch gives the clear error only on
+                # ranks != 0 while rank 0 (the logs operators watch)
+                # degenerates into a generic rendezvous timeout. Peers
+                # publish before reading the anchor, so these keys arrive
+                # no later than the listener addresses the ring build
+                # waits on anyway.
+                for r in range(1, world_size):
+                    other = store.get(f"{prefix}/arcfg/{r}",
+                                      timeout_ms=tmo).decode()
+                    if other != fp:
+                        raise skew(f"replica rank {r}", other)
+            else:
+                anchor = store.get(f"{prefix}/arcfg/0",
+                                   timeout_ms=tmo).decode()
+                if anchor != fp:
+                    raise skew("replica rank 0", anchor)
 
         listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)

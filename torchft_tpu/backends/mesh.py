@@ -392,6 +392,13 @@ class MeshCommunicator(Communicator):
         if self._fallback_inst is None:
             self._fallback_inst = HostCommunicator(
                 timeout_sec=self._timeout_sec)
+        # Forward the Manager-set allreduce-config fingerprint so the host
+        # fallback's store rendezvous runs the skew check. Done here — at
+        # the only point the fallback materializes — so pure on-device mesh
+        # deployments never pay for the fallback's worker thread.
+        fp = getattr(self, "allreduce_config_fingerprint", None)
+        if fp is not None:
+            setattr(self._fallback_inst, "allreduce_config_fingerprint", fp)
         return self._fallback_inst
 
     @property
@@ -423,12 +430,6 @@ class MeshCommunicator(Communicator):
         self._rank = rank
         self._size = world_size
         self._prefix = store_addr
-        # Forward the Manager-set allreduce-config fingerprint so the host
-        # fallback's store rendezvous runs the skew check (the on-device
-        # path never buckets, so it has nothing to check).
-        fp = getattr(self, "allreduce_config_fingerprint", None)
-        if fp is not None:
-            setattr(self._fallback, "allreduce_config_fingerprint", fp)
         poisoned = self._mesh_world.poisoned()
         if world_size == self._mesh_world.num_groups and poisoned is None:
             # Full static membership: stay on device. No sockets are built;

@@ -157,9 +157,13 @@ class CheckpointServer:
                     # bytes — compare_digest raises TypeError on non-ASCII
                     # str, which an attacker could trigger with a latin-1
                     # header to crash the handler instead of getting a 401.
+                    # `got` came from http.server's latin-1 header decode,
+                    # so latin-1 re-encode recovers the client's raw bytes;
+                    # `want` encodes UTF-8, the byte form a legitimate
+                    # client sends for a non-ASCII token.
                     if not hmac.compare_digest(
                         got.encode("latin-1", "replace"),
-                        want.encode("latin-1", "replace"),
+                        want.encode("utf-8"),
                     ):
                         self.send_error(401, "missing/bad bearer token")
                         return

@@ -79,6 +79,16 @@ class Communicator(ABC):
         over host (numpy) leaves. Wrappers forward the wrapped value."""
         return False
 
+    def set_allreduce_config_fingerprint(self, fp: str) -> None:
+        """Install the Manager's allreduce-config fingerprint (bucket
+        schedule + wire dtype). Backends that rendezvous over a KV store
+        verify it against replica rank 0's during ``configure`` and raise
+        on skew (mismatched configs would wedge every bucketed collective
+        with no diagnostic). Wrappers MUST forward to their inner
+        communicator — a fingerprint stranded on a wrapper silently
+        disables the check."""
+        self.allreduce_config_fingerprint = fp
+
     def shutdown(self) -> None:  # noqa: B027
         pass
 
@@ -211,6 +221,9 @@ class ErrorSwallowingCommunicator(Communicator):
     def wants_device_arrays(self) -> bool:
         return self._comm.wants_device_arrays
 
+    def set_allreduce_config_fingerprint(self, fp: str) -> None:
+        self._comm.set_allreduce_config_fingerprint(fp)
+
     def shutdown(self) -> None:
         self._comm.shutdown()
 
@@ -278,6 +291,9 @@ class ManagedCommunicator(Communicator):
 
     def rank(self) -> int:
         return self._comm.rank()
+
+    def set_allreduce_config_fingerprint(self, fp: str) -> None:
+        self._comm.set_allreduce_config_fingerprint(fp)
 
     @property
     def wants_device_arrays(self) -> bool:

@@ -396,10 +396,14 @@ class Manager:
             # collective on mismatched bucket counts with no diagnostic.
             # The fingerprint rides the backend's own store rendezvous
             # (backends/host.py) — no extra connection, and the on-device
-            # mesh path (which never buckets) never pays for it.
-            setattr(self._comm, "allreduce_config_fingerprint",
-                    f"bucket_bytes={self._bucket_bytes};"
-                    f"wire_dtype={self._wire_dtype}")
+            # mesh path (which never buckets) never pays for it. Wrapper
+            # communicators forward it inward (Communicator ABC contract);
+            # getattr tolerates bare duck-typed comms in tests.
+            setter = getattr(self._comm, "set_allreduce_config_fingerprint",
+                             None)
+            if setter is not None:
+                setter(f"bucket_bytes={self._bucket_bytes};"
+                       f"wire_dtype={self._wire_dtype}")
             self._comm.configure(
                 store_prefixed, q.replica_rank, q.replica_world_size
             )
@@ -662,9 +666,13 @@ class Manager:
                         allreduce_ms_total=(
                             time.perf_counter() - ar_t0) * 1e3,
                     )
+                    # Unflatten OUTSIDE the settle try: a custom pytree
+                    # node raising there must settle agg as an error (the
+                    # outer except), not be eaten by the already-settled
+                    # guard and leave the caller hanging.
+                    result = jax.tree_util.tree_unflatten(treedef, out_leaves)
                     try:
-                        agg.set_result(
-                            jax.tree_util.tree_unflatten(treedef, out_leaves))
+                        agg.set_result(result)
                     except BaseException:  # a bucket error settled it first
                         pass
             except Exception as e:  # noqa: BLE001

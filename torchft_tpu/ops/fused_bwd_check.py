@@ -33,11 +33,16 @@ def _grads(q, k, v, use_fused: bool):
     from torchft_tpu.ops.flash_attention import flash_attention
 
     def loss(q, k, v):
-        return flash_attention(q, k, v, causal=True).astype("float32").sum()
+        # block_q=512 pinned explicitly: that is the tile shape the gate's
+        # safety contract documents as measured-safe (auto-pick would
+        # choose block_q=1024 → nqb=4, validating a different shape than
+        # the one the contract names).
+        return flash_attention(q, k, v, causal=True, block_q=512,
+                               block_k=512).astype("float32").sum()
 
-    # jit cache would reuse the first variant's trace if the env var were
-    # read at trace time under the same signature; it is read at trace
-    # time, so trace each variant fresh.
+    # The env var is read at TRACE time inside _flash_bwd; each call here
+    # builds a fresh closure, so jax.jit re-traces and the toggle takes
+    # effect (a shared cached jit would silently reuse the first variant).
     return jax.jit(jax.grad(loss, argnums=(0, 1, 2)))(q, k, v)
 
 
@@ -51,7 +56,7 @@ def main() -> int:
     import jax.numpy as jnp
     import numpy as np
 
-    # Deep q grid (nqb = s/block_q = 8 >= 4) so the fused path is taken.
+    # Deep q grid (nqb = 4096/512 = 8 >= 4) so the fused path is taken.
     b, s, h, d = 1, 4096, 8, 128
     ks = jax.random.split(jax.random.key(0), 3)
     q, k, v = (jax.random.normal(kk, (b, s, h, d), jnp.bfloat16)
