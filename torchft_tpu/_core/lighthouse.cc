@@ -8,6 +8,9 @@
 namespace torchft_tpu {
 
 Lighthouse::Lighthouse(const LighthouseOpt& opt) : opt_(opt) {
+  // Boot-time id seed: a replacement lighthouse must mint ids strictly
+  // above any previous incarnation's (see lighthouse.h quorum_id_).
+  quorum_id_ = (now_ms() / 1000) << 8;
   server_ = std::make_unique<RpcServer>(
       opt.bind,
       [this](uint8_t m, const std::string& req, std::string* resp,

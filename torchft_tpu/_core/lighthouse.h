@@ -98,6 +98,16 @@ class Lighthouse {
   int64_t first_join_ms_ = 0;
   bool has_prev_quorum_ = false;
   Quorum prev_quorum_;
+  // Seeded from boot time, NOT 0: managers detect membership changes by
+  // quorum_id inequality, so a REPLACEMENT lighthouse (operator restarts
+  // it at the same address after a crash — docs/pod_runbook.md "the
+  // lighthouse died") must never mint ids a previous incarnation already
+  // used. A counter restarting at 1 would collide with the common
+  // stable-membership job (id still 1), survivors would skip the
+  // communicator reconfigure, and a ring containing peers that died
+  // during the outage would wedge every collective. Seconds-since-epoch
+  // << 8 leaves 256 id bumps/second headroom within the old incarnation
+  // while guaranteeing the new one starts strictly higher.
   int64_t quorum_id_ = 0;
   int64_t broadcast_seq_ = 0;
   struct Beat {

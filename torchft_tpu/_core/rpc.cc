@@ -291,6 +291,10 @@ bool RpcClient::call(uint8_t method, const std::string& req, std::string* resp,
                      std::string* err, int64_t timeout_ms) {
   std::lock_guard<std::mutex> lk(mu_);
   if (check_cancelled(err)) return false;
+  // A previous call may have poisoned the connection (see below); frames
+  // carry no call id, so a fresh socket is the only way to guarantee the
+  // next response read belongs to the next request.
+  if (fd_ < 0 && !reconnect(err)) return false;
   struct timeval tv = {};
   if (timeout_ms > 0) {
     tv.tv_sec = timeout_ms / 1000;
@@ -319,6 +323,21 @@ bool RpcClient::call(uint8_t method, const std::string& req, std::string* resp,
     if (attempt == 0) {
       if (!reconnect(err)) return false;
       setsockopt(fd_, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+    }
+  }
+  // Final failure with the request possibly still executing server-side
+  // (e.g. a quorum handler parked on a dead lighthouse). Its LATE response
+  // will eventually be written to this socket, and with no call ids in the
+  // framing the next call() would consume it as ITS response — cross-
+  // parsing a quorum payload as a commit decision corrupts the protocol
+  // (observed: should_commit=true against a false vote during a lighthouse
+  // outage). Poison the connection so the next call starts on a socket the
+  // stale frame can never reach.
+  {
+    std::lock_guard<std::mutex> flk(fd_mu_);
+    if (fd_ >= 0) {
+      close(fd_);
+      fd_ = -1;
     }
   }
   *err = "transport: rpc to " + address_ + " failed (timeout or disconnect)";

@@ -189,9 +189,16 @@ class Manager:
         # can't answer (how long do quorums take, how often do we heal).
         self._metrics: Dict[str, float] = {
             "quorum_count": 0, "quorum_ms_total": 0.0, "quorum_ms_last": 0.0,
-            "reconfigure_count": 0, "heal_count": 0,
+            "reconfigure_count": 0, "reconfigure_ms_total": 0.0,
+            "heal_count": 0,
             "heal_ms_total": 0.0, "heal_bytes_total": 0.0,
             "allreduce_count": 0, "allreduce_ms_total": 0.0,
+            # Stage breakdown of the pipelined host allreduce (cumulative
+            # BUSY ms per stage; stages overlap across buckets, so sums
+            # can exceed allreduce_ms_total — they attribute, not
+            # partition). wire_bytes counts what actually crossed D2H.
+            "allreduce_fetch_ms_total": 0.0, "allreduce_ring_ms_total": 0.0,
+            "allreduce_put_ms_total": 0.0, "allreduce_wire_bytes_total": 0.0,
             "commit_count": 0, "commit_ms_total": 0.0,
             "committed_steps": 0, "aborted_steps": 0,
         }
@@ -356,6 +363,21 @@ class Manager:
         with self._metrics_lock:
             self._metrics["quorum_ms_last"] = quorum_ms
 
+        # Defense in depth against transport desync: a structurally-invalid
+        # quorum (no members, or we're not in it) must be treated as a
+        # failed round, never acted on — reconfiguring onto a zero world
+        # poisons the communicator for all subsequent steps. (Root cause
+        # class: a late response frame cross-parsed as this RPC's; the RPC
+        # client now poisons desynced sockets, this guard catches anything
+        # that still slips through.)
+        if (q.replica_world_size <= 0 or q.quorum_id <= 0
+                or not 0 <= q.replica_rank < q.replica_world_size):
+            raise RuntimeError(
+                f"invalid quorum response (quorum_id={q.quorum_id}, "
+                f"replica_rank={q.replica_rank}, "
+                f"replica_world_size={q.replica_world_size}); treating as "
+                "a failed quorum round")
+
         if self._use_async_quorum:
             # Healers are not at max_step, so they sit out this step
             # (max_rank is None) and contribute zero grads.
@@ -404,11 +426,13 @@ class Manager:
             if setter is not None:
                 setter(f"bucket_bytes={self._bucket_bytes};"
                        f"wire_dtype={self._wire_dtype}")
+            reconf_t0 = time.perf_counter()
             self._comm.configure(
                 store_prefixed, q.replica_rank, q.replica_world_size
             )
             self._quorum_id = q.quorum_id
-            self._record(reconfigure_count=1)
+            self._record(reconfigure_count=1, reconfigure_ms_total=(
+                time.perf_counter() - reconf_t0) * 1e3)
             self._log_event(
                 event="reconfigure", step=self._step,
                 quorum_id=q.quorum_id, rank=q.replica_rank,
@@ -643,6 +667,7 @@ class Manager:
 
         def finish_bucket(idx: list, reduced: list) -> None:
             try:
+                put_t0 = time.perf_counter()
                 scaled = {i: div_by_count(a, n)
                           for i, a in zip(idx, reduced)}
                 put_idx = [i for i in idx
@@ -655,6 +680,8 @@ class Manager:
                         [leaves[i].sharding for i in put_idx])
                     for i, a in zip(put_idx, placed):
                         scaled[i] = a
+                self._record(allreduce_put_ms_total=(
+                    time.perf_counter() - put_t0) * 1e3)
                 with lock:
                     for i in idx:
                         out_leaves[i] = scaled[i]
@@ -678,8 +705,13 @@ class Manager:
             except Exception as e:  # noqa: BLE001
                 settle_exception(e)
 
-        def on_bucket(idx: list) -> Callable[[Future], None]:
+        def on_bucket(idx: list, submit_t: float) -> Callable[[Future], None]:
             def cb(f: Future) -> None:
+                # Ring wall = submit -> completion; includes comm-worker
+                # queue wait, i.e. the serialization cost of the single
+                # comm thread when buckets back up behind each other.
+                self._record(allreduce_ring_ms_total=(
+                    time.perf_counter() - submit_t) * 1e3)
                 e = f.exception()
                 if e is not None:
                     settle_exception(e)
@@ -697,6 +729,7 @@ class Manager:
         # the same deterministic leaf order on every rank).
         for idx in buckets:
             if participating:
+                fetch_t0 = time.perf_counter()
                 got = jax.device_get([fetch[i] for i in idx])
                 host = []
                 for i, a in zip(idx, got):
@@ -705,10 +738,16 @@ class Manager:
                     if a.dtype != orig:  # upcast compressed wire leaves
                         a = a.astype(orig)
                     host.append(a)
+                self._record(
+                    allreduce_fetch_ms_total=(
+                        time.perf_counter() - fetch_t0) * 1e3,
+                    allreduce_wire_bytes_total=float(
+                        sum(wire_nbytes(leaves[i]) for i in idx)),
+                )
             else:
                 host = [_zero_like(leaves[i]) for i in idx]
             self._comm.allreduce(host, op="sum").add_done_callback(
-                on_bucket(idx))
+                on_bucket(idx, time.perf_counter()))
 
         return self.wrap_future(agg, default=tree)
 
