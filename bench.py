@@ -85,13 +85,21 @@ def bench_rig_probes(mbytes: float = 4.0, reps: int = 3) -> Dict[str, float]:
     steps/s moved, it's the code."""
     n = int(mbytes * 1e6 / 4)
     host = np.random.default_rng(0).normal(size=(n,)).astype(np.float32)
-    dev = jax.device_put(host)
-    _materialize(dev)
+    bump = jax.jit(lambda a: a + 1)
     probe = jax.jit(lambda a: a + 1)
     _materialize(probe(jnp.zeros(())))
+    base = jax.device_put(host)
+    _materialize(bump(base))  # compile outside the timed region
 
     d2h, h2d, disp = [], [], []
     for _ in range(reps):
+        # The fetched buffer must be a FRESH device computation every rep:
+        # jax caches the host copy on the Array after the first fetch
+        # (and device_put results retain theirs), so re-fetching the same
+        # array reads host RAM and reports GB/s through a MB/s tunnel
+        # (observed: 26 GB/s "D2H").
+        dev = bump(base)
+        dev.block_until_ready()
         t0 = time.perf_counter()
         np.asarray(jax.device_get(dev))
         d2h.append(mbytes / (time.perf_counter() - t0))
@@ -240,8 +248,8 @@ def bench_multigroup(n_groups: int = 2, steps: int = 20,
     sum is one jitted XLA reduction, no serialization or sockets.
 
     ``hidden``/``depth`` size the gradient payload (hidden=512/depth=2
-    ~1.2MB, the historical point; hidden=1024/depth=8 ~8.6MB, deep enough
-    that default 4MB buckets actually multi-bucket). The result carries
+    ~1.2MB, the historical point; hidden=1024/depth=3 ~8.6MB, deep enough
+    that main()'s 2MB buckets actually multi-bucket). The result carries
     the pipelined allreduce's per-stage busy times (fetch/ring/put, from
     Manager.metrics()) so a throughput swing is attributable to a stage —
     and, with bench_rig_probes' bandwidth lines, to the rig vs the code."""
@@ -761,10 +769,10 @@ def main() -> None:
            "wire_mbytes_per_step": round(mw["wire_mbytes_per_step"], 2),
            "stages_ms": stages(mw)})
 
-    # 8.6MB gradient point (hidden=1024, depth=8): big enough that the
-    # default 4MB buckets multi-bucket, making the single-shot-vs-bucketed
-    # A/B meaningful — and bf16 wire halves a D2H leg that dominates here.
-    big = dict(hidden=1024, depth=8, steps=6)
+    # ~8.6MB gradient point (hidden=1024, depth=3): big enough that 2MB
+    # buckets multi-bucket, making the single-shot-vs-bucketed A/B
+    # meaningful — and bf16 wire halves a D2H leg that dominates here.
+    big = dict(hidden=1024, depth=3, steps=6)
     m1 = bench_multigroup(bucket_bytes=1 << 40, **big)  # single-shot
     mb = bench_multigroup(bucket_bytes=2 << 20, **big)  # pipelined buckets
     _emit({"metric": "multigroup_8mb_ab",

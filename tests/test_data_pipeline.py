@@ -8,6 +8,8 @@ round-tripping through disk, O(batch) gathering, exact-position resume,
 disjoint cross-group sharding, and the lossy-rejoin story end to end.
 """
 
+import time
+
 import numpy as np
 import pytest
 
@@ -242,6 +244,93 @@ class TestElasticSampler:
 
 
 @pytest.mark.integration
+class TestElasticLoader:
+    """ElasticSampler x storage tier (round-4 verdict missing #4)."""
+
+    def _mk(self, corpus, rank=0, prefetch=2):
+        from torchft_tpu.data import ElasticLoader, ElasticSampler
+        ds, x, y = corpus
+        m = _FakeFTManager(rank)
+        s = ElasticSampler(len(ds), m, batch_size=8, seed=5)
+        return ElasticLoader(ds, s, prefetch=prefetch), s, m, x, y
+
+    def test_draws_match_slot_indices(self, corpus):
+        loader, s, m, x, y = self._mk(corpus)
+        try:
+            for _ in range(4):
+                idx = s.next_indices()
+                batch = loader()
+                np.testing.assert_array_equal(batch["x"], x[idx])
+                np.testing.assert_array_equal(batch["y"], y[idx])
+                m.bc += 1  # commit
+        finally:
+            loader.shutdown()
+
+    def test_prefetch_hits_on_committed_stream(self, corpus):
+        loader, s, m, x, y = self._mk(corpus)
+        try:
+            loader()           # cold draw: miss, schedules bc+1, bc+2
+            deadline = time.monotonic() + 10
+            for _ in range(6):
+                m.bc += 1
+                # Let the background read land; a miss is CORRECT but we
+                # assert the predictor mostly wins on a steady stream.
+                while time.monotonic() < deadline:
+                    with loader._lock:
+                        if m.bc in loader._cache:
+                            break
+                    time.sleep(0.01)
+                batch = loader()
+                np.testing.assert_array_equal(
+                    batch["x"], x[s.indices_for_slot(m.bc)])
+            assert loader.prefetch_hits >= 4, (
+                loader.prefetch_hits, loader.prefetch_misses)
+        finally:
+            loader.shutdown()
+
+    def test_abort_redraw_served_from_cache(self, corpus):
+        loader, s, m, x, y = self._mk(corpus)
+        try:
+            a = loader()
+            b = loader()  # same slot (abort: bc unchanged) -> cache hit
+            np.testing.assert_array_equal(a["x"], b["x"])
+            assert loader.prefetch_hits == 1
+        finally:
+            loader.shutdown()
+
+    def test_membership_change_still_exact(self, corpus):
+        # A rank/participant change invalidates the prediction, never the
+        # draw: the slot is recomputed live, at worst costing a sync read.
+        loader, s, m, x, y = self._mk(corpus, rank=1)
+        try:
+            loader()
+            m.rank = 0          # membership changed under the loader
+            m.bc += 3           # commits advanced unpredictably
+            idx = s.next_indices()
+            np.testing.assert_array_equal(loader()["x"], x[idx])
+        finally:
+            loader.shutdown()
+
+    def test_token_file_backend(self, tmp_path):
+        from torchft_tpu.data import (ElasticLoader, ElasticSampler,
+                                      TokenFileDataset)
+        toks = np.arange(16 * 64, dtype=np.int64) % 1000
+        TokenFileDataset.write(str(tmp_path / "t.npy"), toks)
+        ds = TokenFileDataset(str(tmp_path / "t.npy"), seq_len=16)
+        m = _FakeFTManager(0)
+        s = ElasticSampler(len(ds), m, batch_size=4, seed=0)
+        loader = ElasticLoader(ds, s, prefetch=1)
+        try:
+            batch = loader()
+            assert batch["tokens"].shape == (4, 16)
+            rows = s.indices_for_slot(0)
+            np.testing.assert_array_equal(
+                batch["tokens"][0],
+                toks[rows[0] * 16:(rows[0] + 1) * 16].astype(np.int32))
+        finally:
+            loader.shutdown()
+
+
 class TestElasticSamplerIntegration:
     def test_coverage_survives_death_and_heal(self):
         """Two groups draw from one elastic stream; one dies and a fresh

@@ -28,7 +28,8 @@ from torchft_tpu.communicator import (
 from torchft_tpu.backends.host import HostCommunicator
 from torchft_tpu.backends.mesh import MeshCommunicator, MeshWorld
 from torchft_tpu.data import (BatchIterator, DistributedSampler,
-                              ElasticBatchIterator, ElasticSampler)
+                              ElasticBatchIterator, ElasticLoader,
+                              ElasticSampler)
 from torchft_tpu.local_sgd import (DiLoCoTrainer, StreamingDiLoCoTrainer,
                                    diloco_outer_optimizer)
 from torchft_tpu.manager import Manager, WorldSizeMode
@@ -43,6 +44,7 @@ __all__ = [
     "StreamingDiLoCoTrainer",
     "DistributedSampler",
     "ElasticBatchIterator",
+    "ElasticLoader",
     "ElasticSampler",
     "diloco_outer_optimizer",
     "DummyCommunicator",

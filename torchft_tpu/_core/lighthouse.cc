@@ -1,3 +1,4 @@
+#include <ctime>
 #include "lighthouse.h"
 
 #include <algorithm>
@@ -10,7 +11,11 @@ namespace torchft_tpu {
 Lighthouse::Lighthouse(const LighthouseOpt& opt) : opt_(opt) {
   // Boot-time id seed: a replacement lighthouse must mint ids strictly
   // above any previous incarnation's (see lighthouse.h quorum_id_).
-  quorum_id_ = (now_ms() / 1000) << 8;
+  // WALL clock, not now_ms(): now_ms() is steady_clock (arbitrary epoch,
+  // usually host uptime), so a replacement on a freshly-booted or
+  // different machine could seed BELOW the dead incarnation and replay
+  // its ids — the exact collision this seed exists to prevent.
+  quorum_id_ = static_cast<int64_t>(::time(nullptr)) << 8;
   server_ = std::make_unique<RpcServer>(
       opt.bind,
       [this](uint8_t m, const std::string& req, std::string* resp,

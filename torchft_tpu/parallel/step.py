@@ -217,7 +217,8 @@ class FTTrainer:
         # trainer this early join contains the entire heal fetch, the
         # dominant recovery component, which must not be mislabeled as
         # loop glue.
-        pre_wait = 0.0
+        pre_wait = 0.0      # quorum/heal wall before the split dispatch
+        pre_dispatch = 0.0  # discarded speculative (fused) dispatch wall
         if self._predict_single is None:
             # First step: learn the shape before compiling anything.
             wq_t0 = time.perf_counter()
@@ -234,8 +235,8 @@ class FTTrainer:
                 self.params, self.model_state, self.opt_state, batch)
             t2 = time.perf_counter()
             self.manager.wait_quorum()
+            t3 = time.perf_counter()
             if self.manager.single_group_step():
-                t3 = time.perf_counter()
                 loss = self._strict_sync(loss)
                 committed = self.manager.should_commit()
                 if committed and not self.manager.is_healing():
@@ -251,7 +252,13 @@ class FTTrainer:
                     "total": t4 - t0}
                 return loss, committed
             # Misprediction (membership grew / healing): discard the
-            # speculative result and rerun the split path this step.
+            # speculative result and rerun the split path this step. Its
+            # dispatch and quorum-wait walls still belong to their named
+            # buckets — a reconfigure-heavy wait_quorum here can be
+            # seconds, and folding it into "other" would recreate the
+            # unattributed-bucket problem these timings exist to solve.
+            pre_dispatch += t2 - t1
+            pre_wait += t3 - t2
             self._predict_single = False
 
         t1 = time.perf_counter()
@@ -274,8 +281,10 @@ class FTTrainer:
         self.last_loss = loss
         t4 = time.perf_counter()
         self.last_step_timings = {
-            "dispatch": t2 - t1, "allreduce_wait": (t3 - t2) + pre_wait,
-            "commit": t4 - t3, "other": t1 - t0 - pre_wait,
+            "dispatch": (t2 - t1) + pre_dispatch,
+            "allreduce_wait": (t3 - t2) + pre_wait,
+            "commit": t4 - t3,
+            "other": t1 - t0 - pre_wait - pre_dispatch,
             "total": t4 - t0}
         return loss, committed
 
