@@ -823,6 +823,23 @@ def main() -> None:
            "ms_per_fwd_bwd": round(lc["ms_per_fwd_bwd"], 2),
            "achieved_tflops": round(lc["achieved_tflops"], 2)})
 
+    # BASELINE config 3 feasibility: per-chip HBM for the Llama-2 7B HSDP
+    # step, from XLA's own buffer assignment AOT-compiled against a real
+    # v5e:4x4 topology (scripts/llama7b_memory.py — minutes of TPU-target
+    # compile, so the bench replays the committed result, flagged
+    # aot_cached; the analysis is topology-deterministic, not a rig
+    # measurement. Re-run the script after model/sharding/jaxlib changes.)
+    try:
+        import pathlib
+        cache = pathlib.Path(__file__).parent / "docs" \
+            / "llama7b_memory.json"
+        mem = json.loads(cache.read_text())
+        mem["aot_cached"] = True
+        _emit(mem)
+    except Exception as e:  # noqa: BLE001
+        _emit({"metric": "llama7b_hsdp_hbm_gb_per_chip", "value": -1.0,
+               "error": f"no cached AOT analysis: {e}"})
+
     rec = bench_recovery()
     _emit({"metric": "recovery_wall_clock_s",
            "value": round(rec.get("recovery_wall_clock_s", -1.0), 3),

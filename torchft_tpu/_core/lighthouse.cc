@@ -15,7 +15,14 @@ Lighthouse::Lighthouse(const LighthouseOpt& opt) : opt_(opt) {
   // usually host uptime), so a replacement on a freshly-booted or
   // different machine could seed BELOW the dead incarnation and replay
   // its ids — the exact collision this seed exists to prevent.
-  quorum_id_ = static_cast<int64_t>(::time(nullptr)) << 8;
+  // MILLISECOND granularity: a supervisor (systemd Restart=always) can
+  // respawn within the same second; ms<<8 still leaves 256 membership
+  // changes per ms of incarnation overlap, far beyond any real churn.
+  quorum_id_ =
+      std::chrono::duration_cast<std::chrono::milliseconds>(
+          std::chrono::system_clock::now().time_since_epoch())
+          .count()
+      << 8;
   server_ = std::make_unique<RpcServer>(
       opt.bind,
       [this](uint8_t m, const std::string& req, std::string* resp,

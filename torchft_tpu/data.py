@@ -477,6 +477,7 @@ class ElasticLoader:
         self._inflight: set = set()
         self._req: "queue.Queue[Optional[int]]" = queue.Queue()
         self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
         self.prefetch_hits = 0
         self.prefetch_misses = 0
 
@@ -490,7 +491,10 @@ class ElasticLoader:
     def _prefetch_loop(self) -> None:
         while True:
             slot = self._req.get()
-            if slot is None:
+            # Stop flag checked before every storage read: shutdown must
+            # not wait behind a queue of full synchronous dataset reads
+            # (cf. StatefulLoader._halt's contract).
+            if slot is None or self._stop.is_set():
                 return
             try:
                 batch = self.dataset[self.sampler.indices_for_slot(slot)]
@@ -535,8 +539,18 @@ class ElasticLoader:
 
     def shutdown(self) -> None:
         if self._thread is not None:
+            self._stop.set()
             self._req.put(None)
             self._thread.join(timeout=5)
+            if self._thread.is_alive():
+                # A zombie reader would keep touching the dataset (and
+                # the cache) after the caller tears the corpus down —
+                # refuse to pretend it stopped (same contract as
+                # StatefulLoader._halt).
+                raise RuntimeError(
+                    "ElasticLoader: prefetch thread did not stop within "
+                    "5s (storage read wedged?); retry shutdown once the "
+                    "read completes")
             self._thread = None
 
 

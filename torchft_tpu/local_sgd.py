@@ -204,14 +204,40 @@ class StreamingDiLoCoTrainer(DiLoCoTrainer):
     quorum/allreduce/commit round, aborted rounds retry the same fragment,
     and healing restores the complete state at round granularity.
 
-    **When it pays (measured):** streaming runs ``fragments``-times more
-    control rounds per window, each with the full fixed cost (quorum RPC,
-    device→host dispatch, ring rendezvous), to move 1/K of the bytes per
-    round under 1/K of the compute. It wins when transfer bytes and inner
-    compute dominate that fixed cost — big models on real DCN between pod
-    slices. On a fixed-cost-dominated link it strictly loses (on this
-    project's tunneled single-chip bench rig: 0.16x the plain DiLoCo inner
-    rate at hidden=512/K=4 — use :class:`DiLoCoTrainer` there).
+    **When it pays (measured + modeled):** streaming runs
+    ``fragments``-times more control rounds per window, each with the full
+    fixed cost (quorum RPC, device→host dispatch, ring rendezvous), to
+    move 1/K of the bytes per round under 1/K of the compute. Per sync
+    window of H inner steps each taking t_step, with model bytes M, DCN
+    bandwidth B, and fixed per-round cost c:
+
+        plain window     = H*t_step + c + M/B        (one stalling burst)
+        streaming window = K * max(H/K * t_step,     (transfer hidden
+                                   c + (M/K)/B)       under compute)
+
+    Streaming wins iff the per-fragment exchange fits under its compute
+    slice: ``c + M/(K*B) < (H/K) * t_step`` — then the window costs
+    H*t_step flat and the speedup approaches ``1 + (c + M/B)/(H*t_step)``.
+    Worked example (the design center): 7B f32 deltas M=27 GB over
+    B=25 GB/s inter-slice DCN, c=50 ms, H=64, t_step=0.5 s, K=4: plain
+    window 32 + 1.13 s; streaming max(8, 0.05+0.27)=8 s per fragment x 4
+    = 32 s flat -> ~3.5% end-to-end win, growing with sync frequency
+    (H=16: 8+1.13 vs 8 -> +14%) and with slower DCN (B=5 GB/s, H=16:
+    8+5.45 vs 8 -> +68%). The break-even reads off the same two
+    expressions: streaming pays exactly when the plain window's stall
+    ``c + M/B`` exceeds the streaming window's excess
+    ``K*max(0, c + M/(K*B) - (H/K)*t_step)`` — in particular whenever
+    each fragment exchange hides fully under its compute slice, which is
+    the regime real DCN and real model sizes sit in.
+
+    On a fixed-cost-dominated link the model predicts a strict loss
+    (c >> (M/K)/B and c comparable to H/K*t_step), and that is what this
+    project's tunneled single-chip rig measures: 0.16x the plain DiLoCo
+    inner rate at hidden=512/K=4 (M=1.2 MB, c ~ 750 ms!). Use
+    :class:`DiLoCoTrainer` there; no environment this rig can host will
+    ever show streaming winning, which is why its tests pin the
+    schedule/consistency contract (tests/test_local_sgd.py) rather than
+    throughput.
     """
 
     def __init__(

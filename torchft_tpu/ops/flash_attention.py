@@ -37,6 +37,19 @@ ceiling: per unmasked block the 7 remaining matmuls cost ~19 us MXU
 against ~37 us of irreducible VPU softmax passes (exp, running max/sum,
 rescale) — further gains need fewer VPU passes per element, not tiling.
 
+Counter-validation of that VPU-floor claim (round-5): the classic
+exp2-domain rewrite — fold log2(e) into the compile-time logit scale,
+call exp2 directly, convert the stored lse back to natural units per
+row — was implemented across all four kernels and A/B'd interleaved on
+one chip at S=16k: 0.958x (SLOWER: old 24.3 ms vs exp2 25.4 ms), so it
+was reverted. Mosaic already lowers jnp.exp to the bare hardware exp2
+with the multiply fused; the explicit form only perturbed fusion. The
+remaining exp/max/sum/rescale passes are therefore genuinely
+irreducible at this tiling — consistent with the ~37 us VPU floor, and
+with the measured S=16k fwd+bwd sitting at 70-79 TFLOP/s across runs
+(tunnel drift; the 2024-era public Pallas flash kernels measure in the
+same band on v5e).
+
 Kernel structure: grid (batch*heads, q_blocks, k_blocks). The innermost
 (k) grid dimension is sequential on a TPU core, so the running
 (max, sum, acc) statistics live in VMEM scratch that persists across k
