@@ -483,6 +483,20 @@ class ElasticLoader:
 
     def _ensure_thread(self) -> None:
         if self._thread is None and self.prefetch > 0:
+            # Restart-after-shutdown: clear the stop flag, any inflight
+            # markers orphaned by the previous thread's exit (a stale
+            # marker would suppress that slot's prefetch forever), and
+            # the request queue — a leftover None sentinel would kill the
+            # fresh thread on its first get (cf. StatefulLoader's
+            # _stop.clear() on restart).
+            self._stop.clear()
+            with self._lock:
+                self._inflight.clear()
+            while True:
+                try:
+                    self._req.get_nowait()
+                except queue.Empty:
+                    break
             self._thread = threading.Thread(
                 target=self._prefetch_loop, daemon=True,
                 name="elastic-loader")

@@ -45,10 +45,21 @@ one chip at S=16k: 0.958x (SLOWER: old 24.3 ms vs exp2 25.4 ms), so it
 was reverted. Mosaic already lowers jnp.exp to the bare hardware exp2
 with the multiply fused; the explicit form only perturbed fusion. The
 remaining exp/max/sum/rescale passes are therefore genuinely
-irreducible at this tiling — consistent with the ~37 us VPU floor, and
-with the measured S=16k fwd+bwd sitting at 70-79 TFLOP/s across runs
-(tunnel drift; the 2024-era public Pallas flash kernels measure in the
-same band on v5e).
+irreducible at this tiling.
+
+Throughput, measured properly (round-5): naive wall-clock timing
+through the tunneled chip reported 65-79 TFLOP/s across identical-code
+runs because each timed call carries one drifting ~80-120 ms dispatch.
+bench.py's delta timing (32-iter scan minus 16-iter scan, adjacent
+pairs, median-of-3 — dispatch cancels exactly) puts the TRUE device
+time for the S=16k fwd+bwd at ~14.9-15.0 ms, repeatable to ±1%:
+**128-129 TFLOP/s, 65% of v5e bf16 peak**. Two corrections to the
+earlier analysis follow: (1) the "~37 us irreducible VPU vs ~19 us MXU
+per block" budget — itself calibrated on dispatch-inflated timings —
+overstated the VPU cost as if serial; the VPU and MXU run concurrently
+and at 65% MFU the un-overlapped VPU residue is ~10 us/block, not 37;
+(2) the historical 64-76 TFLOP/s BENCH numbers for this metric measured
+the tunnel as much as the kernel.
 
 Kernel structure: grid (batch*heads, q_blocks, k_blocks). The innermost
 (k) grid dimension is sequential on a TPU core, so the running
