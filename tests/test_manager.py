@@ -504,6 +504,68 @@ class TestNumerics:
             for o, e in zip(flat_out, flat_exp):
                 np.testing.assert_array_equal(np.asarray(o), e)
 
+    def test_zero_element_leaf_and_host_precision_under_wire(self):
+        """Two packing edge cases: (1) a 0-element leaf must contribute 0
+        to the packed payload geometry (an off-by-one would wedge the
+        ring / break the split); (2) host-native float leaves never cross
+        the D2H link, so wire compression must NOT quantize them — their
+        averaged values stay bitwise full-precision."""
+        import threading as _t
+
+        import jax.numpy as jnp
+
+        from torchft_tpu._native import Store
+        from torchft_tpu.backends.host import HostCommunicator
+
+        store = Store(bind="127.0.0.1:0")
+        world = 2
+        rng = np.random.default_rng(2)
+        host_leaf = rng.normal(size=(33,)).astype(np.float32)
+        tree = {
+            "empty": np.zeros((0, 5), np.float32),
+            "host": host_leaf,                      # numpy: stays exact
+            "dev": jnp.asarray(rng.normal(size=(40,)).astype(np.float32)),
+        }
+        results = [None] * world
+        errors = []
+
+        def run(rank):
+            client = MagicMock()
+            client.quorum.return_value = quorum_result(
+                store_address=store.address(),
+                max_rank=rank, max_world_size=world,
+                replica_rank=rank, replica_world_size=world)
+            client.should_commit.return_value = True
+            m = make_manager(
+                client, comm=HostCommunicator(timeout_sec=30),
+                allreduce_bucket_bytes=64,  # force multi-bucket
+                allreduce_wire_dtype=jnp.bfloat16)
+            try:
+                m.step()
+                scaled = jax.tree_util.tree_map(
+                    lambda a: a * (rank + 1), tree)
+                results[rank] = m.allreduce(scaled).result(timeout=30)
+                assert m.should_commit()
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+            finally:
+                m.shutdown()
+
+        threads = [_t.Thread(target=run, args=(r,)) for r in range(world)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        alive = [t for t in threads if t.is_alive()]
+        store.shutdown()
+        assert not alive, "packed allreduce deadlocked on empty leaf"
+        assert not errors, errors
+        for out in results:
+            assert out["empty"].shape == (0, 5)
+            # Host-native leaf: exact mean, no bf16 quantization anywhere.
+            np.testing.assert_array_equal(
+                np.asarray(out["host"]), host_leaf * 1.5)
+
     def test_bf16_wire_compression_close_to_exact(self):
         """allreduce_wire_dtype=bfloat16 quantizes each local contribution
         once; the sum/scale stay f32, so the result tracks the exact mean

@@ -587,20 +587,26 @@ class Manager:
         split into ~``allreduce_bucket_bytes`` buckets and each bucket flows
         through a three-stage pipeline on three threads —
 
-            caller thread:    device_get(bucket i+1)        (D2H)
+            caller thread:    pack + device_get(bucket i+1) (D2H)
             comm worker:      ring allreduce of bucket i    (DCN/TCP)
             put thread:       1/n scale + device_put of i-1 (H2D)
 
         so wire transfer, device fetch, and device restore all overlap
-        instead of running back-to-back. Results are bitwise identical
-        across ranks (every rank runs the same bucket schedule and ring
-        order). At world_size 2 they are also bitwise identical to the
+        instead of running back-to-back. Each bucket's leaves are PACKED
+        on device into one contiguous buffer per dtype before the fetch:
+        separate transfers pay a full dispatch round trip each on
+        latency-bound links, and the per-leaf fetch measured ~8x the
+        packed cost on this project's tunnel rig (770ms of an 880ms
+        allreduce for an 8-leaf 1.2MB bucket). Results are bitwise
+        identical across ranks (every rank derives the same
+        metadata-deterministic bucket + chunk schedule and ring order).
+        At world_size 2 they are also bitwise identical to the
         single-shot path (two-term sums are order-insensitive; asserted by
         tests/test_manager.py::TestNumerics::test_bucketed_matches_single);
-        at world_size >= 3 ring chunk boundaries shift with bucketing, so
-        per-element accumulation *order* can differ from the single-shot
-        path by last-ulp rounding — the same reorder tolerance any ring
-        collective already implies across world sizes.
+        at world_size >= 3 ring chunk boundaries shift with bucketing and
+        packing, so per-element accumulation *order* can differ from the
+        single-shot path by last-ulp rounding — the same reorder
+        tolerance any ring collective already implies across world sizes.
 
         The ``allreduce_ms_total`` metric for this path spans the whole
         exchange — device fetch, ring, scale, and device restore — i.e.
@@ -621,33 +627,58 @@ class Manager:
         # reference lacks entirely (round-3 verdict weak #3).
         wire = self._wire_dtype
 
-        def compressible(leaf: Any) -> bool:
-            return (wire is not None and isinstance(leaf, jax.Array)
-                    and jnp.issubdtype(leaf.dtype, jnp.floating)
-                    and np.dtype(leaf.dtype).itemsize > wire.itemsize)
-
-        fetch = leaves
-        if participating and wire is not None:
-            cidx = [i for i, leaf in enumerate(leaves) if compressible(leaf)]
-            if cidx:
-                compressed = _compress_leaves(
-                    [leaves[i] for i in cidx], str(wire))
-                fetch = list(leaves)
-                for i, c in zip(cidx, compressed):
-                    fetch[i] = c
-
-        # Bucket by *wire* bytes — compressed sizes for compressible leaves
-        # — so each bucket actually moves ~bucket_bytes over the D2H leg it
-        # exists to amortize. Sizes come from leaf METADATA (not from
-        # `fetch`, which healing/spare ranks leave uncompressed): every rank
-        # must derive the identical bucket schedule or the ring wedges on
-        # mismatched payload boundaries.
-        def wire_nbytes(leaf: Any) -> int:
-            dt = np.dtype(wire) if compressible(leaf) else np.dtype(
+        # (orig_dtype, wire_dtype) per leaf, from METADATA only: every
+        # rank — participant, healer, spare — must derive the identical
+        # chunking and bucket schedule below or the ring wedges on
+        # mismatched payload boundaries. Wire compression
+        # (allreduce_wire_dtype) shows up here as a narrower wire dtype
+        # for wide float leaves.
+        def leaf_dtypes(leaf: Any) -> tuple:
+            orig = np.dtype(
                 getattr(leaf, "dtype", None) or np.asarray(leaf).dtype)
-            return int(np.prod(np.shape(leaf)) or 1) * dt.itemsize
+            if (wire is not None and np.issubdtype(orig, np.floating)
+                    and orig.itemsize > wire.itemsize):
+                return orig, np.dtype(wire)
+            return orig, orig
+
+        # Bucket by *wire* bytes — compressed sizes for compressible
+        # leaves — so each bucket actually moves ~bucket_bytes over the
+        # D2H leg it exists to amortize.
+        def wire_nbytes(leaf: Any) -> int:
+            return (int(np.prod(np.shape(leaf)) or 1)
+                    * leaf_dtypes(leaf)[1].itemsize)
         buckets = _make_buckets(
             [wire_nbytes(leaf) for leaf in leaves], self._bucket_bytes)
+
+        # Within a bucket, leaves are PACKED into one contiguous buffer
+        # per (orig, wire) dtype pair before the device->host fetch: on a
+        # tunnel/PCIe-attached host every separate transfer pays the
+        # dispatch round trip (~95ms through this rig's tunnel), so
+        # fetching an 8-leaf bucket leaf-by-leaf costs ~8 round trips of
+        # latency against ONE for the packed buffer — measured as the
+        # dominant term of the host allreduce (fetch 770ms of 880ms at
+        # 1.2MB). The ring then also moves one buffer per chunk instead
+        # of one per leaf. Chunking is metadata-deterministic (dtype
+        # pairs by first occurrence), so every rank's payload matches.
+        def chunk_bucket(idx: list) -> list:
+            by_key: Dict[tuple, dict] = {}
+            chunks: list = []
+            for i in idx:
+                orig, wdt = leaf_dtypes(leaves[i])
+                key = (str(orig), str(wdt))
+                c = by_key.get(key)
+                if c is None:
+                    c = by_key[key] = {
+                        "orig": orig, "wire": wdt, "idx": [], "sizes": []}
+                    chunks.append(c)
+                c["idx"].append(i)
+                # TRUE element count — 0-element leaves must contribute 0
+                # to the split/payload geometry (an `or 1` here would make
+                # participants' packed buffers one element longer than
+                # their sizes sum and wedge the ring; the `or 1` in
+                # wire_nbytes is only advisory bucket sizing).
+                c["sizes"].append(int(np.prod(np.shape(leaves[i]))))
+            return chunks
         agg: Future = Future()
         out_leaves: list = [None] * len(leaves)
         lock = threading.Lock()
@@ -665,11 +696,18 @@ class Manager:
             except BaseException:  # already settled by another thread
                 pass
 
-        def finish_bucket(idx: list, reduced: list) -> None:
+        def finish_bucket(chunks: list, reduced: list) -> None:
             try:
                 put_t0 = time.perf_counter()
-                scaled = {i: div_by_count(a, n)
-                          for i, a in zip(idx, reduced)}
+                # Unpack each reduced chunk buffer back into leaves:
+                # scale once per chunk, split by the recorded sizes.
+                idx = [i for c in chunks for i in c["idx"]]
+                scaled: Dict[int, Any] = {}
+                for c, arr in zip(chunks, reduced):
+                    arr = div_by_count(np.asarray(arr), n)
+                    parts = np.split(arr, np.cumsum(c["sizes"])[:-1])
+                    for i, part in zip(c["idx"], parts):
+                        scaled[i] = part.reshape(np.shape(leaves[i]))
                 put_idx = [i for i in idx
                            if isinstance(leaves[i], jax.Array)]
                 if put_idx:
@@ -705,7 +743,8 @@ class Manager:
             except Exception as e:  # noqa: BLE001
                 settle_exception(e)
 
-        def on_bucket(idx: list, submit_t: float) -> Callable[[Future], None]:
+        def on_bucket(chunks: list, submit_t: float
+                      ) -> Callable[[Future], None]:
             def cb(f: Future) -> None:
                 # Ring wall = submit -> completion; includes comm-worker
                 # queue wait, i.e. the serialization cost of the single
@@ -719,25 +758,66 @@ class Manager:
                 if not agg.done():
                     try:
                         self._put_executor.submit(
-                            finish_bucket, idx, f.result())
+                            finish_bucket, chunks, f.result())
                     except Exception as e2:  # executor shut down mid-step
                         settle_exception(e2)
             return cb
 
-        # Stage 1, on the caller thread: fetch bucket i+1 while the comm
-        # worker rings bucket i (ops run in submission order there, and in
-        # the same deterministic leaf order on every rank).
+        # Stage 1, on the caller thread: pack + fetch bucket i+1 while the
+        # comm worker rings bucket i (ops run in submission order there,
+        # and in the same deterministic chunk order on every rank). The
+        # ring payload per bucket is one UPCAST (original-dtype) buffer
+        # per chunk, so summation and 1/n stay full precision; wire
+        # compression costs exactly one narrow-dtype quantization of each
+        # local contribution during the on-device pack.
         for idx in buckets:
+            chunks = chunk_bucket(idx)
             if participating:
                 fetch_t0 = time.perf_counter()
-                got = jax.device_get([fetch[i] for i in idx])
-                host = []
-                for i, a in zip(idx, got):
-                    a = np.asarray(a)
-                    orig = np.dtype(getattr(leaves[i], "dtype", a.dtype))
-                    if a.dtype != orig:  # upcast compressed wire leaves
-                        a = a.astype(orig)
-                    host.append(a)
+                dev_packed = []   # (chunk_pos, packed device array)
+                mixed = []        # (chunk_pos, leaves) — any host leaf
+                host = [None] * len(chunks)
+                for ci, c in enumerate(chunks):
+                    ls = [leaves[i] for i in c["idx"]]
+                    if all(isinstance(x, jax.Array) for x in ls):
+                        dev_packed.append(
+                            (ci, _pack_leaves(ls, str(c["wire"]))))
+                    else:
+                        mixed.append((ci, ls))
+                if dev_packed:
+                    got = jax.device_get([a for _, a in dev_packed])
+                    for (ci, _), a in zip(dev_packed, got):
+                        host[ci] = np.asarray(a)
+                if mixed:
+                    # Chunks containing host-native leaves: stray device
+                    # leaves still fetch in ONE batched device_get (the
+                    # per-leaf round trips packing exists to avoid), and
+                    # nothing is wire-quantized — these bytes never cross
+                    # the D2H link, so narrowing them would discard
+                    # precision for zero transfer benefit. Chunk geometry
+                    # (metadata-only) is identical across ranks either
+                    # way.
+                    flat = [(ci, j, x) for ci, ls in mixed
+                            for j, x in enumerate(ls)
+                            if isinstance(x, jax.Array)]
+                    fetched = jax.device_get(
+                        [x for _, _, x in flat]) if flat else []
+                    lookup = {(ci, j): np.asarray(a)
+                              for (ci, j, _), a in zip(flat, fetched)}
+                    for ci, ls in mixed:
+                        orig = chunks[ci]["orig"]
+                        parts = []
+                        for j, x in enumerate(ls):
+                            a = (lookup[(ci, j)]
+                                 if isinstance(x, jax.Array)
+                                 else np.asarray(x))
+                            parts.append(
+                                np.ravel(a).astype(orig, copy=False))
+                        host[ci] = (np.concatenate(parts) if parts
+                                    else np.zeros(0, orig))
+                for ci, c in enumerate(chunks):
+                    if host[ci].dtype != c["orig"]:  # upcast wire chunks
+                        host[ci] = host[ci].astype(c["orig"])
                 self._record(
                     allreduce_fetch_ms_total=(
                         time.perf_counter() - fetch_t0) * 1e3,
@@ -745,9 +825,10 @@ class Manager:
                         sum(wire_nbytes(leaves[i]) for i in idx)),
                 )
             else:
-                host = [_zero_like(leaves[i]) for i in idx]
+                host = [np.zeros(sum(c["sizes"]), c["orig"])
+                        for c in chunks]
             self._comm.allreduce(host, op="sum").add_done_callback(
-                on_bucket(idx, time.perf_counter()))
+                on_bucket(chunks, time.perf_counter()))
 
         return self.wrap_future(agg, default=tree)
 
@@ -997,15 +1078,26 @@ class Manager:
             self._store_server.shutdown()
 
 
-from functools import partial  # noqa: E402  (placed near its sole user)
+_PACK_FNS: Dict[str, Any] = {}
 
 
-@partial(jax.jit, static_argnums=1)
-def _compress_leaves(leaves: list, wire_dtype_str: str) -> list:
-    """Cast a list of device arrays down to the wire dtype in one fused
-    dispatch (per-leaf eager casts would pay a dispatch round trip each)."""
-    wire = np.dtype(wire_dtype_str)
-    return [leaf.astype(wire) for leaf in leaves]
+def _pack_leaves(leaves: list, wire_dtype_str: str) -> Any:
+    """Pack device leaves into ONE contiguous 1-D device array in the
+    wire dtype, via a cached jitted concat — so the subsequent
+    ``device_get`` pays a single transfer round trip for the whole chunk
+    instead of one per leaf (the dominant host-allreduce cost on
+    latency-bound links), and wire compression is fused into the same
+    dispatch."""
+    fn = _PACK_FNS.get(wire_dtype_str)
+    if fn is None:
+        wire = jnp.dtype(wire_dtype_str)
+
+        def pack(ls):
+            return jnp.concatenate(
+                [jnp.ravel(x).astype(wire) for x in ls])
+
+        fn = _PACK_FNS[wire_dtype_str] = jax.jit(pack)
+    return fn(leaves)
 
 
 def _zero_like(leaf: Any) -> np.ndarray:
