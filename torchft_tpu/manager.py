@@ -789,21 +789,32 @@ class Manager:
                     for (ci, _), a in zip(dev_packed, got):
                         host[ci] = np.asarray(a)
                 if mixed:
-                    # Chunks containing host-native leaves: stray device
-                    # leaves still fetch in ONE batched device_get (the
-                    # per-leaf round trips packing exists to avoid), and
-                    # nothing is wire-quantized — these bytes never cross
-                    # the D2H link, so narrowing them would discard
-                    # precision for zero transfer benefit. Chunk geometry
-                    # (metadata-only) is identical across ranks either
-                    # way.
-                    flat = [(ci, j, x) for ci, ls in mixed
-                            for j, x in enumerate(ls)
-                            if isinstance(x, jax.Array)]
+                    # Chunks containing host-native leaves: the DEVICE
+                    # subset still packs (wire cast included) and all
+                    # mixed chunks' packs fetch in ONE batched
+                    # device_get — only the host-native leaves skip the
+                    # link (they are already here; quantizing them would
+                    # discard precision for zero transfer benefit).
+                    # Chunk geometry (metadata-only) is identical across
+                    # ranks either way; the pack/merge below is a
+                    # rank-local detail.
+                    packs = []  # (ci, [(pos_in_ls, leaf), ...], packed)
+                    for ci, ls in mixed:
+                        dev = [(j, x) for j, x in enumerate(ls)
+                               if isinstance(x, jax.Array)]
+                        if dev:
+                            packs.append((ci, dev, _pack_leaves(
+                                [x for _, x in dev],
+                                str(chunks[ci]["wire"]))))
                     fetched = jax.device_get(
-                        [x for _, _, x in flat]) if flat else []
-                    lookup = {(ci, j): np.asarray(a)
-                              for (ci, j, _), a in zip(flat, fetched)}
+                        [p for _, _, p in packs]) if packs else []
+                    lookup: Dict[tuple, np.ndarray] = {}
+                    for (ci, dev, _), buf in zip(packs, fetched):
+                        buf = np.asarray(buf)
+                        sizes = [int(np.prod(np.shape(x))) for _, x in dev]
+                        for (j, _), part in zip(
+                                dev, np.split(buf, np.cumsum(sizes)[:-1])):
+                            lookup[(ci, j)] = part
                     for ci, ls in mixed:
                         orig = chunks[ci]["orig"]
                         parts = []
@@ -821,8 +832,12 @@ class Manager:
                 self._record(
                     allreduce_fetch_ms_total=(
                         time.perf_counter() - fetch_t0) * 1e3,
+                    # Bytes that actually crossed D2H: host-native leaves
+                    # never do (rank-local accounting; no cross-rank
+                    # constraint rides on this metric).
                     allreduce_wire_bytes_total=float(
-                        sum(wire_nbytes(leaves[i]) for i in idx)),
+                        sum(wire_nbytes(leaves[i]) for i in idx
+                            if isinstance(leaves[i], jax.Array))),
                 )
             else:
                 host = [np.zeros(sum(c["sizes"]), c["orig"])
