@@ -43,7 +43,8 @@ import optax  # noqa: E402
 from jax.sharding import NamedSharding  # noqa: E402
 
 from torchft_tpu import HostCommunicator, Manager  # noqa: E402
-from torchft_tpu.data import (DistributedSampler, StatefulLoader,  # noqa: E402
+from torchft_tpu.data import (DistributedSampler, ElasticLoader,  # noqa: E402
+                              ElasticSampler, StatefulLoader,
                               TokenFileDataset)
 from torchft_tpu.models import (Transformer, TransformerConfig,  # noqa: E402
                                 chunked_causal_lm_loss, tiny_config,
@@ -108,14 +109,24 @@ def main() -> None:
                 .astype(np.uint16 if cfg.vocab_size <= 65536 else np.int32))
             os.replace(tmp, tokens_file)
     dataset = TokenFileDataset(tokens_file, seq_len=seq_len)
-    sampler = DistributedSampler(
-        dataset_size=len(dataset),
-        replica_group=replica_group,
-        num_replica_groups=num_groups,
-        batch_size=batch_size,
-        seed=0,
-    )
-    batches = StatefulLoader(dataset, sampler, prefetch=2)
+    # ELASTIC_DATA=1 swaps the static 2D sampler for the quorum-following
+    # elastic stream (ElasticSampler + ElasticLoader): slots re-partition
+    # with membership instead of losing a dead group's shard, prefetch is
+    # keyed on the commit-predicted next slots, and exact resume is FREE —
+    # the stream position IS manager.batches_committed(), which already
+    # rides the manager checkpoint state, so no loader state is saved.
+    elastic = os.environ.get("ELASTIC_DATA") == "1"
+    if elastic:
+        batches = None  # built after the trainer (the sampler needs its manager)
+    else:
+        sampler = DistributedSampler(
+            dataset_size=len(dataset),
+            replica_group=replica_group,
+            num_replica_groups=num_groups,
+            batch_size=batch_size,
+            seed=0,
+        )
+        batches = StatefulLoader(dataset, sampler, prefetch=2)
 
     def loss_fn(params, batch):
         # Chunked loss: the [B, S, vocab] logits tensor (LM training's
@@ -146,6 +157,11 @@ def main() -> None:
         ),
     )
     m = trainer.manager
+    if elastic:
+        batches = ElasticLoader(
+            dataset,
+            ElasticSampler(len(dataset), m, batch_size=batch_size, seed=0),
+            prefetch=2)
     logger.info("replica group %d/%d up (%s)", replica_group, num_groups,
                 m.replica_id())
 
@@ -155,17 +171,24 @@ def main() -> None:
     # death; this covers whole-job restarts.
     ckpt_dir = os.environ.get("CHECKPOINT_DIR")
     ckpt_every = int(os.environ.get("CHECKPOINT_EVERY", 10))
+    # The saved tree's structure differs by data mode (elastic saves no
+    # loader state), and checkpoint_io.load matches structure strictly —
+    # partition the directory by mode so toggling ELASTIC_DATA against an
+    # existing CHECKPOINT_DIR starts a fresh lineage instead of crashing
+    # resume on a shape mismatch.
+    ckpt_name = f"{replica_group}-elastic" if elastic else str(replica_group)
     if ckpt_dir:
         from torchft_tpu import checkpoint_io
 
-        path = checkpoint_io.latest(os.path.join(ckpt_dir,
-                                                 str(replica_group)))
+        path = checkpoint_io.latest(os.path.join(ckpt_dir, ckpt_name))
         if path:
-            user, mgr_state = checkpoint_io.load(
-                path, target={"trainer": trainer.state_dict(),
-                              "loader": batches.state_dict()})
+            target = {"trainer": trainer.state_dict()}
+            if not elastic:
+                target["loader"] = batches.state_dict()
+            user, mgr_state = checkpoint_io.load(path, target=target)
             trainer.load_state_dict(user["trainer"])
-            batches.load_state_dict(user["loader"])
+            if not elastic:  # elastic resume = batches_committed (mgr state)
+                batches.load_state_dict(user["loader"])
             m.load_state_dict(mgr_state)
             logger.info("resumed from %s at step %d", path,
                         m.current_step())
@@ -181,15 +204,19 @@ def main() -> None:
 
     t0 = time.perf_counter()
     while m.current_step() < total_steps:
-        batch = next(batches)
+        # Elastic mode hands the loader ITSELF to train_step (a zero-arg
+        # callable): the draw then happens after manager.step(), reading
+        # the step's true slot.
+        batch = batches if elastic else next(batches)
         loss, committed = trainer.train_step(batch)
         step = m.current_step()
         if ckpt_writer is not None and committed and step % ckpt_every == 0:
+            user = {"trainer": trainer.state_dict()}
+            if not elastic:
+                user["loader"] = batches.state_dict()
             ckpt_writer.save_async(
-                os.path.join(ckpt_dir, str(replica_group), f"ckpt_{step}"),
-                {"trainer": trainer.state_dict(),
-                 "loader": batches.state_dict()},
-                m.state_dict())
+                os.path.join(ckpt_dir, ckpt_name, f"ckpt_{step}"),
+                user, m.state_dict())
         if step % 10 == 0:
             dt = time.perf_counter() - t0
             logger.info(
