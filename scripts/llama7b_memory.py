@@ -29,7 +29,6 @@ changes.
 from __future__ import annotations
 
 import json
-import os
 import pathlib
 import sys
 import time
