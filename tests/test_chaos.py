@@ -1,0 +1,616 @@
+"""Tests for the ChaosNet fault injector (:mod:`torchft_tpu.chaos`):
+schedule determinism, the ``TORCHFT_CHAOS`` spec grammar, socket/stream/
+communicator wrappers, the chaos-hardened heal fetch — and the seeded
+multi-group chaos soak (``slow``/``nightly``) asserting zero lost or
+duplicated commits while every transport is being disrupted."""
+
+import socket
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+import pytest
+
+from torchft_tpu import chaos
+from torchft_tpu.chaos import (ChaosCommunicator, ChaosSchedule, Decision,
+                               EndpointChaos, parse_spec)
+from torchft_tpu.communicator import CommunicatorError, DummyCommunicator
+from torchft_tpu.retry import RetryPolicy, RetryStats
+
+
+import conftest
+
+requires_native = conftest.requires_native()
+
+
+class TestSchedule:
+    def test_same_seed_same_trace(self):
+        eps = {"ring": EndpointChaos(reset_rate=0.3, short_rate=0.2,
+                                     latency_ms=1, jitter_ms=2)}
+        a, b = ChaosSchedule(seed=9, endpoints=eps), \
+            ChaosSchedule(seed=9, endpoints=eps)
+        da = [a.decide("ring", "send") for _ in range(100)]
+        db = [b.decide("ring", "send") for _ in range(100)]
+        assert da == db
+        assert any(d.fault for d in da)  # at these rates faults fired
+
+    def test_different_seed_different_trace(self):
+        eps = {"ring": EndpointChaos(reset_rate=0.3, jitter_ms=5)}
+        a = [ChaosSchedule(seed=1, endpoints=eps).decide("ring", "send")
+             for _ in range(50)]
+        b = [ChaosSchedule(seed=2, endpoints=eps).decide("ring", "send")
+             for _ in range(50)]
+        assert a != b
+
+    def test_channels_are_independent_streams(self):
+        """Decision n of a channel is a pure function of (seed, channel,
+        n): interleaving another channel's draws must not perturb it —
+        the property that makes multi-threaded traces replayable."""
+        eps = {"ring": EndpointChaos(reset_rate=0.3),
+               "store": EndpointChaos(reset_rate=0.3)}
+        solo = ChaosSchedule(seed=5, endpoints=eps)
+        ring_solo = [solo.decide("ring", "send") for _ in range(40)]
+        mixed = ChaosSchedule(seed=5, endpoints=eps)
+        ring_mixed = []
+        for i in range(40):
+            mixed.decide("store", "get")  # interleaved foreign draws
+            ring_mixed.append(mixed.decide("ring", "send"))
+        assert ring_solo == ring_mixed
+
+    def test_endpoint_fallback(self):
+        s = ChaosSchedule(seed=0, endpoints={
+            "ring": EndpointChaos(latency_ms=5),
+            "*": EndpointChaos(latency_ms=1)})
+        assert s.config_for("ring:3").latency_ms == 5
+        assert s.config_for("store").latency_ms == 1
+        s2 = ChaosSchedule(seed=0, endpoints={"ring": EndpointChaos()})
+        assert s2.config_for("heal") is None
+        assert s2.decide("heal", "fetch") is None
+
+    def test_max_faults_cap(self):
+        s = ChaosSchedule(seed=3, endpoints={
+            "ring": EndpointChaos(reset_rate=1.0, max_faults=2)})
+        faults = [s.decide("ring", "send").fault for _ in range(10)]
+        assert faults[:2] == ["reset", "reset"]
+        assert all(f is None for f in faults[2:])
+
+    def test_trace_replay_reproduces(self):
+        """The acceptance property: replaying a recorded per-channel op
+        sequence through a fresh schedule with the same seed reproduces
+        the identical injection trace."""
+        eps = {"ring": EndpointChaos(reset_rate=0.2, short_rate=0.1,
+                                     jitter_ms=3),
+               "store": EndpointChaos(reset_rate=0.3)}
+        s = ChaosSchedule(seed=11, endpoints=eps)
+        for i in range(30):
+            s.decide("ring", "send" if i % 2 else "recv")
+            if i % 3 == 0:
+                s.decide("store", "get")
+        trace = s.trace()
+        replay = ChaosSchedule(seed=11, endpoints=eps)
+        for d in trace:
+            replay.decide(d.endpoint, d.op)
+        assert replay.trace() == trace
+
+
+class TestSpecGrammar:
+    def test_full_spec(self):
+        s = parse_spec("seed=42;ring:reset_rate=0.02,latency_ms=5;"
+                       "store:blackhole_rate=0.01,blackhole_ms=100;"
+                       "*:jitter_ms=2;manager:max_faults=7")
+        assert s.seed == 42
+        assert s.endpoints["ring"].reset_rate == 0.02
+        assert s.endpoints["ring"].latency_ms == 5
+        assert s.endpoints["store"].blackhole_ms == 100
+        assert s.endpoints["*"].jitter_ms == 2
+        assert s.endpoints["manager"].max_faults == 7
+
+    def test_empty_clauses_tolerated(self):
+        s = parse_spec("seed=1;;ring:latency_ms=1;")
+        assert s.seed == 1 and "ring" in s.endpoints
+
+    @pytest.mark.parametrize("bad", [
+        "ring",                       # no colon
+        "ring:bogus_field=1",         # unknown field
+        "ring:latency_ms",            # no value
+    ])
+    def test_bad_specs_raise(self, bad):
+        with pytest.raises(ValueError):
+            parse_spec(bad)
+
+    def test_env_activation(self, monkeypatch):
+        chaos.reset()  # re-arm env parsing (uninstall is sticky)
+        monkeypatch.setenv("TORCHFT_CHAOS", "seed=5;ring:latency_ms=1")
+        try:
+            s = chaos.active()
+            assert s is not None and s.seed == 5
+            # parsed once, then cached
+            assert chaos.active() is s
+            # uninstall is STICKY against the env: the spec must NOT
+            # silently re-arm on the next transport op (drain boundary).
+            chaos.uninstall()
+            assert chaos.active() is None
+        finally:
+            chaos.reset()
+
+    def test_inactive_is_none(self, monkeypatch):
+        chaos.reset()
+        monkeypatch.delenv("TORCHFT_CHAOS", raising=False)
+        try:
+            assert chaos.active() is None
+            sock = socket.socket()
+            try:
+                assert chaos.wrap_socket(sock, "ring") is sock
+            finally:
+                sock.close()
+        finally:
+            chaos.uninstall()
+
+
+def _socketpair_with_chaos(schedule):
+    a, b = socket.socketpair()
+    a.settimeout(5)
+    b.settimeout(5)
+    return chaos.wrap_socket(a, "ring", schedule), b
+
+
+class TestChaosSocket:
+    def test_passthrough_when_clean(self):
+        s = ChaosSchedule(seed=0, endpoints={"ring": EndpointChaos()})
+        wrapped, peer = _socketpair_with_chaos(s)
+        try:
+            wrapped.sendall(b"hello")
+            assert peer.recv(5) == b"hello"
+            peer.sendall(b"world")
+            buf = bytearray(5)
+            assert wrapped.recv_into(memoryview(buf)) == 5
+            assert bytes(buf) == b"world"
+        finally:
+            wrapped.close()
+            peer.close()
+
+    def test_reset_closes_both_ways(self):
+        s = ChaosSchedule(seed=0, endpoints={
+            "ring": EndpointChaos(reset_rate=1.0, max_faults=1)})
+        wrapped, peer = _socketpair_with_chaos(s)
+        try:
+            with pytest.raises(ConnectionResetError, match="chaos"):
+                wrapped.sendall(b"data")
+            # the real socket was aborted, so the peer observes EOF/reset
+            assert peer.recv(4) == b""
+        finally:
+            peer.close()
+
+    def test_short_write_transfers_partial_then_resets(self):
+        s = ChaosSchedule(seed=0, endpoints={
+            "ring": EndpointChaos(short_rate=1.0, max_faults=1)})
+        wrapped, peer = _socketpair_with_chaos(s)
+        try:
+            payload = b"x" * 1000
+            with pytest.raises(ConnectionResetError, match="short write"):
+                wrapped.sendall(payload)
+            got = b""
+            while True:
+                part = peer.recv(4096)
+                if not part:
+                    break
+                got += part
+            assert 0 < len(got) < len(payload)  # genuinely partial
+        finally:
+            peer.close()
+
+    def test_short_read_raises_after_partial_fill(self):
+        s = ChaosSchedule(seed=0, endpoints={
+            "ring": EndpointChaos(short_rate=1.0, max_faults=1)})
+        wrapped, peer = _socketpair_with_chaos(s)
+        try:
+            peer.sendall(b"y" * 100)
+            buf = bytearray(100)
+            with pytest.raises(ConnectionResetError, match="short read"):
+                wrapped.recv_into(memoryview(buf))
+        finally:
+            peer.close()
+
+    def test_latency_delays_io(self):
+        s = ChaosSchedule(seed=0, endpoints={
+            "ring": EndpointChaos(latency_ms=30)})
+        wrapped, peer = _socketpair_with_chaos(s)
+        try:
+            t0 = time.perf_counter()
+            wrapped.sendall(b"z")
+            assert (time.perf_counter() - t0) >= 0.025
+            assert peer.recv(1) == b"z"
+        finally:
+            wrapped.close()
+            peer.close()
+
+
+class TestChaosCommunicator:
+    def _scripted(self, fault, phase):
+        class One(ChaosSchedule):
+            def config_for(self, endpoint):
+                return EndpointChaos()
+
+            def decide(self, endpoint, op):
+                return Decision(endpoint=endpoint, op=op, n=0,
+                                delay_ms=0.0, fault=fault, phase=phase,
+                                frac=0.5, blackhole_ms=0.0)
+
+        return One(seed=0, endpoints={})
+
+    def test_clean_forwarding(self):
+        inner = DummyCommunicator()
+        c = ChaosCommunicator(inner, ChaosSchedule(seed=0, endpoints={}))
+        assert c.allreduce({"g": np.ones(2)}).result()["g"].sum() == 2
+        assert inner.allreduce_count == 1
+        assert c.size() == 1 and c.rank() == 0
+        assert not c.wants_device_arrays
+
+    def test_pre_fault_raises_sync(self):
+        c = ChaosCommunicator(DummyCommunicator(),
+                              self._scripted("reset", "pre"))
+        with pytest.raises(CommunicatorError, match="chaos"):
+            c.allreduce({"g": np.ones(2)})
+
+    def test_post_fault_fails_future(self):
+        c = ChaosCommunicator(DummyCommunicator(),
+                              self._scripted("reset", "post"))
+        fut = c.allreduce({"g": np.ones(2)})
+        assert isinstance(fut.exception(), CommunicatorError)
+
+    def test_fingerprint_and_shutdown_forward(self):
+        inner = DummyCommunicator()
+        c = ChaosCommunicator(inner, ChaosSchedule(seed=0, endpoints={}))
+        c.set_allreduce_config_fingerprint("fp")
+        assert inner.allreduce_config_fingerprint == "fp"
+        c.configure("store:1/x", 0, 1)
+        assert inner.configure_count == 1
+
+
+class TestHealUnderChaos:
+    """The heal transport end to end (pure Python, no native lib): a real
+    CheckpointServer streams a pytree; chaos injects a mid-stream reset
+    on the first fetch; the retry layer re-fetches and the restore
+    succeeds."""
+
+    def test_fetch_retries_mid_stream_reset(self):
+        from torchft_tpu.checkpointing import CheckpointServer
+
+        state = {"w": np.arange(64, dtype=np.float32),
+                 "b": np.ones(8, dtype=np.float32)}
+        srv = CheckpointServer(lambda: state, bind_host="127.0.0.1")
+        srv.allow_checkpoint(1)
+        fails = [2]  # first two read() calls of the body get faults
+
+        class Script(ChaosSchedule):
+            def config_for(self, endpoint):
+                return EndpointChaos()
+
+            def decide(self, endpoint, op):
+                fault = None
+                if op == "read" and fails[0] > 0:
+                    fails[0] -= 1
+                    fault = "reset"
+                return Decision(endpoint=endpoint, op=op, n=0,
+                                delay_ms=0.0, fault=fault, phase="pre",
+                                frac=0.5, blackhole_ms=0.0)
+
+        chaos.install(Script(seed=0, endpoints={}))
+        try:
+            stats = RetryStats()
+            target = {"w": np.zeros(64, dtype=np.float32),
+                      "b": np.zeros(8, dtype=np.float32)}
+            out = CheckpointServer.load_from_address(
+                srv.address(), target, device_put=False,
+                retry_policy=RetryPolicy(max_attempts=4, base_delay_ms=1),
+                retry_stats=stats)
+            np.testing.assert_array_equal(out["w"], state["w"])
+            np.testing.assert_array_equal(out["b"], state["b"])
+            assert stats.snapshot()["retry_count"] == 2
+        finally:
+            chaos.uninstall()
+            srv.shutdown()
+
+    def test_fatal_refusal_does_not_retry(self):
+        from torchft_tpu.checkpointing import CheckpointServer
+
+        srv = CheckpointServer(lambda: {"w": np.ones(2)},
+                               bind_host="127.0.0.1")
+        srv.allow_checkpoint(3)
+        try:
+            stats = RetryStats()
+            # Request a WRONG step: 400 "invalid checkpoint requested"
+            # must surface immediately, not retry.
+            bad = srv.address().rsplit("/", 1)[0] + "/99"
+            with pytest.raises(Exception, match="[Ii]nvalid|400"):
+                CheckpointServer.load_from_address(
+                    bad, {"w": np.ones(2)}, device_put=False,
+                    retry_policy=RetryPolicy(max_attempts=5,
+                                             base_delay_ms=1),
+                    retry_stats=stats)
+            assert stats.snapshot()["retry_count"] == 0
+        finally:
+            srv.shutdown()
+
+
+class TestPoisonedRingRecovery:
+    """A transient collective failure with UNCHANGED membership must not
+    wedge the job: a latched CommunicatorError poisons the communicator
+    and the next quorum round forces a rebuild onto the deterministic
+    recovery prefix keyed by (quorum_id, max_step)."""
+
+    def _make_manager(self, comm, client):
+        from unittest.mock import MagicMock
+
+        from torchft_tpu.manager import Manager
+
+        return Manager(
+            comm=comm, load_state_dict=MagicMock(),
+            state_dict=lambda: {}, min_replica_size=1,
+            use_async_quorum=False, rank=0, world_size=1,
+            replica_id="poison", _manager_client=client)
+
+    def _quorum(self, qid, max_step):
+        from torchft_tpu._native import QuorumResult
+
+        return QuorumResult(
+            quorum_id=qid, recover_manager_address="m:1",
+            store_address="s:1", max_step=max_step, max_rank=0,
+            max_world_size=2, replica_rank=0, replica_world_size=2,
+            heal=False)
+
+    def test_comm_error_forces_recovery_rendezvous(self):
+        from unittest.mock import MagicMock
+
+        class Recording(DummyCommunicator):
+            def __init__(self):
+                super().__init__()
+                self.prefixes = []
+
+            def configure(self, store_addr, rank, world_size):
+                super().configure(store_addr, rank, world_size)
+                self.prefixes.append(store_addr)
+
+        comm = Recording()
+        client = MagicMock()
+        client.quorum.return_value = self._quorum(qid=7, max_step=3)
+        client.should_commit.return_value = False
+        m = self._make_manager(comm, client)
+        try:
+            m.step()
+            assert comm.prefixes == ["s:1/torchft/7/0"]
+            # Transient ring failure: membership unchanged, ring dead.
+            m.report_error(CommunicatorError("connection reset by peer"))
+            assert not m.should_commit()
+            m.step()  # same quorum id → recovery prefix, not a no-op
+            assert comm.prefixes[-1] == "s:1/torchft/7.r3/0"
+            # Poison cleared by the successful rebuild: the next same-
+            # quorum round reconfigures nothing.
+            client.should_commit.return_value = True
+            assert m.should_commit()
+            m.step()
+            assert len(comm.prefixes) == 2
+        finally:
+            m.shutdown()
+
+    def test_non_comm_error_does_not_rebuild_ring(self):
+        from unittest.mock import MagicMock
+
+        comm = DummyCommunicator()
+        client = MagicMock()
+        client.quorum.return_value = self._quorum(qid=5, max_step=2)
+        client.should_commit.return_value = False
+        m = self._make_manager(comm, client)
+        try:
+            m.step()
+            assert comm.configure_count == 1
+            # A quorum/heal-class error must NOT force a lone rebuild —
+            # peers know nothing about it and their ring is healthy.
+            m.report_error(RuntimeError("heal fetch failed"))
+            assert not m.should_commit()
+            m.step()
+            assert comm.configure_count == 1
+        finally:
+            m.shutdown()
+
+    def test_failed_recovery_keeps_poison_set(self):
+        from unittest.mock import MagicMock
+
+        class FailsOnce(DummyCommunicator):
+            def __init__(self):
+                super().__init__()
+                self.prefixes = []
+                self.fail_next = False
+
+            def configure(self, store_addr, rank, world_size):
+                self.prefixes.append(store_addr)
+                if self.fail_next:
+                    self.fail_next = False
+                    raise CommunicatorError("rendezvous timeout")
+                super().configure(store_addr, rank, world_size)
+
+        comm = FailsOnce()
+        client = MagicMock()
+        client.quorum.return_value = self._quorum(qid=9, max_step=4)
+        client.should_commit.return_value = False
+        m = self._make_manager(comm, client)
+        try:
+            m.step()
+            m.report_error(CommunicatorError("connection reset by peer"))
+            assert not m.should_commit()
+            comm.fail_next = True  # peers not at the rendezvous yet
+            with pytest.raises(CommunicatorError):
+                m.step()          # sync mode surfaces the failed round
+            m.step()              # retried: poison still set → try again
+            assert comm.prefixes[-2:] == ["s:1/torchft/9.r4/0",
+                                          "s:1/torchft/9.r4/0"]
+        finally:
+            m.shutdown()
+
+
+@requires_native
+@pytest.mark.integration
+@pytest.mark.slow
+@pytest.mark.nightly
+class TestChaosSoak:
+    """The capstone: two replica groups run 20+ steps while a seeded
+    schedule injects connection resets, latency/jitter, and short writes
+    into EVERY transport — store, manager RPC, heal, host ring, and the
+    allreduce path via the ChaosCommunicator shim. Oracles:
+
+    * both groups finish all steps with bitwise-identical params;
+    * zero lost or duplicated commits: no step is committed under two
+      quorum ids, and ``batches_committed`` agrees across survivors;
+    * faults actually fired on every targeted channel;
+    * the same ``ChaosSchedule(seed)`` reproduces the identical
+      injection trace when the recorded per-channel op sequence is
+      replayed.
+    """
+
+    SEED = 1234
+
+    def _schedule(self):
+        # Hard-fault caps bound wall clock: every ring/allreduce fault
+        # can cost one abort + a recovery rendezvous (up to ~timeout_sec
+        # when a stalled peer must notice); manager/store faults are
+        # cheap (absorbed by client retries in milliseconds).
+        return ChaosSchedule(seed=self.SEED, endpoints={
+            "ring": EndpointChaos(latency_ms=0.2, jitter_ms=1.0,
+                                  reset_rate=0.01, short_rate=0.01,
+                                  max_faults=4),
+            "store": EndpointChaos(latency_ms=0.2, reset_rate=0.05,
+                                   max_faults=6),
+            "manager": EndpointChaos(jitter_ms=1.0, reset_rate=0.04,
+                                     max_faults=8),
+            "heal": EndpointChaos(reset_rate=0.2, max_faults=2),
+            "allreduce": EndpointChaos(reset_rate=0.02, max_faults=2),
+        })
+
+    def test_soak_two_groups_no_lost_or_duplicated_commits(self):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from torchft_tpu import HostCommunicator, Lighthouse, Manager
+        from torchft_tpu.models import MLP
+        from torchft_tpu.parallel import FTTrainer
+
+        # Chaotic phase through step `chaos_until`, then a clean drain to
+        # `total_steps`: a fault landing exactly on the final step would
+        # let one group commit it while the other exits with it aborted —
+        # a legitimate at-most-one-step divergence the heal would repair
+        # on the NEXT step, which never comes. The drain gives every
+        # in-flight recovery (ring rebuild, heal catch-up) steps to
+        # converge, so the end-state oracles are exact.
+        total_steps = 24
+        chaos_until = 18
+        schedule = self._schedule()
+        chaos.install(schedule)
+        lh = Lighthouse(bind="127.0.0.1:0", min_replicas=1,
+                        join_timeout_ms=1000, quorum_tick_ms=50)
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(64, 8)).astype(np.float32)
+        y = (x.sum(axis=1) > 0).astype(np.int32)
+        model = MLP(features=(16,), num_classes=2)
+
+        def loss_fn(params, batch):
+            logits = model.apply(params, batch["x"])
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, batch["y"]).mean()
+
+        progress = {}  # group -> latest step (read by the main thread)
+
+        def run_group(group: int):
+            params = model.init(jax.random.key(42), jnp.zeros((1, 8)))
+            trainer = FTTrainer(
+                loss_fn=loss_fn, tx=optax.sgd(0.05), params=params,
+                manager_factory=lambda load, save: Manager(
+                    # schedule=None: the shim reads chaos.active() per
+                    # op, so the main thread's uninstall() at the drain
+                    # boundary silences this path too.
+                    comm=ChaosCommunicator(
+                        HostCommunicator(timeout_sec=15)),
+                    load_state_dict=load, state_dict=save,
+                    min_replica_size=1, replica_id=f"chaos{group}",
+                    lighthouse_addr=lh.address(), rank=0, world_size=1,
+                    timeout_ms=15_000, quorum_timeout_ms=15_000,
+                    max_consecutive_failures=100,
+                ),
+            )
+            commits = []
+            b = {"x": x[:16], "y": y[:16]}
+            try:
+                while trainer.manager.current_step() < total_steps:
+                    progress[group] = trainer.manager.current_step()
+                    _, committed = trainer.train_step(b)
+                    if committed:
+                        commits.append(
+                            (trainer.manager.current_step(),
+                             trainer.manager.quorum_id(),
+                             trainer.manager.num_participants()))
+                return {
+                    "params": jax.device_get(trainer.params),
+                    "step": trainer.manager.current_step(),
+                    "batches_committed":
+                        trainer.manager.batches_committed(),
+                    "commits": commits,
+                    "metrics": trainer.manager.metrics(),
+                }
+            finally:
+                trainer.shutdown()
+
+        try:
+            with ThreadPoolExecutor(max_workers=2) as pool:
+                futs = [pool.submit(run_group, g) for g in range(2)]
+                # Drain boundary: once every group is past `chaos_until`,
+                # stop injecting and let the tail converge cleanly.
+                deadline = time.monotonic() + 480
+                while not (len(progress) == 2 and all(
+                        s >= chaos_until for s in progress.values())):
+                    if time.monotonic() > deadline:
+                        break  # let result() surface the real failure
+                    if any(f.done() and f.exception() for f in futs):
+                        break
+                    time.sleep(0.25)
+                chaos.uninstall()
+                results = [f.result(timeout=600) for f in futs]
+        finally:
+            chaos.uninstall()
+            lh.shutdown()
+
+        # Everyone finished every step under sustained disruption.
+        assert all(r["step"] == total_steps for r in results), results
+        # Zero duplicated commits: no step committed under two quorums.
+        step_qids: dict = {}
+        for r in results:
+            for step, qid, _ in r["commits"]:
+                step_qids.setdefault(step, set()).add(qid)
+        split = {s: q for s, q in step_qids.items() if len(q) > 1}
+        assert not split, f"steps committed under multiple quorums: {split}"
+        # Zero lost commits: batches_committed consistent across
+        # survivors, and params bitwise identical (a lost commit on one
+        # side would diverge both).
+        assert (results[0]["batches_committed"]
+                == results[1]["batches_committed"]), results
+        jax.tree_util.tree_map(
+            lambda a, b_: np.testing.assert_array_equal(a, b_),
+            results[0]["params"], results[1]["params"])
+
+        # Chaos genuinely fired into the transports...
+        trace = schedule.trace()
+        channels_faulted = {d.endpoint.split(":", 1)[0]
+                            for d in trace if d.fault is not None}
+        assert {"store", "manager"} <= channels_faulted, channels_faulted
+        # ...and the retry layer absorbed transient RPC faults (visible
+        # in metrics rather than as training-loop crashes).
+        total_retries = sum(r["metrics"]["retry_count"] for r in results)
+        assert total_retries >= 1, [r["metrics"] for r in results]
+
+        # Determinism: replaying the recorded per-channel op sequence
+        # through a fresh ChaosSchedule(seed) reproduces the identical
+        # injection trace.
+        replay = self._schedule()
+        for d in trace:
+            replay.decide(d.endpoint, d.op)
+        assert replay.trace() == trace

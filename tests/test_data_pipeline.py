@@ -189,6 +189,34 @@ class TestElasticSampler:
         for a, c in zip(first, again):
             np.testing.assert_array_equal(a, c)
 
+    def test_prefers_atomic_slot_snapshot(self):
+        """With a real Manager the sampler must read the slot through the
+        participant_slot() atomic snapshot, never the two-call sequence a
+        concurrent quorum could tear (torn pair = wrong slot drawn)."""
+        from torchft_tpu.data import ElasticSampler
+
+        class SnapshotManager(_FakeFTManager):
+            def __init__(self):
+                super().__init__(rank=1)
+                self.snapshot_calls = 0
+
+            def participant_slot(self):
+                self.snapshot_calls += 1
+                return self.rank, self.bc
+
+            def participant_rank(self):  # must NOT be used
+                raise AssertionError("torn two-read path used")
+
+            def batches_committed(self):
+                raise AssertionError("torn two-read path used")
+
+        m = SnapshotManager()
+        m.bc = 10
+        s = ElasticSampler(64, m, batch_size=4, seed=0)
+        np.testing.assert_array_equal(
+            s.next_indices(), s.indices_for_slot(11))
+        assert m.snapshot_calls == 1
+
     def test_membership_shrink_repartitions(self):
         """3 -> 2 groups: after the survivors' ranks and bc update, the
         stream continues with no gaps or duplicates."""

@@ -20,6 +20,7 @@ from torchft_tpu import HostCommunicator, Lighthouse, Manager
 from torchft_tpu.data import DistributedSampler
 from torchft_tpu.models import MLP
 from torchft_tpu.parallel import FTTrainer
+from torchft_tpu.retry import RetryPolicy
 
 
 class InjectedFailure(Exception):
@@ -171,7 +172,11 @@ class TestLighthouseOutage:
                     # NB the RPC layer makes 2 attempts per call (rpc.cc
                     # reconnect+retry), so a quorum visibly fails only
                     # after 2x this timeout — the outage below must
-                    # outlast that for the stall to be observable.
+                    # outlast that for the stall to be observable. The
+                    # Python retry layer is pinned OFF: its backoff would
+                    # stretch (or, once the replacement lighthouse is up,
+                    # absorb) the per-step aborts this test asserts.
+                    retry_policy=RetryPolicy(max_attempts=1),
                     timeout_ms=4000, quorum_timeout_ms=2000,
                     # The guard must not fire during a bounded outage: an
                     # operator replacing a lighthouse needs minutes, and
@@ -273,6 +278,8 @@ class TestLighthouseOutage:
                     load_state_dict=load, state_dict=save,
                     min_replica_size=1, replica_id=f"lhm{group}",
                     lighthouse_addr=addr, rank=0, world_size=1,
+                    # Raw transport timing (see the outage test above).
+                    retry_policy=RetryPolicy(max_attempts=1),
                     timeout_ms=4000, quorum_timeout_ms=2000,
                     max_consecutive_failures=50,
                 ),

@@ -17,7 +17,11 @@ from torchft_tpu._native import (
     Store,
     StoreClient,
 )
+from torchft_tpu.chaos import (ChaosCommunicator, ChaosSchedule,
+                               EndpointChaos)
 from torchft_tpu.checkpointing import CheckpointServer
+from torchft_tpu.retry import (RetryError, RetryPolicy, RetryStats,
+                               call_with_retry, is_transient)
 from torchft_tpu.communicator import (
     Communicator,
     CommunicatorError,
@@ -37,7 +41,15 @@ from torchft_tpu.optim import FTOptimizer, OptimizerWrapper
 
 __all__ = [
     "BatchIterator",
+    "ChaosCommunicator",
+    "ChaosSchedule",
     "CheckpointServer",
+    "EndpointChaos",
+    "RetryError",
+    "RetryPolicy",
+    "RetryStats",
+    "call_with_retry",
+    "is_transient",
     "Communicator",
     "CommunicatorError",
     "DiLoCoTrainer",

@@ -1,4 +1,3 @@
-#include <ctime>
 #include "lighthouse.h"
 
 #include <algorithm>

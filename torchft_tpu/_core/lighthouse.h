@@ -105,9 +105,11 @@ class Lighthouse {
   // used. A counter restarting at 1 would collide with the common
   // stable-membership job (id still 1), survivors would skip the
   // communicator reconfigure, and a ring containing peers that died
-  // during the outage would wedge every collective. Seconds-since-epoch
-  // << 8 leaves 256 id bumps/second headroom within the old incarnation
-  // while guaranteeing the new one starts strictly higher.
+  // during the outage would wedge every collective. Milliseconds-since-
+  // epoch << 8 (see lighthouse.cc) leaves 256 id bumps per MILLISECOND
+  // of incarnation overlap while guaranteeing the new one starts
+  // strictly higher — ms, not seconds, because a supervisor can respawn
+  // within the same second.
   int64_t quorum_id_ = 0;
   int64_t broadcast_seq_ = 0;
   struct Beat {

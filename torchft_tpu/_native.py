@@ -20,6 +20,9 @@ import subprocess
 from dataclasses import dataclass
 from typing import Optional
 
+from torchft_tpu import chaos
+from torchft_tpu.retry import RetryPolicy, RetryStats, call_with_retry
+
 _CORE_DIR = os.path.join(os.path.dirname(__file__), "_core")
 _LIB_PATH = os.path.join(_CORE_DIR, "build", "libtorchft_tpu_core.so")
 
@@ -314,35 +317,117 @@ class Store:
             lib().tft_store_free(h)
 
 
-class StoreClient:
-    def __init__(self, address: str, connect_timeout_ms: int = 10_000):
-        err = ctypes.c_void_p()
-        self._h = _check_handle(
-            lib().tft_store_client_new(address.encode(), connect_timeout_ms,
-                                       ctypes.byref(err)), err)
+class _RetryingNativeClient:
+    """Shared retry + chaos scaffolding for the native RPC clients.
+    Subclasses set ``_CHANNEL`` (the chaos endpoint / stats-label
+    channel) and implement ``_new_handle`` / ``_free_handle`` for their
+    C pair; the handle lifecycle and retry loop live here once, so the
+    two clients cannot silently diverge.
+
+    Retries re-invoke on the SAME native handle, never rebuild it: the
+    C++ ``RpcClient`` already poisons a desynced socket and reconnects
+    internally on the next call, and — critically — its per-handle
+    monotonic ``call_seq`` survives those reconnects. A fresh handle
+    would restart ``call_seq`` at 0, and the server takes a LOWER seq at
+    a done round to be a lost-response replay (``manager.cc``), so a
+    rebuilt handle would replay stale quorum/commit rounds for thousands
+    of calls — breaking the very idempotency contract that makes retries
+    safe.
+
+    ``retry_policy`` defaults to the shared 3-attempt
+    exponential-backoff policy; pass ``RetryPolicy(max_attempts=1)`` to
+    observe raw transport timing. Chaos injection
+    (:mod:`torchft_tpu.chaos`, endpoint ``_CHANNEL``) wraps every call
+    so soak runs exercise exactly this retry path."""
+
+    _CHANNEL = ""
+
+    def __init__(self, address: str, connect_timeout_ms: int = 10_000,
+                 retry_policy: Optional[RetryPolicy] = None,
+                 retry_stats: Optional[RetryStats] = None):
+        self._h = None  # __del__ must be safe when the connect raises
         self._address = address
+        self._connect_timeout_ms = connect_timeout_ms
+        self._retry_policy = (retry_policy if retry_policy is not None
+                              else RetryPolicy())
+        self._retry_stats = retry_stats
+        self._h = self._call("connect", self._connect)
 
-    def set(self, key: str, value: bytes) -> None:
-        if isinstance(value, str):
-            value = value.encode()
-        err = ctypes.c_void_p()
-        _check(lib().tft_store_client_set(self._h, key.encode(), value,
-                                          len(value), ctypes.byref(err)), err)
+    def _new_handle(self):  # pragma: no cover — subclass contract
+        raise NotImplementedError
 
-    def get(self, key: str, timeout_ms: int = 30_000) -> bytes:
-        out, n, err = ctypes.c_void_p(), ctypes.c_size_t(), ctypes.c_void_p()
-        _check(lib().tft_store_client_get(self._h, key.encode(), timeout_ms,
-                                          ctypes.byref(out), ctypes.byref(n),
-                                          ctypes.byref(err)), err)
-        try:
-            return ctypes.string_at(out.value, n.value)
-        finally:
-            lib().tft_free(out.value)
+    def _free_handle(self, h) -> None:  # pragma: no cover
+        raise NotImplementedError
+
+    def _connect(self):
+        return self._new_handle()
+
+    def _call(self, op: str, fn):
+        def attempt():
+            tok = chaos.begin(self._CHANNEL, op)
+            result = fn()
+            try:
+                chaos.end(tok)
+            except BaseException:
+                # A post-phase fault after a successful connect would
+                # otherwise strand the freshly-created native handle (and
+                # its socket fd) with no owner.
+                if op == "connect" and result:
+                    self._free_handle(result)
+                raise
+            return result
+
+        return call_with_retry(attempt, self._retry_policy,
+                               stats=self._retry_stats,
+                               op=f"{self._CHANNEL}.{op}")
 
     def __del__(self):
         h, self._h = getattr(self, "_h", None), None
         if h:
-            lib().tft_store_client_free(h)
+            self._free_handle(h)
+
+
+class StoreClient(_RetryingNativeClient):
+    """KV store client with reconnect-and-retry on transient transport
+    errors (see :class:`_RetryingNativeClient`)."""
+
+    _CHANNEL = "store"
+
+    def _new_handle(self):
+        err = ctypes.c_void_p()
+        return _check_handle(
+            lib().tft_store_client_new(self._address.encode(),
+                                       self._connect_timeout_ms,
+                                       ctypes.byref(err)), err)
+
+    def _free_handle(self, h) -> None:
+        lib().tft_store_client_free(h)
+
+    def set(self, key: str, value: bytes) -> None:
+        if isinstance(value, str):
+            value = value.encode()
+
+        def do_set():
+            err = ctypes.c_void_p()
+            _check(lib().tft_store_client_set(self._h, key.encode(), value,
+                                              len(value), ctypes.byref(err)),
+                   err)
+
+        self._call("set", do_set)
+
+    def get(self, key: str, timeout_ms: int = 30_000) -> bytes:
+        def do_get():
+            out, n, err = (ctypes.c_void_p(), ctypes.c_size_t(),
+                           ctypes.c_void_p())
+            _check(lib().tft_store_client_get(
+                self._h, key.encode(), timeout_ms, ctypes.byref(out),
+                ctypes.byref(n), ctypes.byref(err)), err)
+            try:
+                return ctypes.string_at(out.value, n.value)
+            finally:
+                lib().tft_free(out.value)
+
+        return self._call("get", do_get)
 
 
 @dataclass
@@ -361,16 +446,27 @@ class QuorumResult:
     heal: bool
 
 
-class ManagerClient:
+class ManagerClient(_RetryingNativeClient):
     """Blocking client to a replica group's manager server (reference
-    ``src/lib.rs:81-181``)."""
+    ``src/lib.rs:81-181``), with reconnect-and-retry on transient
+    transport errors (see :class:`_RetryingNativeClient`). Retrying is
+    safe: every request carries a per-client monotonic ``call_seq``
+    (rpc.h), and the server replays a done round idempotently for a
+    retried rank while opening a fresh round only for a genuinely new
+    step attempt (manager.cc), so a retry after a lost response can
+    never double-join or double-commit."""
 
-    def __init__(self, address: str, connect_timeout_ms: int = 10_000):
+    _CHANNEL = "manager"
+
+    def _new_handle(self):
         err = ctypes.c_void_p()
-        self._h = _check_handle(
-            lib().tft_manager_client_new(address.encode(), connect_timeout_ms,
+        return _check_handle(
+            lib().tft_manager_client_new(self._address.encode(),
+                                         self._connect_timeout_ms,
                                          ctypes.byref(err)), err)
-        self._address = address
+
+    def _free_handle(self, h) -> None:
+        lib().tft_manager_client_free(h)
 
     @property
     def address(self) -> str:
@@ -378,6 +474,12 @@ class ManagerClient:
 
     def quorum(self, rank: int, step: int, checkpoint_server_addr: str,
                timeout_ms: int = 0) -> QuorumResult:
+        return self._call("quorum", lambda: self._quorum_once(
+            rank, step, checkpoint_server_addr, timeout_ms))
+
+    def _quorum_once(self, rank: int, step: int,
+                     checkpoint_server_addr: str,
+                     timeout_ms: int) -> QuorumResult:
         res, err = _CQuorumResult(), ctypes.c_void_p()
         _check(lib().tft_manager_client_quorum(
             self._h, rank, step, checkpoint_server_addr.encode(), timeout_ms,
@@ -395,25 +497,26 @@ class ManagerClient:
         )
 
     def checkpoint_address(self, rank: int, timeout_ms: int = 10_000) -> str:
-        out, err = ctypes.c_void_p(), ctypes.c_void_p()
-        _check(lib().tft_manager_client_checkpoint_address(
-            self._h, rank, timeout_ms, ctypes.byref(out), ctypes.byref(err)),
-            err)
-        return _take_str(out.value)
+        def once() -> str:
+            out, err = ctypes.c_void_p(), ctypes.c_void_p()
+            _check(lib().tft_manager_client_checkpoint_address(
+                self._h, rank, timeout_ms, ctypes.byref(out),
+                ctypes.byref(err)), err)
+            return _take_str(out.value)
+
+        return self._call("checkpoint_address", once)
 
     def should_commit(self, rank: int, step: int, should_commit: bool,
                       timeout_ms: int = 0) -> bool:
-        out, err = ctypes.c_int32(), ctypes.c_void_p()
-        _check(lib().tft_manager_client_should_commit(
-            self._h, rank, step, 1 if should_commit else 0, timeout_ms,
-            ctypes.byref(out), ctypes.byref(err)), err)
-        return bool(out.value)
+        def once() -> bool:
+            out, err = ctypes.c_int32(), ctypes.c_void_p()
+            _check(lib().tft_manager_client_should_commit(
+                self._h, rank, step, 1 if should_commit else 0, timeout_ms,
+                ctypes.byref(out), ctypes.byref(err)), err)
+            return bool(out.value)
+
+        return self._call("should_commit", once)
 
     def kill(self, msg: str = "") -> None:
         err = ctypes.c_void_p()
         lib().tft_manager_client_kill(self._h, msg.encode(), ctypes.byref(err))
-
-    def __del__(self):
-        h, self._h = getattr(self, "_h", None), None
-        if h:
-            lib().tft_manager_client_free(h)

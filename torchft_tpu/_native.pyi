@@ -4,6 +4,8 @@
 from dataclasses import dataclass
 from typing import Optional
 
+from torchft_tpu.retry import RetryPolicy, RetryStats
+
 class NativeError(RuntimeError): ...
 
 class Lighthouse:
@@ -49,7 +51,9 @@ class Store:
     def shutdown(self) -> None: ...
 
 class StoreClient:
-    def __init__(self, address: str, connect_timeout_ms: int = ...) -> None: ...
+    def __init__(self, address: str, connect_timeout_ms: int = ...,
+                 retry_policy: RetryPolicy | None = ...,
+                 retry_stats: RetryStats | None = ...) -> None: ...
     def set(self, key: str, value: bytes) -> None: ...
     def get(self, key: str, timeout_ms: int = ...) -> bytes: ...
 
@@ -66,7 +70,9 @@ class QuorumResult:
     heal: bool
 
 class ManagerClient:
-    def __init__(self, address: str, connect_timeout_ms: int = ...) -> None: ...
+    def __init__(self, address: str, connect_timeout_ms: int = ...,
+                 retry_policy: RetryPolicy | None = ...,
+                 retry_stats: RetryStats | None = ...) -> None: ...
     @property
     def address(self) -> str: ...
     def quorum(

@@ -33,8 +33,10 @@ from typing import Any, List, Optional, Tuple
 import numpy as np
 import jax
 
+from torchft_tpu import chaos
 from torchft_tpu._native import StoreClient
 from torchft_tpu.communicator import Communicator, CommunicatorError
+from torchft_tpu.retry import RetryPolicy, RetryStats, call_with_retry
 from torchft_tpu.serialization import load_pytree, save_pytree
 from torchft_tpu.utils import advertise_host
 
@@ -130,8 +132,22 @@ class _Ring:
 
 
 class HostCommunicator(Communicator):
-    def __init__(self, timeout_sec: float = 60.0) -> None:
+    """``retry_policy`` governs the transient-error retries of the ring
+    (re)connect during :meth:`configure` and rides into the store client
+    used for rendezvous; a fresh listener is already published per
+    epoch, so retrying the dial is idempotent. The ring's data sockets
+    are chaos-wrappable (:func:`torchft_tpu.chaos.wrap_socket`, endpoint
+    ``ring``) so soak runs inject resets/short-writes into live
+    collectives."""
+
+    def __init__(self, timeout_sec: float = 60.0,
+                 retry_policy: Optional[RetryPolicy] = None,
+                 retry_stats: Optional[RetryStats] = None) -> None:
         self._timeout = timeout_sec
+        self._retry_policy = (retry_policy if retry_policy is not None
+                              else RetryPolicy())
+        self._retry_stats = retry_stats
+
         self._rank = 0
         self._world = 1
         self._ring: Optional[_Ring] = None
@@ -142,6 +158,13 @@ class HostCommunicator(Communicator):
                                         name="host-comm")
         self._worker.start()
         self._shutdown = False
+
+    def set_retry_policy(self, policy, stats=None) -> None:
+        """Adopt the owning Manager's policy + shared stats (forwarded by
+        Manager at construction) so ring-dial retries follow the one
+        configured policy and surface in ``Manager.metrics()``."""
+        self._retry_policy = policy
+        self._retry_stats = stats
 
     # ------------------------------------------------------------ configure
 
@@ -169,7 +192,8 @@ class HostCommunicator(Communicator):
 
         host_port, _, prefix = store_addr.partition("/")
         store = StoreClient(host_port, connect_timeout_ms=int(
-            self._timeout * 1000))
+            self._timeout * 1000), retry_policy=self._retry_policy,
+            retry_stats=self._retry_stats)
 
         # Allreduce-config skew check (set by Manager before configure):
         # every rank must derive the identical bucket schedule from
@@ -220,28 +244,164 @@ class HostCommunicator(Communicator):
         store.set(f"{prefix}/{rank}", my_addr.encode())
 
         next_rank = (rank + 1) % world_size
-        next_addr = store.get(f"{prefix}/{next_rank}",
-                              timeout_ms=int(self._timeout * 1000)).decode()
-        nhost, _, nport = next_addr.rpartition(":")
-        next_sock = socket.create_connection((nhost, int(nport)),
-                                             timeout=self._timeout)
-        next_sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        # Identify ourselves so the acceptor can reject stale dialers.
-        _send_all(next_sock, struct.pack("<qq", epoch_key(prefix), rank))
 
-        prev_sock = None
-        while prev_sock is None:
-            cand, _ = listener.accept()
-            cand.settimeout(self._timeout)
-            cand.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            key, peer_rank = struct.unpack("<qq",
-                                           bytes(_recv_exact(cand, 16)))
-            if key == epoch_key(prefix) and peer_rank == (
-                    rank - 1) % world_size:
-                prev_sock = cand
-            else:
-                cand.close()
-        next_sock.settimeout(self._timeout)
+        class _StoreLookupError(RuntimeError):
+            """Successor-address lookup failed. Deliberately NOT retried
+            by the outer dial loop: the StoreClient already applied its
+            own retry policy (and chaos-injected store faults surface
+            type-unchanged as ConnectionError after it gives up), so
+            outer retries would compound the layers into
+            max_attempts^2 worst-case stalls on the quorum thread."""
+
+        # Retried dial, re-reading the successor's address each attempt:
+        # besides riding out a transient reset mid-handshake, this heals
+        # the stale-address cases in recovery rendezvous — a peer's
+        # earlier configure of the SAME prefix may have left a dead (or
+        # not-yet-superseded live) listener's address under the key its
+        # fresh attempt then overwrites. A refused dial re-reads instead
+        # of redialing the corpse; the handshake ACK below catches the
+        # nastier still-open-but-abandoned listener, whose accept queue
+        # swallows the dial silently.
+        def dial() -> socket.socket:
+            try:
+                next_addr = store.get(
+                    f"{prefix}/{next_rank}",
+                    timeout_ms=int(self._timeout * 1000)).decode()
+            except Exception as e:  # KeyboardInterrupt must propagate
+                raise _StoreLookupError(
+                    f"successor address lookup failed: {e}") from e
+            nhost, _, nport = next_addr.rpartition(":")
+            s = socket.create_connection((nhost, int(nport)),
+                                         timeout=self._timeout)
+            try:
+                s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                s.settimeout(self._timeout)
+                # Identify ourselves so the acceptor can reject stale
+                # dialers...
+                _send_all(s, struct.pack("<qq", epoch_key(prefix), rank))
+                # ...and require its ACK so WE reject stale acceptors: a
+                # connect into the accept backlog of an abandoned
+                # listener from an earlier same-prefix attempt succeeds
+                # silently and would wedge the ring's first collective;
+                # only a peer actively accepting this epoch echoes the
+                # key (its eventual listener close RSTs us instead,
+                # failing this read and triggering a re-read-and-redial).
+                ack = struct.unpack("<q", bytes(_recv_exact(s, 8)))[0]
+                if ack != epoch_key(prefix):
+                    raise CommunicatorError(
+                        "ring handshake ack mismatch (stale peer?)")
+                return s
+            except BaseException:
+                s.close()
+                raise
+
+        # Outer retries cover the socket dial + handshake only —
+        # OSError spans the whole dial-failure family (refused, reset,
+        # timed out, no-route-to-host, DNS via socket.gaierror), and
+        # CommunicatorError covers the handshake (short read /
+        # stale-acceptor ACK mismatch). Never the store lookup (see
+        # _StoreLookupError, a plain RuntimeError).
+        def dial_transient(e: BaseException) -> bool:
+            return isinstance(e, (OSError, CommunicatorError))
+
+        # The accept loop runs CONCURRENTLY with the dial: each rank's
+        # dial blocks on its successor's ACK, and that ACK is sent by the
+        # successor's accept loop — serializing accept after dial would
+        # deadlock the whole ring on its own circular wait. The loop is
+        # resilient per candidate (a hello reset mid-handshake closes
+        # that candidate and keeps accepting — it is exactly the
+        # transient the dialer's retry redials through) and keeps
+        # serving REDIALS until the rendezvous finalizes: a dialer whose
+        # ACK was lost retries, and the newest validated candidate
+        # supersedes the previous one (whose far end gave up on it).
+        accept_box: dict = {}
+        box_lock = threading.Lock()
+        have_prev = threading.Event()
+        accept_done = threading.Event()
+
+        def accept_loop() -> None:
+            while not accept_done.is_set():
+                try:
+                    cand, _ = listener.accept()
+                except OSError:
+                    continue  # listener timeout/close: re-check done
+                old = None
+                try:
+                    cand.settimeout(self._timeout)
+                    cand.setsockopt(socket.IPPROTO_TCP,
+                                    socket.TCP_NODELAY, 1)
+                    key, peer_rank = struct.unpack(
+                        "<qq", bytes(_recv_exact(cand, 16)))
+                    if key != epoch_key(prefix) or peer_rank != (
+                            rank - 1) % world_size:
+                        cand.close()
+                        continue
+                    # Publish under the lock BEFORE ACKing: ACK-first
+                    # would let a late redial be ACKed (dial "succeeds")
+                    # and then closed when the done-check fires — a dead
+                    # ring link minted at the exact window the ACK exists
+                    # to close.
+                    with box_lock:
+                        if accept_done.is_set():
+                            cand.close()
+                            return
+                        old = accept_box.pop("sock", None)
+                        accept_box["sock"] = cand
+                    # ACK: prove to the dialer it reached a live acceptor
+                    # of THIS epoch, not an abandoned listener's backlog.
+                    try:
+                        _send_all(cand, struct.pack("<q", key))
+                    except Exception:  # noqa: BLE001 — dialer gone
+                        with box_lock:
+                            mine = accept_box.get("sock") is cand
+                            if mine:
+                                accept_box.pop("sock")
+                        # Only close what the rendezvous hasn't already
+                        # claimed; if finalize raced the pop, the dead
+                        # link surfaces on the first collective and the
+                        # poison/recovery path repairs it.
+                        if mine:
+                            cand.close()
+                        continue
+                    have_prev.set()
+                except Exception:  # noqa: BLE001 — per-candidate only
+                    try:
+                        cand.close()
+                    except OSError:
+                        pass
+                finally:
+                    if old is not None:
+                        old.close()
+
+        acceptor = threading.Thread(target=accept_loop, daemon=True,
+                                    name="ring-accept")
+        acceptor.start()
+        next_sock = None
+        try:
+            next_sock = call_with_retry(
+                dial, self._retry_policy, classify=dial_transient,
+                stats=self._retry_stats, op="ring.connect")
+            have_prev.wait(timeout=self._timeout)
+            with box_lock:
+                accept_done.set()
+                prev_sock = accept_box.pop("sock", None)
+            if prev_sock is None:
+                raise CommunicatorError(
+                    "ring accept failed: predecessor never arrived")
+        except BaseException:
+            with box_lock:
+                accept_done.set()
+                stranded = accept_box.pop("sock", None)
+            if stranded is not None:
+                # Close the already-validated predecessor socket too:
+                # leaving it half-open would make the peer's first ring
+                # send wedge until its full timeout instead of failing
+                # fast on the reset.
+                stranded.close()
+            if next_sock is not None:
+                next_sock.close()
+            listener.close()  # unblocks the acceptor thread too
+            raise
 
         with self._lock:
             if self._epoch != epoch:  # raced with another configure
@@ -249,7 +409,13 @@ class HostCommunicator(Communicator):
                 prev_sock.close()
                 listener.close()
                 return
-            self._ring = _Ring(next_sock, prev_sock, listener)
+            # Chaos wrapping AFTER the epoch handshake: rendezvous stays
+            # clean (a fault there is just a failed configure), the data
+            # plane — every ring collective byte — is injectable.
+            self._ring = _Ring(
+                chaos.wrap_socket(next_sock, "ring"),
+                chaos.wrap_socket(prev_sock, "ring"),
+                listener)
         logger.info("host communicator configured: rank=%d world=%d (%s)",
                     rank, world_size, prefix)
 

@@ -1,0 +1,600 @@
+"""ChaosNet: deterministic, seed-driven transport fault injection.
+
+The framework's fault-tolerance story was proven only against *clean*
+failures (a whole replica group killed at a step boundary). Production
+failures live in the messy middle: slow peers, connection resets mid-RPC,
+partial writes on the host ring, a flapping lighthouse. This module
+injects exactly those, deterministically, at every Python-side transport:
+
+* the host-ring sockets (:mod:`torchft_tpu.backends.host`) via
+  :func:`wrap_socket`;
+* the heal transport (:mod:`torchft_tpu.checkpointing`) via
+  :func:`wrap_reader` around the streamed HTTP body;
+* the native KV-store / manager-RPC clients (:mod:`torchft_tpu._native`)
+  via the :func:`begin`/:func:`end` shims around each foreign call (the
+  C++ sockets themselves are out of Python's reach, so faults are
+  injected at the call boundary — a "pre" fault models a request that
+  never arrived, a "post" fault a lost response, which is the case the
+  server-side ``call_seq`` idempotency exists for);
+* the manager's cross-group allreduce path via
+  :class:`ChaosCommunicator`, a fault-injecting Communicator shim.
+
+Faults come from a :class:`ChaosSchedule`: a per-endpoint configuration
+(latency, jitter, connection resets, short reads/writes, black-holes)
+driven by per-channel deterministic RNG streams — the decision sequence
+for a channel is a pure function of ``(seed, channel, op index)``, so the
+same schedule replayed over the same per-channel op sequence reproduces
+the identical injection trace (:meth:`ChaosSchedule.trace`), regardless
+of cross-channel thread interleaving.
+
+Activation:
+
+* tests construct a schedule and :func:`install` it (or pass it
+  directly, e.g. to :class:`ChaosCommunicator`);
+* soak runs set ``TORCHFT_CHAOS`` and every transport picks it up
+  lazily. Spec grammar (see docs/design/chaos_and_retry.md)::
+
+      TORCHFT_CHAOS="seed=42;ring:reset_rate=0.02,latency_ms=5;store:reset_rate=0.01;*:jitter_ms=2"
+
+  ``seed=<int>`` first (optional, default 0), then
+  ``<channel>:<field>=<value>,...`` clauses separated by ``;`` where
+  ``<channel>`` is an endpoint channel (``ring``, ``store``,
+  ``manager``, ``heal``, ``allreduce``) or ``*`` for all, and
+  ``<field>`` is any :class:`EndpointChaos` field.
+
+When nothing is installed and ``TORCHFT_CHAOS`` is unset, every hook is
+a no-op costing one global read on the hot path.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import socket
+import threading
+import time
+from dataclasses import dataclass, fields, replace
+from typing import Any, Dict, List, Optional
+
+from concurrent.futures import Future
+
+from torchft_tpu.communicator import Communicator, CommunicatorError
+
+__all__ = [
+    "EndpointChaos",
+    "ChaosSchedule",
+    "ChaosCommunicator",
+    "ChaosSocket",
+    "parse_spec",
+    "install",
+    "uninstall",
+    "reset",
+    "active",
+    "wrap_socket",
+    "wrap_reader",
+    "begin",
+    "end",
+]
+
+
+@dataclass(frozen=True)
+class EndpointChaos:
+    """Fault mix for one endpoint channel. Rates are per-operation
+    probabilities in ``[0, 1]``; at most one hard fault fires per op
+    (drawn from a single uniform sample, so ``reset_rate + short_rate +
+    blackhole_rate`` should stay <= 1)."""
+
+    latency_ms: float = 0.0      # fixed delay added to every operation
+    jitter_ms: float = 0.0       # extra uniform delay in [0, jitter_ms]
+    reset_rate: float = 0.0      # connection reset (pre or post for RPCs)
+    short_rate: float = 0.0      # partial read/write, then reset
+    blackhole_rate: float = 0.0  # op stalls, then times out
+    blackhole_ms: float = 5_000.0  # stall bound for black-holed ops
+    max_faults: int = -1         # cap on hard faults per channel (-1 = inf)
+
+
+@dataclass(frozen=True)
+class Decision:
+    """One injection decision. ``fault`` is ``None``, ``"reset"``,
+    ``"short"`` or ``"blackhole"``; ``phase`` is ``"pre"`` (request never
+    arrived) or ``"post"`` (response lost) and is honored by the RPC
+    shims only — socket faults fire at IO time. ``frac`` is the fraction
+    of a short transfer that completes."""
+
+    endpoint: str
+    op: str
+    n: int                      # per-channel op index
+    delay_ms: float
+    fault: Optional[str]
+    phase: str
+    frac: float
+    blackhole_ms: float
+
+
+class ChaosSchedule:
+    """Seed-driven per-endpoint fault schedule with a recorded trace.
+
+    Decisions for a channel are drawn from that channel's own RNG stream
+    seeded by ``(seed, channel)``: decision ``n`` of a channel is a pure
+    function of ``(seed, channel, n)``, so replaying the same per-channel
+    op sequence through a fresh ``ChaosSchedule(seed)`` reproduces the
+    identical trace even when threads interleave channels differently.
+    """
+
+    def __init__(self, seed: int = 0,
+                 endpoints: Optional[Dict[str, EndpointChaos]] = None,
+                 trace_cap: int = 100_000) -> None:
+        """``trace_cap`` bounds the recorded trace: a multi-hour soak
+        draws a decision per ring segment / RPC / stream read, and an
+        unbounded list would grow into gigabytes on the collective hot
+        path. Decisions past the cap still DRAW (determinism and fault
+        injection are unaffected) but are only counted —
+        ``trace_dropped`` says how many; reproducibility asserts must
+        fit their op sequence under the cap."""
+        self.seed = int(seed)
+        self.endpoints: Dict[str, EndpointChaos] = dict(endpoints or {})
+        self.trace_cap = int(trace_cap)
+        self.trace_dropped = 0
+        self._lock = threading.Lock()
+        self._rngs: Dict[str, random.Random] = {}
+        self._counts: Dict[str, int] = {}
+        self._faults_left: Dict[str, int] = {}
+        self._trace: List[Decision] = []
+        self._fault_count = 0
+
+    # ------------------------------------------------------------- config
+
+    def config_for(self, endpoint: str) -> Optional[EndpointChaos]:
+        """Effective config: exact endpoint, else its channel (the part
+        before the first ``:``), else the ``*`` wildcard."""
+        cfg = self.endpoints.get(endpoint)
+        if cfg is None:
+            cfg = self.endpoints.get(endpoint.split(":", 1)[0])
+        if cfg is None:
+            cfg = self.endpoints.get("*")
+        return cfg
+
+    # ---------------------------------------------------------- decisions
+
+    def decide(self, endpoint: str, op: str) -> Optional[Decision]:
+        """Draw (and record) the next decision for ``endpoint``; ``None``
+        when the endpoint has no chaos configured."""
+        cfg = self.config_for(endpoint)
+        if cfg is None:
+            return None
+        channel = endpoint.split(":", 1)[0]
+        with self._lock:
+            rng = self._rngs.get(channel)
+            if rng is None:
+                # String seeding hashes stably (sha512) across runs and
+                # interpreters, unlike tuple/hash() seeding.
+                rng = self._rngs[channel] = random.Random(
+                    f"{self.seed}/{channel}")
+                self._counts[channel] = 0
+                self._faults_left[channel] = cfg.max_faults
+            n = self._counts[channel]
+            self._counts[channel] = n + 1
+            delay = cfg.latency_ms
+            if cfg.jitter_ms > 0:
+                delay += rng.uniform(0.0, cfg.jitter_ms)
+            fault: Optional[str] = None
+            u = rng.random()
+            if u < cfg.reset_rate:
+                fault = "reset"
+            elif u < cfg.reset_rate + cfg.short_rate:
+                fault = "short"
+            elif u < (cfg.reset_rate + cfg.short_rate
+                      + cfg.blackhole_rate):
+                fault = "blackhole"
+            # Draw phase/frac unconditionally so the stream position does
+            # not depend on whether a fault fired (keeps decision n a pure
+            # function of (seed, channel, n) even across config edits).
+            phase = "pre" if rng.random() < 0.5 else "post"
+            frac = rng.uniform(0.1, 0.9)
+            if fault is not None and self._faults_left[channel] == 0:
+                fault = None  # cap exhausted: latency only
+            elif fault is not None and self._faults_left[channel] > 0:
+                self._faults_left[channel] -= 1
+            d = Decision(endpoint=endpoint, op=op, n=n, delay_ms=delay,
+                         fault=fault, phase=phase, frac=frac,
+                         blackhole_ms=cfg.blackhole_ms)
+            if fault is not None:
+                self._fault_count += 1
+            if len(self._trace) < self.trace_cap:
+                self._trace.append(d)
+            else:
+                self.trace_dropped += 1
+            return d
+
+    def trace(self) -> List[Decision]:
+        """Recorded decisions (copy, thread-safe) — the first
+        ``trace_cap`` draws; ``trace_dropped`` counts the rest."""
+        with self._lock:
+            return list(self._trace)
+
+    def fault_count(self) -> int:
+        """Hard faults injected so far (counted even past the trace
+        cap)."""
+        with self._lock:
+            return self._fault_count
+
+
+# ----------------------------------------------------------------- spec
+
+
+def parse_spec(spec: str) -> ChaosSchedule:
+    """Parse a ``TORCHFT_CHAOS`` spec string into a schedule."""
+    seed = 0
+    endpoints: Dict[str, EndpointChaos] = {}
+    valid = {f.name: f.type for f in fields(EndpointChaos)}
+    for clause in spec.split(";"):
+        clause = clause.strip()
+        if not clause:
+            continue
+        if clause.startswith("seed="):
+            seed = int(clause[len("seed="):])
+            continue
+        channel, sep, params = clause.partition(":")
+        if not sep:
+            raise ValueError(
+                f"TORCHFT_CHAOS clause {clause!r}: expected "
+                "'<channel>:<field>=<value>,...' or 'seed=<int>'")
+        cfg = endpoints.get(channel.strip(), EndpointChaos())
+        for kv in params.split(","):
+            kv = kv.strip()
+            if not kv:
+                continue
+            key, sep, value = kv.partition("=")
+            key = key.strip()
+            if not sep or key not in valid:
+                raise ValueError(
+                    f"TORCHFT_CHAOS clause {clause!r}: unknown field "
+                    f"{key!r} (valid: {sorted(valid)})")
+            cast = int if key == "max_faults" else float
+            cfg = replace(cfg, **{key: cast(value)})
+        endpoints[channel.strip()] = cfg
+    return ChaosSchedule(seed=seed, endpoints=endpoints)
+
+
+# ------------------------------------------------------- global activation
+
+_installed: Optional[ChaosSchedule] = None
+_env_checked = False
+_install_lock = threading.Lock()
+
+
+def install(schedule: Optional[ChaosSchedule]) -> None:
+    """Install a process-wide schedule (tests / soak harnesses)."""
+    global _installed, _env_checked
+    with _install_lock:
+        _installed = schedule
+        _env_checked = True  # an explicit install overrides the env
+
+
+def uninstall() -> None:
+    """Disable process-wide chaos. STICKY against the environment: a
+    later ``active()`` does NOT re-parse ``TORCHFT_CHAOS`` — otherwise a
+    soak's drain-boundary uninstall would be silently re-armed by the
+    very next transport op whenever the spec came from the env. Use
+    :func:`reset` to also forget the env decision."""
+    global _installed, _env_checked
+    with _install_lock:
+        _installed = None
+        _env_checked = True
+
+
+def reset() -> None:
+    """Forget everything: uninstall AND re-arm env parsing, so the next
+    ``active()`` re-reads ``TORCHFT_CHAOS`` (test isolation helper)."""
+    global _installed, _env_checked
+    with _install_lock:
+        _installed = None
+        _env_checked = False
+
+
+def active() -> Optional[ChaosSchedule]:
+    """The installed schedule, lazily parsing ``TORCHFT_CHAOS`` once."""
+    global _env_checked, _installed
+    if _env_checked:
+        return _installed
+    with _install_lock:
+        if not _env_checked:
+            spec = os.environ.get("TORCHFT_CHAOS")
+            if spec:
+                _installed = parse_spec(spec)
+            _env_checked = True
+    return _installed
+
+
+# ------------------------------------------------------------ RPC shims
+
+
+def begin(endpoint: str, op: str,
+          schedule: Optional[ChaosSchedule] = None) -> Optional[Decision]:
+    """Pre-call hook for RPC-style clients: applies latency, raises the
+    decided pre-phase fault, and returns the decision for :func:`end`.
+
+    Raises ``ConnectionResetError`` for resets/shorts (message-classified
+    transient by :func:`torchft_tpu.retry.is_transient`) and
+    ``TimeoutError`` after stalling for black-holes.
+    """
+    sched = schedule if schedule is not None else active()
+    if sched is None:
+        return None
+    d = sched.decide(endpoint, op)
+    if d is None:
+        return None
+    if d.delay_ms > 0:
+        time.sleep(d.delay_ms / 1e3)
+    if d.fault == "blackhole":
+        time.sleep(d.blackhole_ms / 1e3)
+        raise TimeoutError(
+            f"[chaos] {endpoint}/{op}#{d.n}: black-holed, timed out")
+    if d.fault in ("reset", "short") and d.phase == "pre":
+        raise ConnectionResetError(
+            f"[chaos] {endpoint}/{op}#{d.n}: connection reset by peer "
+            "(request lost)")
+    return d
+
+
+def end(decision: Optional[Decision]) -> None:
+    """Post-call hook: raises the decided post-phase fault (the RPC
+    executed server-side but the response was "lost" — the exact case
+    ``call_seq`` idempotency makes safe to retry)."""
+    if decision is not None and decision.fault in ("reset", "short") \
+            and decision.phase == "post":
+        raise ConnectionResetError(
+            f"[chaos] {decision.endpoint}/{decision.op}"
+            f"#{decision.n}: connection reset by peer (response lost)")
+
+
+# ------------------------------------------------------------- sockets
+
+
+class ChaosSocket:
+    """Socket proxy injecting the schedule's faults at IO time.
+
+    Wraps ``send``/``sendall``/``recv``/``recv_into``; everything else
+    delegates. A reset/short fault also closes the real socket so the
+    peer observes the failure too (bilateral, like a real RST). A
+    black-hole stalls up to ``min(blackhole_ms, socket timeout)`` and
+    raises ``socket.timeout``.
+    """
+
+    def __init__(self, sock: socket.socket, endpoint: str,
+                 schedule: ChaosSchedule,
+                 from_global: bool = False) -> None:
+        self._sock = sock
+        self._endpoint = endpoint
+        self._schedule = schedule
+        # Wrapped off the process-wide schedule: honor a later
+        # uninstall() — long-lived sockets (the ring) must fall quiet
+        # when the soak harness ends the chaotic phase.
+        self._from_global = from_global
+
+    def __getattr__(self, name: str) -> Any:
+        return getattr(self._sock, name)
+
+    def _pre(self, op: str) -> Optional[Decision]:
+        if self._from_global and active() is not self._schedule:
+            return None
+        d = self._schedule.decide(self._endpoint, op)
+        if d is None:
+            return None
+        if d.delay_ms > 0:
+            time.sleep(d.delay_ms / 1e3)
+        if d.fault == "blackhole":
+            tmo = self._sock.gettimeout()
+            stall = d.blackhole_ms / 1e3
+            if tmo is not None:
+                stall = min(stall, tmo)
+            time.sleep(stall)
+            raise socket.timeout(
+                f"[chaos] {self._endpoint}/{op}#{d.n}: black-holed")
+        if d.fault == "reset":
+            self._abort()
+            raise ConnectionResetError(
+                f"[chaos] {self._endpoint}/{op}#{d.n}: "
+                "connection reset by peer")
+        return d
+
+    def _abort(self) -> None:
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def _short_write(self, data, d: Decision) -> None:
+        """Transfer a partial prefix, then abort — the one spelling of
+        the short-write fault shared by send and sendall."""
+        part = max(1, int(len(data) * d.frac))
+        try:
+            self._sock.sendall(memoryview(data)[:part])
+        finally:
+            self._abort()
+        raise ConnectionResetError(
+            f"[chaos] {self._endpoint}/send#{d.n}: short write "
+            f"({part}/{len(data)} bytes), connection reset")
+
+    def send(self, data, *args) -> int:
+        d = self._pre("send")
+        if d is not None and d.fault == "short":
+            self._short_write(data, d)
+        return self._sock.send(data, *args)
+
+    def sendall(self, data, *args) -> None:
+        d = self._pre("send")
+        if d is not None and d.fault == "short":
+            self._short_write(data, d)
+        return self._sock.sendall(data, *args)
+
+    def recv(self, bufsize: int, *args) -> bytes:
+        d = self._pre("recv")
+        if d is not None and d.fault == "short" and bufsize > 1:
+            got = self._sock.recv(max(1, int(bufsize * d.frac)), *args)
+            self._abort()
+            raise ConnectionResetError(
+                f"[chaos] {self._endpoint}/recv#{d.n}: short read "
+                f"({len(got)}/{bufsize} bytes), connection reset")
+        return self._sock.recv(bufsize, *args)
+
+    def recv_into(self, buffer, nbytes: int = 0, *args) -> int:
+        d = self._pre("recv")
+        n = nbytes or len(buffer)
+        if d is not None and d.fault == "short" and n > 1:
+            part = max(1, int(n * d.frac))
+            self._sock.recv_into(memoryview(buffer)[:part], part, *args)
+            self._abort()
+            raise ConnectionResetError(
+                f"[chaos] {self._endpoint}/recv#{d.n}: short read "
+                f"({part}/{n} bytes), connection reset")
+        return self._sock.recv_into(buffer, nbytes, *args)
+
+
+def wrap_socket(sock: socket.socket, endpoint: str,
+                schedule: Optional[ChaosSchedule] = None):
+    """Wrap ``sock`` when chaos targets ``endpoint``; pass through (zero
+    overhead) otherwise. Transport code calls this unconditionally."""
+    sched = schedule if schedule is not None else active()
+    if sched is None or sched.config_for(endpoint) is None:
+        return sock
+    return ChaosSocket(sock, endpoint, sched, from_global=schedule is None)
+
+
+class _ChaosReader:
+    """File-like read shim for streamed HTTP bodies (the heal fetch):
+    injects latency/short-read/reset per ``read()`` call."""
+
+    def __init__(self, raw: Any, endpoint: str,
+                 schedule: ChaosSchedule) -> None:
+        self._raw = raw
+        self._endpoint = endpoint
+        self._schedule = schedule
+
+    def __getattr__(self, name: str) -> Any:
+        return getattr(self._raw, name)
+
+    def read(self, n: int = -1) -> bytes:
+        d = self._schedule.decide(self._endpoint, "read")
+        if d is not None:
+            if d.delay_ms > 0:
+                time.sleep(d.delay_ms / 1e3)
+            if d.fault == "blackhole":
+                time.sleep(d.blackhole_ms / 1e3)
+                raise TimeoutError(
+                    f"[chaos] {self._endpoint}/read#{d.n}: black-holed, "
+                    "timed out")
+            if d.fault == "reset":
+                raise ConnectionResetError(
+                    f"[chaos] {self._endpoint}/read#{d.n}: "
+                    "connection reset by peer")
+            if d.fault == "short" and n is not None and n > 1:
+                self._raw.read(max(1, int(n * d.frac)))
+                raise ConnectionResetError(
+                    f"[chaos] {self._endpoint}/read#{d.n}: short read, "
+                    "connection reset")
+        return self._raw.read(n)
+
+    def readinto(self, b) -> int:
+        # load_pytree_from may use readinto on some paths; route through
+        # read() so faults apply uniformly.
+        data = self.read(len(b))
+        b[: len(data)] = data
+        return len(data)
+
+
+def wrap_reader(raw: Any, endpoint: str,
+                schedule: Optional[ChaosSchedule] = None) -> Any:
+    """Wrap a readable stream when chaos targets ``endpoint``."""
+    sched = schedule if schedule is not None else active()
+    if sched is None or sched.config_for(endpoint) is None:
+        return raw
+    return _ChaosReader(raw, endpoint, sched)
+
+
+# --------------------------------------------------------- communicator
+
+
+class ChaosCommunicator(Communicator):
+    """Fault-injecting shim around any Communicator: the manager's
+    allreduce path sees latency/resets without touching the backend.
+
+    Faults surface as :class:`CommunicatorError` (sync raise or failed
+    Future per the decision's phase) — exactly how a real backend failure
+    arrives, so the ErrorSwallowing/commit-vote machinery above is
+    exercised unmodified.
+    """
+
+    def __init__(self, comm: Communicator,
+                 schedule: Optional[ChaosSchedule] = None,
+                 endpoint: str = "allreduce") -> None:
+        self._comm = comm
+        self._schedule = schedule
+        self._endpoint = endpoint
+
+    def _sched(self) -> Optional[ChaosSchedule]:
+        return self._schedule if self._schedule is not None else active()
+
+    def _inject(self, op: str, submit) -> Future:
+        sched = self._sched()
+        if sched is None:
+            return submit()
+        d = sched.decide(f"{self._endpoint}:{op}", op)
+        if d is None:
+            return submit()
+        if d.delay_ms > 0:
+            time.sleep(d.delay_ms / 1e3)
+        err = CommunicatorError(
+            f"[chaos] {self._endpoint}/{op}#{d.n}: connection reset by "
+            "peer")
+        if d.fault == "blackhole":
+            time.sleep(d.blackhole_ms / 1e3)
+            raise CommunicatorError(
+                f"[chaos] {self._endpoint}/{op}#{d.n}: black-holed, "
+                "timed out")
+        if d.fault in ("reset", "short"):
+            if d.phase == "pre":
+                raise err
+            fut: Future = Future()
+            fut.set_exception(err)
+            return fut
+        return submit()
+
+    def configure(self, store_addr: str, rank: int,
+                  world_size: int) -> None:
+        self._comm.configure(store_addr, rank, world_size)
+
+    def allreduce(self, tree: Any, op: str = "sum") -> Future:
+        return self._inject("allreduce",
+                            lambda: self._comm.allreduce(tree, op))
+
+    def broadcast(self, tree: Any, root: int = 0) -> Future:
+        return self._inject("broadcast",
+                            lambda: self._comm.broadcast(tree, root))
+
+    def allgather(self, tree: Any) -> Future:
+        return self._inject("allgather",
+                            lambda: self._comm.allgather(tree))
+
+    def size(self) -> int:
+        return self._comm.size()
+
+    def rank(self) -> int:
+        return self._comm.rank()
+
+    @property
+    def wants_device_arrays(self) -> bool:
+        return self._comm.wants_device_arrays
+
+    def set_allreduce_config_fingerprint(self, fp: str) -> None:
+        self._comm.set_allreduce_config_fingerprint(fp)
+
+    def set_retry_policy(self, policy: Any, stats: Any = None) -> None:
+        self._comm.set_retry_policy(policy, stats)
+
+    def shutdown(self) -> None:
+        self._comm.shutdown()

@@ -24,11 +24,23 @@ import os
 import tempfile
 from typing import Any, Optional, Tuple
 
+from torchft_tpu.retry import RetryPolicy, RetryStats, call_with_retry
 from torchft_tpu.serialization import (
     device_put_like,
     iter_pytree_chunks,
     load_pytree_from,
 )
+
+
+def _io_transient(exc: BaseException) -> bool:
+    """Retryable filesystem errors for durable saves: interrupted/flaky
+    IO on network filesystems (EIO, EAGAIN, ESTALE, ETIMEDOUT, EINTR).
+    Deliberately narrow — ENOSPC/EACCES/EROFS must surface immediately."""
+    import errno
+
+    transient = {errno.EIO, errno.EAGAIN, errno.ESTALE, errno.ETIMEDOUT,
+                 errno.EINTR, errno.EBUSY}
+    return (isinstance(exc, OSError) and exc.errno in transient)
 
 
 def save(path: str, user_state: Any, manager_state: Optional[dict] = None,
@@ -92,9 +104,19 @@ class AsyncCheckpointer:
         keep: when > 0, prune all but the newest ``keep`` checkpoint files
             matching ``{prefix}{step}`` in the directory after each
             successful save.
+        retry_policy: when given, transient filesystem errors (EIO /
+            EAGAIN / ESTALE / ETIMEDOUT — the NFS-blip class) retry the
+            whole atomic write under this policy. Safe because the write
+            is temp-file + rename: a failed attempt leaves no partial
+            checkpoint to collide with. ``None`` (default) keeps
+            fail-on-first-error behavior.
+        retry_stats: optional shared :class:`~torchft_tpu.retry.RetryStats`
+            the retries are counted into.
     """
 
-    def __init__(self, keep: int = 0, prefix: str = "ckpt_") -> None:
+    def __init__(self, keep: int = 0, prefix: str = "ckpt_",
+                 retry_policy: Optional[RetryPolicy] = None,
+                 retry_stats: Optional[RetryStats] = None) -> None:
         from concurrent.futures import ThreadPoolExecutor
 
         self._executor = ThreadPoolExecutor(
@@ -103,6 +125,8 @@ class AsyncCheckpointer:
         self._error: Optional[BaseException] = None
         self._keep = keep
         self._prefix = prefix
+        self._retry_policy = retry_policy
+        self._retry_stats = retry_stats
 
     def _raise_pending_error(self) -> None:
         if self._error is not None:
@@ -122,7 +146,13 @@ class AsyncCheckpointer:
 
         def write() -> str:
             try:
-                save(path, snap_user, snap_mgr)
+                if self._retry_policy is not None:
+                    call_with_retry(
+                        lambda: save(path, snap_user, snap_mgr),
+                        self._retry_policy, classify=_io_transient,
+                        stats=self._retry_stats, op="ckpt.save")
+                else:
+                    save(path, snap_user, snap_mgr)
                 if self._keep > 0:
                     self._prune(os.path.dirname(os.path.abspath(path)))
                 return path

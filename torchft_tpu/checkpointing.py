@@ -49,6 +49,8 @@ from typing import Any, Callable, Optional, Tuple, TypeVar
 import jax
 import jax.numpy as jnp
 
+from torchft_tpu import chaos
+from torchft_tpu.retry import RetryPolicy, RetryStats, call_with_retry
 from torchft_tpu.utils import advertise_host
 from torchft_tpu.serialization import (
     device_put_like,
@@ -307,25 +309,49 @@ class CheckpointServer:
                           timeout_sec: float = 300.0,
                           device_put: bool = True,
                           stats: Optional[dict] = None,
-                          auth_token: Optional[str] = None) -> T:
+                          auth_token: Optional[str] = None,
+                          retry_policy: Optional[RetryPolicy] = None,
+                          retry_stats: Optional[RetryStats] = None) -> T:
         """Fetch a peer's live checkpoint and restore it into ``target``'s
         structure (and shardings, when ``device_put``). Streams: each leaf
         is read off the socket into a preallocated buffer and device_put
         before the next is read — healing never buffers the full payload.
+
+        Transient transport failures (connection reset mid-stream, a
+        truncated body, a refused dial while the donor restarts its
+        server) retry under ``retry_policy`` with backoff; each attempt
+        restarts the fetch from scratch, which is safe because the donor
+        serves an immutable per-step snapshot. Step/auth refusals (400 /
+        401 / 503) are fatal and surface immediately. Chaos injection
+        (endpoint ``heal``) wraps both the dial and the streamed body.
 
         ``stats``, when given, is filled with ``{"bytes": <payload size>}``
         so callers (Manager metrics) can report transfer volume without
         re-parsing logs."""
         logger.info("fetching checkpoint from %s", address)
         t0 = time.perf_counter()
-        req = urllib.request.Request(address)
-        if auth_token is not None:
-            req.add_header("Authorization", f"Bearer {auth_token}")
-        with urllib.request.urlopen(req, timeout=timeout_sec) as resp:
-            nbytes = int(resp.headers.get("Content-Length", 0))
-            out = load_pytree_from(
-                resp, target,
-                device_put_fn=device_put_like if device_put else None)
+
+        def fetch_once() -> Tuple[T, int]:
+            tok = chaos.begin("heal", "fetch")
+            req = urllib.request.Request(address)
+            if auth_token is not None:
+                req.add_header("Authorization", f"Bearer {auth_token}")
+            with urllib.request.urlopen(req, timeout=timeout_sec) as resp:
+                nbytes = int(resp.headers.get("Content-Length", 0))
+                out = load_pytree_from(
+                    chaos.wrap_reader(resp, "heal"), target,
+                    device_put_fn=device_put_like if device_put else None)
+            chaos.end(tok)
+            return out, nbytes
+
+        # None keeps the pre-existing fail-on-first-error semantics of
+        # this public API (same convention as AsyncCheckpointer); the
+        # Manager opts in by passing its policy.
+        out, nbytes = call_with_retry(
+            fetch_once,
+            retry_policy if retry_policy is not None
+            else RetryPolicy(max_attempts=1),
+            stats=retry_stats, op="heal.fetch")
         dt = time.perf_counter() - t0
         logger.info("checkpoint transfer: %.1f MB in %.2fs (%.0f MB/s)",
                     nbytes / 1e6, dt, nbytes / 1e6 / max(dt, 1e-9))

@@ -89,6 +89,16 @@ class Communicator(ABC):
         disables the check."""
         self.allreduce_config_fingerprint = fp
 
+    def set_retry_policy(self, policy: Any, stats: Any = None) -> None:
+        """Install the owning Manager's transient-error retry policy and
+        shared :class:`~torchft_tpu.retry.RetryStats`, so the backend's
+        own transport retries (ring dial, rendezvous store client)
+        follow the one configured policy and count into
+        ``Manager.metrics()``. Default stores attributes; backends that
+        retry override, and wrappers MUST forward inward."""
+        self.retry_policy = policy
+        self.retry_stats = stats
+
     def shutdown(self) -> None:  # noqa: B027
         pass
 
@@ -224,6 +234,9 @@ class ErrorSwallowingCommunicator(Communicator):
     def set_allreduce_config_fingerprint(self, fp: str) -> None:
         self._comm.set_allreduce_config_fingerprint(fp)
 
+    def set_retry_policy(self, policy: Any, stats: Any = None) -> None:
+        self._comm.set_retry_policy(policy, stats)
+
     def shutdown(self) -> None:
         self._comm.shutdown()
 
@@ -294,6 +307,9 @@ class ManagedCommunicator(Communicator):
 
     def set_allreduce_config_fingerprint(self, fp: str) -> None:
         self._comm.set_allreduce_config_fingerprint(fp)
+
+    def set_retry_policy(self, policy: Any, stats: Any = None) -> None:
+        self._comm.set_retry_policy(policy, stats)
 
     @property
     def wants_device_arrays(self) -> bool:

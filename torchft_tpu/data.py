@@ -398,9 +398,23 @@ class ElasticSampler:
         return perm
 
     def current_slot(self) -> int:
-        """This group's slot of the current step (live quorum state)."""
-        rank = self.manager.participant_rank()
-        return int(self.manager.batches_committed()) + (rank or 0)
+        """This group's slot of the current step (live quorum state).
+
+        Reads ``(participant_rank, batches_committed)`` as one atomic
+        snapshot (``Manager.participant_slot``, taken under the manager's
+        metrics lock) rather than two separate calls: the async quorum
+        thread installs a new rank concurrently with ``step()`` advancing
+        the commit counter, and a torn pair — new rank with the old
+        counter, or vice versa — would silently draw a wrong slot.
+        Duck-typed managers without the snapshot API (test doubles) fall
+        back to the two-read path."""
+        snap = getattr(self.manager, "participant_slot", None)
+        if snap is not None:
+            rank, committed = snap()
+        else:
+            rank = self.manager.participant_rank()
+            committed = self.manager.batches_committed()
+        return int(committed) + (rank or 0)
 
     def indices_for_slot(self, slot: int) -> np.ndarray:
         """Deterministic index batch for any slot of the global stream."""
@@ -464,6 +478,18 @@ class ElasticLoader:
     must ride the user checkpoint): the stream position IS
     ``manager.batches_committed()``, already part of the manager state a
     healer restores, and slot->indices is a pure function of it.
+
+    Residual race window: the slot snapshot is atomic
+    (``Manager.participant_slot`` — no torn rank/counter pair), but it
+    reflects the *last resolved* quorum. A draw taken between
+    ``manager.step()`` and that step's async quorum resolving can use the
+    previous membership's rank; the draw then lands on a slot another
+    group may also draw, or skips one — bounded to AT MOST the one step
+    around a membership change (the same one-step slot-reuse the class
+    docstring's abort semantics already allow, and exactly why
+    ``FTTrainer`` draws the batch *after* joining the quorum). Exactness
+    of resume is unaffected: committed positions derive only from
+    committed counters.
     """
 
     def __init__(self, dataset: Any, sampler: ElasticSampler,

@@ -53,7 +53,8 @@ import jax.numpy as jnp
 
 from torchft_tpu._native import ManagerClient, ManagerServer, Store, StoreClient
 from torchft_tpu.checkpointing import CheckpointServer
-from torchft_tpu.communicator import Communicator
+from torchft_tpu.communicator import Communicator, CommunicatorError
+from torchft_tpu.retry import RetryPolicy, RetryStats
 from torchft_tpu.utils import advertise_host, div_by_count
 
 logger: logging.Logger = logging.getLogger(__name__)
@@ -123,6 +124,17 @@ class Manager:
         checkpoint_bind_host: interface the checkpoint server listens on
             (env ``TORCHFT_CHECKPOINT_BIND``; default all interfaces,
             like the reference — restrict on shared networks).
+        retry_policy: unified transient-error policy
+            (:class:`~torchft_tpu.retry.RetryPolicy`) threaded through the
+            store client, the manager RPC client (quorum /
+            checkpoint_address / should_commit — safe under the server's
+            call_seq idempotency), and the heal checkpoint fetch. Defaults
+            to 3 attempts with exponential backoff + jitter; pass
+            ``RetryPolicy(max_attempts=1)`` to observe raw transport
+            timing. Retry counts/latencies surface in :meth:`metrics` and
+            the manager's ``/metrics.json``; the
+            ``max_consecutive_failures`` fail-fast streak acts as the
+            circuit breaker above this layer.
     """
 
     def __init__(
@@ -148,6 +160,7 @@ class Manager:
         allreduce_wire_dtype: Optional[Any] = None,
         auth_token: Optional[str] = None,
         checkpoint_bind_host: Optional[str] = None,
+        retry_policy: Optional[RetryPolicy] = None,
         _manager_client: Optional[ManagerClient] = None,
     ) -> None:
         self._comm = comm
@@ -203,6 +216,22 @@ class Manager:
             "committed_steps": 0, "aborted_steps": 0,
         }
         self._metrics_lock = threading.Lock()
+        # Unified transient-error retry policy + shared counters for every
+        # transport client this Manager owns (store, manager RPC, heal
+        # fetch). The counters ride metrics()/metrics.json so a degraded-
+        # but-alive transport is visible before the failure-streak circuit
+        # breaker above this layer trips.
+        self._retry_policy = (retry_policy if retry_policy is not None
+                              else RetryPolicy())
+        self._retry_stats = RetryStats()
+        # Hand the policy + shared counters to the communicator we drive:
+        # its own transport retries (ring dial, rendezvous store client)
+        # must follow the one configured policy and show up in metrics()
+        # too. getattr tolerates bare duck-typed comms in tests (same
+        # contract as set_allreduce_config_fingerprint).
+        set_rp = getattr(comm, "set_retry_policy", None)
+        if set_rp is not None:
+            set_rp(self._retry_policy, self._retry_stats)
         # Recent membership/heal/abort events, served with the metrics at
         # the manager's GET /metrics.json (VERDICT r3 missing #3: the
         # reference dashboard answers "what step is everyone on"; this
@@ -213,6 +242,17 @@ class Manager:
         # the training loop spin forever voting False (VERDICT r1 weak #8).
         self._max_consecutive_failures = max_consecutive_failures
         self._quorum_failure_streak = 0
+        # A latched CommunicatorError poisons the communicator: its ring
+        # sockets may be dead even though membership (and so the quorum
+        # id) is unchanged, and without intervention every later
+        # collective would fail forever — a transient reset would wedge
+        # the job as hard as a dead peer. The next quorum round forces a
+        # reconfigure onto a recovery rendezvous prefix derived from
+        # (quorum_id, max_step): max_step is frozen while the ring is
+        # down (no group can commit through a broken collective), so
+        # every poisoned group independently computes the same prefix and
+        # they re-mesh without any extra coordination channel.
+        self._comm_poisoned = False
         # One thread: quorum rounds are strictly ordered per rank (reference
         # manager.py:134).
         self._executor = ThreadPoolExecutor(
@@ -265,7 +305,9 @@ class Manager:
                 "store_addr (or TORCHFT_STORE_ADDR) required for rank != 0"
             )
         self._store_addr = store_addr
-        self._store = StoreClient(store_addr, connect_timeout_ms=timeout_ms)
+        self._store = StoreClient(store_addr, connect_timeout_ms=timeout_ms,
+                                  retry_policy=self._retry_policy,
+                                  retry_stats=self._retry_stats)
 
         self._manager_server = None
         if self._rank == 0:
@@ -289,7 +331,9 @@ class Manager:
             self._replica_id = replica_id or ""
 
         addr = self._store.get(MANAGER_ADDR_KEY, timeout_ms=timeout_ms).decode()
-        self._client = ManagerClient(addr, connect_timeout_ms=timeout_ms)
+        self._client = ManagerClient(addr, connect_timeout_ms=timeout_ms,
+                                     retry_policy=self._retry_policy,
+                                     retry_stats=self._retry_stats)
 
     # ------------------------------------------------------------------ step
 
@@ -315,13 +359,18 @@ class Manager:
             time.sleep(min(0.05 * streak, 1.0))
 
         if self._should_step:
-            self._step += 1
-            # Committed batches advance by how many groups contributed last
-            # step (reference manager.py:312-314).
-            self._batches_committed += self.num_participants()
+            # Under the metrics lock so (participant_rank,
+            # batches_committed) snapshots (participant_slot()) can never
+            # observe a torn pair mid-advance.
+            with self._metrics_lock:
+                self._step += 1
+                # Committed batches advance by how many groups contributed
+                # last step (reference manager.py:312-314).
+                self._batches_committed += self._participating_world_size
 
         self._errored = None
-        self._healing = False
+        with self._metrics_lock:
+            self._healing = False
         self._pending_state_dict = None
         self._ckpt_server.allow_checkpoint(self._step)
 
@@ -332,7 +381,8 @@ class Manager:
                 # Sync mode: state is restored *before* compute, so the
                 # healer participates immediately (reference manager.py:328-332).
                 self._apply_pending_state_dict()
-                self._healing = False
+                with self._metrics_lock:
+                    self._healing = False
 
     # start_quorum is the name later torchft revisions settled on; provide it
     # as an alias so either spelling of the loop works.
@@ -378,38 +428,67 @@ class Manager:
                 f"replica_world_size={q.replica_world_size}); treating as "
                 "a failed quorum round")
 
-        if self._use_async_quorum:
-            # Healers are not at max_step, so they sit out this step
-            # (max_rank is None) and contribute zero grads.
-            self._participating_rank = q.max_rank
-            self._participating_world_size = q.max_world_size
-        else:
-            self._participating_rank = q.replica_rank
-            self._participating_world_size = q.replica_world_size
+        with self._metrics_lock:  # pair with participant_slot() snapshots
+            if self._use_async_quorum:
+                # Healers are not at max_step, so they sit out this step
+                # (max_rank is None) and contribute zero grads.
+                self._participating_rank = q.max_rank
+                self._participating_world_size = q.max_world_size
+            else:
+                self._participating_rank = q.replica_rank
+                self._participating_world_size = q.replica_world_size
 
-        if self._world_size_mode == WorldSizeMode.FIXED_WITH_SPARES:
-            # Clamp the arithmetic world; surplus groups become warm spares
-            # with zeroed contributions (reference manager.py:362-370).
-            self._participating_world_size = min(
-                self._participating_world_size, self._min_replica_size
-            )
-            if (
-                self._participating_rank is not None
-                and self._participating_rank >= self._min_replica_size
-            ):
-                self._participating_rank = None
+            if self._world_size_mode == WorldSizeMode.FIXED_WITH_SPARES:
+                # Clamp the arithmetic world; surplus groups become warm
+                # spares with zeroed contributions (reference
+                # manager.py:362-370).
+                self._participating_world_size = min(
+                    self._participating_world_size, self._min_replica_size
+                )
+                if (
+                    self._participating_rank is not None
+                    and self._participating_rank >= self._min_replica_size
+                ):
+                    self._participating_rank = None
 
-        if q.quorum_id != self._quorum_id:
-            # Membership changed: rebuild the cross-group communicator from a
-            # store prefix unique to (quorum, local rank) so stragglers from
-            # an old quorum cannot cross-talk (reference manager.py:372-377).
-            store_prefixed = (
-                f"{q.store_address}/torchft/{q.quorum_id}/{self._rank}"
-            )
+        # Rebuild the communicator when membership changed — OR when a
+        # collective error poisoned the current ring: its sockets may be
+        # dead with the quorum id unchanged (transient reset, both peers
+        # alive), and without a rebuild every later collective would fail
+        # forever. Membership change uses the plain per-quorum prefix
+        # (every member sees the same id change). A poisoned same-quorum
+        # rebuild rendezvouses under a recovery prefix keyed by
+        # (quorum_id, max_step): a broken ring breaks the SAME collective
+        # for every member (it is a cycle), so they all abort, all
+        # poison, and — since no group can commit through the broken ring
+        # — all observe the same frozen max_step and meet at the same
+        # prefix. A member whose collective happened to complete before
+        # the break poisons one step later and joins the same rendezvous
+        # (its max_step is still the frozen one); stragglers stalled on a
+        # ring timeout arrive within their timeout and re-join the same
+        # keys, which later attempts simply overwrite.
+        poisoned = self._comm_poisoned
+        # Recovery rendezvous only when the quorum is UNCHANGED: a
+        # membership change already forces every member onto the new
+        # plain per-quorum prefix, and mixing the two spellings would
+        # split the rendezvous.
+        recovery = poisoned and q.quorum_id == self._quorum_id
+        if q.quorum_id != self._quorum_id or recovery:
+            if recovery:
+                store_prefixed = (
+                    f"{q.store_address}/torchft/{q.quorum_id}"
+                    f".r{q.max_step}/{self._rank}"
+                )
+            else:
+                store_prefixed = (
+                    f"{q.store_address}/torchft/{q.quorum_id}/{self._rank}"
+                )
             logger.info(
-                "%s reconfiguring communicator: quorum_id=%d rank=%d world=%d",
+                "%s reconfiguring communicator: quorum_id=%d rank=%d "
+                "world=%d%s",
                 self._replica_id, q.quorum_id, q.replica_rank,
                 q.replica_world_size,
+                " (ring poisoned; recovery rendezvous)" if recovery else "",
             )
             # Fail fast on allreduce-config skew: the bucketed host
             # allreduce derives its bucket schedule from per-Manager config
@@ -431,18 +510,23 @@ class Manager:
                 store_prefixed, q.replica_rank, q.replica_world_size
             )
             self._quorum_id = q.quorum_id
+            # Only after configure SUCCEEDS: a failed recovery rendezvous
+            # (peers not there yet) must leave the poison set so the next
+            # round tries again.
+            self._comm_poisoned = False
             self._record(reconfigure_count=1, reconfigure_ms_total=(
                 time.perf_counter() - reconf_t0) * 1e3)
             self._log_event(
                 event="reconfigure", step=self._step,
                 quorum_id=q.quorum_id, rank=q.replica_rank,
-                world=q.replica_world_size,
+                world=q.replica_world_size, recovery=recovery,
             )
 
         if q.heal:
             # We are lagging (or a fresh step-1 non-primary): fetch the
             # primary's live weights (reference manager.py:380-396).
-            self._healing = True
+            with self._metrics_lock:
+                self._healing = True
             self._record(heal_count=1)
             logger.info(
                 "%s healing from %s at step %d",
@@ -454,6 +538,8 @@ class Manager:
                 primary = ManagerClient(
                     q.recover_manager_address,
                     connect_timeout_ms=self._timeout_ms,
+                    retry_policy=self._retry_policy,
+                    retry_stats=self._retry_stats,
                 )
                 ckpt_addr = primary.checkpoint_address(
                     self._rank, timeout_ms=self._timeout_ms
@@ -463,7 +549,9 @@ class Manager:
                     Dict[str, Any],
                     CheckpointServer.load_from_address(
                         ckpt_addr, target, stats=heal_stats,
-                        auth_token=self._auth_token),
+                        auth_token=self._auth_token,
+                        retry_policy=self._retry_policy,
+                        retry_stats=self._retry_stats),
                 )
             finally:
                 # Failed heals count too: without this, an aborted fetch's
@@ -951,7 +1039,17 @@ class Manager:
 
     def report_error(self, e: Exception) -> None:
         """Latch a step-local error; the step will abstain from committing
-        (reference ``manager.py:250-269``)."""
+        (reference ``manager.py:250-269``).
+
+        A :class:`CommunicatorError` additionally poisons the
+        communicator: the ring's sockets may be dead even though
+        membership is unchanged, so the next quorum round forces a
+        rebuild (see ``_comm_poisoned`` in ``__init__``). Other errors
+        (quorum timeouts, heal failures) leave the ring alone — forcing a
+        lone group into a rebuild its peers don't know about would stall
+        it against their healthy ring."""
+        if isinstance(e, CommunicatorError):
+            self._comm_poisoned = True
         if self._errored is None:
             self._errored = e
 
@@ -1008,9 +1106,14 @@ class Manager:
         committed/aborted step counts. The reference exposes only
         current_step/batches_committed (``manager.py:484-506``); this answers
         the operational questions those can't (how long do quorums take, how
-        often do we heal/abort)."""
+        often do we heal/abort). Includes the transport retry counters
+        (``retry_count`` / ``retry_ms_total`` / ``retry_giveups``) shared
+        by this Manager's store / manager-RPC / heal clients, so degraded
+        transports are observable while retries still absorb them."""
         with self._metrics_lock:
-            return dict(self._metrics)
+            out = dict(self._metrics)
+        out.update(self._retry_stats.snapshot())
+        return out
 
     # ----------------------------------------------------------- state dicts
 
@@ -1026,8 +1129,9 @@ class Manager:
         }
 
     def load_state_dict(self, state_dict: Dict[str, int]) -> None:
-        self._step = int(state_dict["step"])
-        self._batches_committed = int(state_dict["batches_committed"])
+        with self._metrics_lock:  # pair with participant_slot() snapshots
+            self._step = int(state_dict["step"])
+            self._batches_committed = int(state_dict["batches_committed"])
 
     # ------------------------------------------------------------- accessors
 
@@ -1043,6 +1147,25 @@ class Manager:
         if self._participating_rank is None or self._healing:
             return None
         return self._participating_rank
+
+    def participant_slot(self) -> tuple:
+        """Atomic ``(participant_rank, batches_committed)`` snapshot.
+
+        Both halves are written under the metrics lock (``step()`` bumps
+        the commit counter, the quorum thread installs the new rank), so
+        unlike calling :meth:`participant_rank` and
+        :meth:`batches_committed` back to back, this can never observe a
+        torn pair — e.g. the new rank with the previous step's counter —
+        which would make :class:`~torchft_tpu.data.ElasticSampler` draw a
+        wrong slot. The snapshot is still only as current as the last
+        quorum the async thread resolved (see ElasticSampler's
+        membership-change note)."""
+        with self._metrics_lock:
+            if self._participating_rank is None or self._healing:
+                rank: Optional[int] = None
+            else:
+                rank = self._participating_rank
+            return rank, self._batches_committed
 
     def is_participating(self) -> bool:
         """False while healing (async) or benched as a spare (reference

@@ -1,0 +1,42 @@
+"""``jax.shard_map`` compatibility shim.
+
+Callers always use the new spelling (top-level ``shard_map`` with a
+``check_vma`` kwarg); this module adapts to whatever the installed jax
+provides. The adaptation is keyed on the function's actual signature,
+not its import location: there are jax releases where the top-level
+export exists but still spells the knob ``check_rep``.
+"""
+
+from __future__ import annotations
+
+import functools
+import inspect
+
+try:
+    from jax import shard_map as _shard_map  # type: ignore[attr-defined]
+except ImportError:  # jax < 0.4.42 family
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+
+def _accepts_check_vma(fn) -> bool:
+    try:
+        params = inspect.signature(fn).parameters
+    except (TypeError, ValueError):  # C accelerated / exotic wrapper
+        return True  # assume modern; a TypeError would surface loudly
+    if any(p.kind is inspect.Parameter.VAR_KEYWORD
+           for p in params.values()):
+        return True
+    return "check_vma" in params
+
+
+if _accepts_check_vma(_shard_map):
+    shard_map = _shard_map
+else:
+    @functools.wraps(_shard_map)
+    def shard_map(*args, check_vma=None, **kwargs):  # type: ignore[misc]
+        if check_vma is not None:
+            kwargs.setdefault("check_rep", check_vma)
+        return _shard_map(*args, **kwargs)
+
+
+__all__ = ["shard_map"]

@@ -30,11 +30,34 @@ def force_cpu_devices(n: int) -> None:
     env vars alone are ineffective: drops any initialized backends and
     re-creates the CPU client with ``jax_num_cpu_devices=n``. Used by the
     test suite and the multi-chip dry run."""
+    import re
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    xla_flags = os.environ.get("XLA_FLAGS", "")
+    flag = f"--xla_force_host_platform_device_count={n}"
+    if "xla_force_host_platform_device_count" in xla_flags:
+        # REPLACE a pre-existing count rather than keep it: on jax
+        # releases where the env flag is the only mechanism (no
+        # jax_num_cpu_devices option), silently preserving e.g. "=2"
+        # would leave the suite on the wrong device count and fail
+        # sharded tests far from the cause.
+        xla_flags = re.sub(
+            r"--xla_force_host_platform_device_count=\d+", flag, xla_flags)
+        os.environ["XLA_FLAGS"] = xla_flags
+    else:
+        os.environ["XLA_FLAGS"] = f"{xla_flags} {flag}".strip()
+
     import jax
     from jax.extend.backend import clear_backends
 
     clear_backends()
-    jax.config.update("jax_num_cpu_devices", n)
+    try:
+        jax.config.update("jax_num_cpu_devices", n)
+    except AttributeError:
+        # Older jax (< 0.4.34 family) has no jax_num_cpu_devices option;
+        # there the XLA_FLAGS env var set above is honored when the CPU
+        # client is (re)created after clear_backends().
+        pass
     jax.config.update("jax_platforms", "cpu")
 
 
