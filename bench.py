@@ -314,10 +314,21 @@ def bench_multigroup(n_groups: int = 2, steps: int = 20,
             "steps_per_s": steps / dt,
             "allreduce_ms_avg": avg_ms("allreduce_ms_total"),
             "fetch_ms_avg": avg_ms("allreduce_fetch_ms_total"),
+            # Fetch split: dispatch (kicking off packs + async D2H) vs
+            # wait (blocked on DMA) — a fetch-bound profile is only
+            # actionable once you know which half it is.
+            "fetch_dispatch_ms_avg":
+                avg_ms("allreduce_fetch_dispatch_ms_total"),
+            "fetch_wait_ms_avg": avg_ms("allreduce_fetch_wait_ms_total"),
             "ring_ms_avg": avg_ms("allreduce_ring_ms_total"),
             "put_ms_avg": avg_ms("allreduce_put_ms_total"),
             "wire_mbytes_per_step": avg_ms("allreduce_wire_bytes_total")
             / 1e6,
+            # Bytes that crossed the TCP ring (vs D2H above): halved by
+            # bf16 wire at 2 groups now that the narrow dtype rides
+            # end-to-end.
+            "ring_wire_mbytes_per_step":
+                avg_ms("allreduce_ring_wire_bytes_total") / 1e6,
         }
         trainer.shutdown()
 
@@ -339,10 +350,13 @@ def bench_multigroup(n_groups: int = 2, steps: int = 20,
         "grad_mbytes": n_params * 4 / 1e6,
         "stages_ms": {
             "fetch": med["fetch_ms_avg"],
+            "fetch_dispatch": med["fetch_dispatch_ms_avg"],
+            "fetch_wait": med["fetch_wait_ms_avg"],
             "ring": med["ring_ms_avg"],
             "put": med["put_ms_avg"],
         },
         "wire_mbytes_per_step": med["wire_mbytes_per_step"],
+        "ring_wire_mbytes_per_step": med["ring_wire_mbytes_per_step"],
     }
 
 
@@ -801,6 +815,8 @@ def main() -> None:
            "speedup_vs_exact": round(mw["steps_per_s"]
                                      / max(mg["steps_per_s"], 1e-9), 2),
            "wire_mbytes_per_step": round(mw["wire_mbytes_per_step"], 2),
+           "ring_wire_mbytes_per_step":
+               round(mw["ring_wire_mbytes_per_step"], 2),
            "stages_ms": stages(mw)})
 
     # ~8.6MB gradient point (hidden=1024, depth=3): big enough that 2MB
@@ -824,6 +840,8 @@ def main() -> None:
            "speedup_vs_exact": round(
                mwb["steps_per_s"] / max(mb["steps_per_s"], 1e-9), 2),
            "wire_mbytes_per_step": round(mwb["wire_mbytes_per_step"], 2),
+           "ring_wire_mbytes_per_step":
+               round(mwb["ring_wire_mbytes_per_step"], 2),
            "stages_ms": stages(mwb)})
 
     mm = bench_multigroup(backend="mesh")

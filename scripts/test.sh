@@ -34,6 +34,19 @@ if [[ "${1:-}" == "nightly" ]]; then
     exit 0
 fi
 
+# Bench-smoke tier: the bench's allreduce A/B scenarios at tiny sizes as
+# a fast regression gate for the pipelined host allreduce — single-shot
+# vs bucketed, bf16 wire byte halving on both legs, and a chaos-enabled
+# variant (TORCHFT_CHAOS short reads through the wire ring's segment
+# upcast). bench_smoke tests are also marked `slow`, so tier-1 per-commit
+# time is unaffected; run this tier on allreduce/bench changes.
+if [[ "${1:-}" == "bench-smoke" ]]; then
+    stage bench-smoke env JAX_PLATFORMS=cpu \
+        python -m pytest tests/test_bench_smoke.py -q -m bench_smoke
+    echo "== total: ${SECONDS}s"
+    exit 0
+fi
+
 stage core bash -c '
     cmake -B torchft_tpu/_core/build -S torchft_tpu/_core -G Ninja \
         -DCMAKE_BUILD_TYPE=Release >/dev/null

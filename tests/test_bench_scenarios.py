@@ -9,8 +9,13 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 import pytest
 
+import conftest  # noqa: E402
 from bench import (bench_diloco, bench_long_context,  # noqa: E402
                    bench_multigroup, bench_recovery, bench_transformer)
+
+# The multi-group scenarios need the native control plane (Lighthouse /
+# Store); skip cleanly where no toolchain can build it.
+requires_native = conftest.requires_native()
 
 
 # Multi-group lighthouse/manager scenarios: integration tier.
@@ -18,16 +23,28 @@ pytestmark = pytest.mark.integration
 
 
 class TestBenchScenarios:
+    @requires_native
     def test_multigroup_traffic(self):
         out = bench_multigroup(n_groups=2, steps=3, hidden=32)
         assert out["steps_per_s"] > 0
         # Real cross-group traffic must have been measured.
         assert out["allreduce_ms_avg"] > 0
         assert out["grad_mbytes"] > 0
-        # Stage attribution must be populated on the host path (fetch can
-        # measure ~0ms at this tiny size, but the ring ran for real).
-        assert out["stages_ms"]["ring"] > 0
+        # Stage attribution must be populated on the host path (the
+        # fetch halves can measure ~0ms at this tiny size, but the ring
+        # ran for real). Fetch is asserted through its dispatch/wait
+        # split — the aggregate is just their sum and the split is what
+        # makes a fetch-bound profile actionable.
+        stages = out["stages_ms"]
+        assert stages["ring"] > 0
+        assert stages["fetch_dispatch"] >= 0
+        assert stages["fetch_wait"] >= 0
+        assert stages["fetch"] >= max(stages["fetch_dispatch"],
+                                      stages["fetch_wait"])
         assert out["wire_mbytes_per_step"] > 0
+        # Bytes crossed the TCP ring for real too (exact mode: same
+        # payload both legs at 2 groups).
+        assert out["ring_wire_mbytes_per_step"] > 0
 
     def test_rig_probes(self):
         from bench import bench_rig_probes
@@ -36,6 +53,7 @@ class TestBenchScenarios:
         assert out["h2d_mb_s"] > 0
         assert out["dispatch_ms"] > 0
 
+    @requires_native
     def test_multigroup_mesh_backend(self):
         out = bench_multigroup(n_groups=2, steps=3, hidden=32,
                                backend="mesh")
@@ -43,6 +61,7 @@ class TestBenchScenarios:
         assert out["steps_per_s"] > 0
         assert out["allreduce_ms_avg"] > 0
 
+    @requires_native
     def test_diloco_rate(self):
         out = bench_diloco(n_groups=2, sync_every=4, rounds=2, hidden=32)
         assert out["inner_steps_per_s"] > 0
@@ -58,6 +77,7 @@ class TestBenchScenarios:
         assert out["tokens_per_s"] > 0
         assert out["ms_per_fwd_bwd"] > 0
 
+    @requires_native
     def test_recovery_guarantees(self):
         kill_at = 3
         out = bench_recovery(kill_at=kill_at, total_steps=12, hidden=16)

@@ -1,0 +1,85 @@
+"""Bench-smoke tier: the bench's allreduce A/B scenarios at tiny sizes
+under ``JAX_PLATFORMS=cpu`` (conftest forces it), as a fast regression
+gate for the pipelined host allreduce — run via ``scripts/test.sh
+bench-smoke``. Includes a chaos-enabled variant driving ``TORCHFT_CHAOS``
+short-read faults through the wire-dtype segment-upcast path (the ring
+recovers via the poison/recovery rendezvous from the chaos PR and the
+run still completes).
+
+Marked ``bench_smoke`` + ``slow`` so the tier-1 per-commit suite's wall
+clock is unaffected.
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+import conftest  # noqa: E402
+
+requires_native = conftest.requires_native()
+
+pytestmark = [pytest.mark.bench_smoke, pytest.mark.slow]
+
+
+@requires_native
+class TestAllreduceABSmoke:
+    def _mg(self, **kw):
+        from bench import bench_multigroup
+
+        base = dict(n_groups=2, steps=2, hidden=48)
+        base.update(kw)
+        return bench_multigroup(**base)
+
+    def test_single_shot_vs_bucketed(self):
+        single = self._mg(bucket_bytes=1 << 40)
+        bucketed = self._mg(bucket_bytes=4096)
+        for out in (single, bucketed):
+            assert out["steps_per_s"] > 0
+            stages = out["stages_ms"]
+            assert stages["ring"] > 0
+            assert stages["fetch_dispatch"] >= 0
+            assert stages["fetch_wait"] >= 0
+            assert np.isfinite(out["allreduce_ms_avg"])
+        # Same gradient, same exact numerics: both move the same bytes
+        # per step on both legs regardless of bucketing.
+        assert bucketed["wire_mbytes_per_step"] == pytest.approx(
+            single["wire_mbytes_per_step"], rel=0.01)
+        assert bucketed["ring_wire_mbytes_per_step"] == pytest.approx(
+            single["ring_wire_mbytes_per_step"], rel=0.01)
+
+    def test_bf16_wire_halves_both_legs(self):
+        import jax.numpy as jnp
+
+        exact = self._mg(bucket_bytes=4096)
+        wire = self._mg(bucket_bytes=4096, wire_dtype=jnp.bfloat16)
+        assert wire["steps_per_s"] > 0
+        # The MLP gradient is all-f32, so bf16 wire must halve BOTH the
+        # D2H leg and — now that the narrow dtype rides the ring
+        # end-to-end — the TCP leg.
+        assert wire["wire_mbytes_per_step"] == pytest.approx(
+            exact["wire_mbytes_per_step"] / 2, rel=0.02)
+        assert wire["ring_wire_mbytes_per_step"] == pytest.approx(
+            exact["ring_wire_mbytes_per_step"] / 2, rel=0.02)
+
+    def test_chaos_short_read_on_wire_ring(self):
+        """A seeded short-read fault injected into the ring's data plane
+        lands mid-collective in the wire path's segment upcast loop; the
+        step aborts cleanly, the poisoned ring rebuilds on the recovery
+        rendezvous, and the run still commits every requested step."""
+        import jax.numpy as jnp
+
+        from torchft_tpu import chaos
+
+        chaos.install(chaos.parse_spec(
+            "seed=7;ring:short_rate=0.05,max_faults=1"))
+        try:
+            out = self._mg(steps=3, bucket_bytes=4096,
+                           wire_dtype=jnp.bfloat16)
+            assert out["steps_per_s"] > 0
+            assert out["ring_wire_mbytes_per_step"] > 0
+        finally:
+            chaos.uninstall()

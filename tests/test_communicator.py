@@ -35,6 +35,29 @@ class TestDummy:
         assert d.allgather_count == 1
         assert d.size() == 3 and d.rank() == 1
 
+    def test_allreduce_wire_default_upcasts(self):
+        """The ABC's default allreduce_wire upcasts wire buffers to the
+        accumulator dtype locally and rides allreduce — backends without
+        a wire-aware transport keep working (compression then only thins
+        the D2H leg, the pre-wire-ring behavior)."""
+        import jax.numpy as jnp
+
+        d = DummyCommunicator(rank=0, world_size=2)
+        wire = np.arange(4, dtype=np.float32).astype(jnp.bfloat16)
+        exact = np.arange(3, dtype=np.float32)
+        out = d.allreduce_wire([wire, exact],
+                               ["float32", "float32"]).result()
+        assert d.allreduce_count == 1
+        assert out[0].dtype == np.float32
+        np.testing.assert_array_equal(out[0],
+                                      np.arange(4, dtype=np.float32))
+        # Already-accumulator-dtype buffers pass through without a copy
+        # (ravel/astype may re-wrap, but never duplicate the data).
+        assert np.shares_memory(out[1], exact)
+
+    def test_ring_bytes_default_zero(self):
+        assert DummyCommunicator().ring_bytes_total() == 0.0
+
 
 class _FailingComm(Communicator):
     """Raises on every collective (sync or async depending on mode)."""
@@ -88,6 +111,37 @@ class TestErrorSwallowing:
         comm.configure("addr/p", 0, 2)
         assert comm.error() is None
 
+    @pytest.mark.parametrize("sync_raise", [True, False])
+    def test_allreduce_wire_swallows_to_upcast_fallback(self, sync_raise):
+        """allreduce_wire failures swallow like allreduce's: the caller
+        gets the locally-upcast contributions back (structure preserved,
+        values = this rank's own), and the error latches."""
+        comm = ErrorSwallowingCommunicator(_FailingComm(sync_raise))
+        wire = np.arange(5, dtype=np.float32)
+        out = comm.allreduce_wire([wire], ["float32"]).result(timeout=5)
+        assert isinstance(comm.error(), CommunicatorError)
+        np.testing.assert_array_equal(out[0], wire)
+
+    def test_wire_contract_forwarded_inward(self):
+        """Wrappers must forward allreduce_wire / ring_bytes_total to the
+        wrapped backend — a wrapper falling back to the ABC default would
+        silently upcast before the ring and double the wire bytes."""
+        calls = {}
+
+        class Inner(DummyCommunicator):
+            def allreduce_wire(self, buffers, orig_dtypes, op="sum"):
+                calls["wire"] = (len(list(buffers)), list(orig_dtypes))
+                return super().allreduce_wire(buffers, orig_dtypes, op)
+
+            def ring_bytes_total(self):
+                return 123.0
+
+        comm = ErrorSwallowingCommunicator(Inner())
+        comm.allreduce_wire([np.ones(2, np.float32)],
+                            ["float32"]).result(timeout=5)
+        assert calls["wire"] == (1, ["float32"])
+        assert comm.ring_bytes_total() == 123.0
+
 
 def _run_ranks(world_size, fn):
     """Run fn(rank) in world_size threads; propagate the first exception."""
@@ -113,6 +167,11 @@ def _run_ranks(world_size, fn):
 
 @pytest.fixture
 def store():
+    import conftest
+
+    if not conftest.native_available():
+        pytest.skip("native control-plane library unavailable "
+                    "(no C++ toolchain)")
     s = Store(bind="127.0.0.1:0")
     yield s
     s.shutdown()
@@ -646,3 +705,142 @@ class TestMeshCommunicator:
         comms[1].configure("store/q2", 0, 1)  # peer moves to a new quorum
         with pytest.raises(CommunicatorError, match="reconfigured away"):
             fut.result(timeout=30)
+
+
+def _socketpair_rings(world):
+    """Pre-wired rings over socketpairs: pair[i] connects rank i's
+    next-hop to rank (i+1)%world's prev-hop. Exercises the REAL ring
+    transport (sender thread, segmented receive) with no store
+    rendezvous and no native library."""
+    import socket as _socket
+
+    from torchft_tpu.backends.host import _Ring
+
+    pairs = [_socket.socketpair() for _ in range(world)]
+    return [_Ring(pairs[r][0], pairs[(r - 1) % world][1],
+                  _socket.socket())
+            for r in range(world)]
+
+
+class TestWireRingTransport:
+    """The wire-dtype ring itself (backends/host.py _ring_allreduce_wire)
+    over real sockets: one quantization per contribution, canonical-order
+    f32 folds (cross-rank bitwise identity), the byte crossover fallback,
+    and the send-side ring byte counter."""
+
+    def _run(self, world, fn):
+        rings = _socketpair_rings(world)
+        comms = []
+        for r in range(world):
+            c = HostCommunicator(timeout_sec=15)
+            c._rank, c._world = r, world
+            comms.append(c)
+        out = [None] * world
+        errors = []
+
+        def w(r):
+            try:
+                out[r] = fn(comms[r], rings[r], r)
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        ts = [threading.Thread(target=w, args=(r,)) for r in range(world)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=30)
+        alive = [t for t in ts if t.is_alive()]
+        for ring in rings:
+            ring.close()
+        assert not alive, "wire ring deadlocked"
+        assert not errors, errors
+        return out, comms
+
+    def test_world2_one_quantization_and_halved_bytes(self):
+        import jax.numpy as jnp
+
+        bf = np.dtype(jnp.bfloat16)
+        rng = np.random.default_rng(0)
+        x = [rng.normal(size=300_001).astype(np.float32)
+             for _ in range(2)]
+        q = [xi.astype(bf).astype(np.float32) for xi in x]
+
+        out, comms = self._run(2, lambda c, ring, r: c._ring_allreduce_wire(
+            ring, x[r].astype(bf), np.dtype(np.float32)))
+        expected = q[0] + q[1]
+        np.testing.assert_array_equal(out[0], expected)
+        np.testing.assert_array_equal(out[1], expected)
+        # Ring bytes: the full wire buffer once per rank — half the f32
+        # bytes the exact ring would move at world 2.
+        for c in comms:
+            assert c.ring_bytes_total() == x[0].size * bf.itemsize
+            c.shutdown()
+
+    def test_world3_canonical_order_bitwise_across_ranks(self):
+        import jax.numpy as jnp
+
+        bf = np.dtype(jnp.bfloat16)
+        rng = np.random.default_rng(1)
+        x = [rng.normal(size=10_007).astype(np.float32) for _ in range(3)]
+        q = [xi.astype(bf).astype(np.float32) for xi in x]
+
+        out, comms = self._run(3, lambda c, ring, r: c._ring_allreduce_wire(
+            ring, x[r].astype(bf), np.dtype(np.float32)))
+        # Canonical rank-order fold: identical bits on every rank, equal
+        # to the ascending-rank f32 sum of once-quantized contributions.
+        np.testing.assert_array_equal(out[0], (q[0] + q[1]) + q[2])
+        np.testing.assert_array_equal(out[1], out[0])
+        np.testing.assert_array_equal(out[2], out[0])
+        for c in comms:
+            c.shutdown()
+
+    def test_crossover_falls_back_to_exact_ring(self):
+        """Past world*wire > 2*orig the raw-contribution form would cost
+        MORE than the exact ring, so the buffer upcasts locally and takes
+        the standard ring — numerics unchanged (quantization already
+        happened at pack)."""
+        import jax.numpy as jnp
+
+        bf = np.dtype(jnp.bfloat16)
+        x = np.linspace(-2, 2, 5_003).astype(np.float32)
+        q = x.astype(bf).astype(np.float32)
+
+        out, comms = self._run(5, lambda c, ring, r: c._ring_allreduce_wire(
+            ring, x.astype(bf), np.dtype(np.float32)))
+        for o in out:
+            np.testing.assert_allclose(o, 5 * q, rtol=1e-5)
+        # Exact-ring byte signature: ~2*(n-1)/n * f32 bytes per rank —
+        # LESS than the (n-1) * wire bytes raw forwarding would cost at
+        # this world size, which is exactly why it falls back.
+        exact_bytes = 2 * 4 / 5 * x.size * 4
+        gather_bytes = 4 * x.size * bf.itemsize
+        for c in comms:
+            sent = c.ring_bytes_total()
+            assert abs(sent - exact_bytes) < 64  # chunk-boundary slack
+            assert sent < gather_bytes
+            c.shutdown()
+
+    def test_do_allreduce_wire_mixes_exact_and_wire_chunks(self):
+        import jax.numpy as jnp
+
+        bf = np.dtype(jnp.bfloat16)
+        rng = np.random.default_rng(2)
+        x = [rng.normal(size=1_000).astype(np.float32) for _ in range(2)]
+        q = [xi.astype(bf).astype(np.float32) for xi in x]
+        ints = np.arange(7, dtype=np.int64)
+
+        def fn(c, ring, r):
+            return c._do_allreduce_wire(
+                ring,
+                [x[r].copy(), x[r].astype(bf), ints * (r + 1)],
+                [np.dtype(np.float32), np.dtype(np.float32),
+                 np.dtype(np.int64)],
+                "sum")
+
+        out, comms = self._run(2, fn)
+        for o in out:
+            np.testing.assert_array_equal(o[0], x[0] + x[1])  # exact
+            np.testing.assert_array_equal(o[1], q[0] + q[1])  # wire
+            np.testing.assert_array_equal(o[2], ints * 3)     # int exact
+        for c in comms:
+            c.shutdown()

@@ -14,9 +14,12 @@ import jax
 import numpy as np
 import pytest
 
+import conftest
 from torchft_tpu._native import QuorumResult
 from torchft_tpu.communicator import DummyCommunicator
-from torchft_tpu.manager import Manager, WorldSizeMode
+from torchft_tpu.manager import Manager, WorldSizeMode, _derive_schedule
+
+requires_native = conftest.requires_native()
 
 
 def quorum_result(
@@ -433,6 +436,7 @@ class TestNumerics:
         finally:
             m.shutdown()
 
+    @requires_native
     @pytest.mark.parametrize("bucket_bytes", [1, 64, 1 << 20])
     def test_bucketed_matches_single(self, bucket_bytes):
         """The pipelined bucketed host allreduce is numerically identical
@@ -504,12 +508,15 @@ class TestNumerics:
             for o, e in zip(flat_out, flat_exp):
                 np.testing.assert_array_equal(np.asarray(o), e)
 
-    def test_zero_element_leaf_and_host_precision_under_wire(self):
+    @requires_native
+    def test_zero_element_leaf_and_host_leaves_under_wire(self):
         """Two packing edge cases: (1) a 0-element leaf must contribute 0
         to the packed payload geometry (an off-by-one would wedge the
-        ring / break the split); (2) host-native float leaves never cross
-        the D2H link, so wire compression must NOT quantize them — their
-        averaged values stay bitwise full-precision."""
+        ring / break the split); (2) the wire dtype is END-TO-END (the
+        TCP ring carries it too, not just the D2H leg), so host-native
+        float leaves are quantized exactly once like every other
+        contribution — bounded by one bf16 quantization each, and
+        bitwise identical across ranks."""
         import threading as _t
 
         import jax.numpy as jnp
@@ -560,12 +567,25 @@ class TestNumerics:
         store.shutdown()
         assert not alive, "packed allreduce deadlocked on empty leaf"
         assert not errors, errors
+        # One bf16 quantization of each local contribution bounds the
+        # error of the mean: |got - exact| <= (|q(x1)-x1| + |q(x2)-x2|)/2
+        # (evaluated in f64, with an ulp cushion for the f32 fold).
+        x64 = host_leaf.astype(np.float64)
+        q1 = host_leaf.astype(jnp.bfloat16).astype(np.float64)
+        q2 = (host_leaf * 2).astype(jnp.bfloat16).astype(np.float64)
+        bound = (np.abs(q1 - x64) + np.abs(q2 - 2 * x64)) / 2
+        cushion = 1e-6 * (1.0 + np.abs(1.5 * x64))
         for out in results:
             assert out["empty"].shape == (0, 5)
-            # Host-native leaf: exact mean, no bf16 quantization anywhere.
-            np.testing.assert_array_equal(
-                np.asarray(out["host"]), host_leaf * 1.5)
+            got = np.asarray(out["host"]).astype(np.float64)
+            assert np.all(np.abs(got - 1.5 * x64) <= bound + cushion)
+        # Cross-rank bitwise agreement (canonical-order f32 fold).
+        np.testing.assert_array_equal(np.asarray(results[0]["host"]),
+                                      np.asarray(results[1]["host"]))
+        np.testing.assert_array_equal(np.asarray(results[0]["dev"]),
+                                      np.asarray(results[1]["dev"]))
 
+    @requires_native
     def test_bf16_wire_compression_close_to_exact(self):
         """allreduce_wire_dtype=bfloat16 quantizes each local contribution
         once; the sum/scale stay f32, so the result tracks the exact mean
@@ -625,6 +645,77 @@ class TestNumerics:
             got = np.asarray(out["g"])
             np.testing.assert_allclose(got, base * 1.5, rtol=1e-2, atol=1e-2)
 
+    @requires_native
+    def test_wire_ring_matches_upcast_before_ring(self):
+        """The wire-dtype ring must match the upcast-before-ring path it
+        replaced within one bf16 quantization of each local contribution.
+        At world 2 the match is exact: raw bf16 contributions cross the
+        wire once and fold into an f32 accumulator — the same values,
+        sum, and 1/n the old path computed after upcasting on the host —
+        so the results are bitwise identical."""
+        import threading as _t
+
+        import jax.numpy as jnp
+
+        from torchft_tpu._native import Store
+        from torchft_tpu.backends.host import HostCommunicator
+
+        store = Store(bind="127.0.0.1:0")
+        world = 2
+        rng = np.random.default_rng(3)
+        base = rng.normal(size=(513,)).astype(np.float32)
+        results = [None] * world
+        errors = []
+
+        def run(rank):
+            client = MagicMock()
+            client.quorum.return_value = quorum_result(
+                store_address=store.address(),
+                max_rank=rank, max_world_size=world,
+                replica_rank=rank, replica_world_size=world)
+            client.should_commit.return_value = True
+            m = make_manager(
+                client, comm=HostCommunicator(timeout_sec=30),
+                allreduce_wire_dtype=jnp.bfloat16)
+            try:
+                m.step()
+                tree = {"g": jnp.asarray(base * (rank + 1))}
+                results[rank] = m.allreduce(tree).result(timeout=30)
+                assert m.should_commit()
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+            finally:
+                m.shutdown()
+
+        threads = [_t.Thread(target=run, args=(r,)) for r in range(world)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        alive = [t for t in threads if t.is_alive()]
+        store.shutdown()
+        assert not alive, "wire ring deadlocked"
+        assert not errors, errors
+        # Upcast-before-ring expectation: quantize each contribution
+        # once (the device pack's bf16 cast), sum + 1/n in f32.
+        q = [np.asarray(jnp.asarray(base * (r + 1))
+                        .astype(jnp.bfloat16).astype(jnp.float32))
+             for r in range(world)]
+        expected = (q[0] + q[1]) / 2
+        x64 = base.astype(np.float64)
+        exact = 1.5 * x64
+        bound = (np.abs(q[0].astype(np.float64) - x64)
+                 + np.abs(q[1].astype(np.float64) - 2 * x64)) / 2
+        cushion = 1e-6 * (1.0 + np.abs(exact))
+        for out in results:
+            got = np.asarray(out["g"])
+            assert np.dtype(got.dtype) == np.float32
+            np.testing.assert_array_equal(got, expected)
+            diff = np.abs(got.astype(np.float64) - exact)
+            assert np.all(diff <= bound + cushion)
+        np.testing.assert_array_equal(
+            np.asarray(results[0]["g"]), np.asarray(results[1]["g"]))
+
     def test_state_dict_roundtrip(self):
         client = MagicMock()
         client.quorum.return_value = quorum_result()
@@ -640,3 +731,306 @@ class TestNumerics:
             assert m.batches_committed() == 84
         finally:
             m.shutdown()
+
+
+class TestSchedule:
+    """The memoized bucket/chunk schedule: metadata-only derivation (so
+    participant, healer, and spare ranks agree byte-for-byte) and
+    steady-state caching (so later steps skip the Python re-derivation)."""
+
+    METAS = (
+        ((17, 3), "float32"),
+        ((130,), "float32"),
+        ((0, 5), "float32"),
+        ((5,), "float64"),
+        ((6,), "int64"),
+    )
+
+    def test_cross_rank_fingerprint_identical(self):
+        import jax.numpy as jnp
+
+        a = _derive_schedule(self.METAS, 256, jnp.bfloat16)
+        b = _derive_schedule(self.METAS, 256, jnp.bfloat16)
+        assert a.fingerprint == b.fingerprint
+        assert a.buckets == b.buckets
+        for cs_a, cs_b in zip(a.chunks, b.chunks):
+            for ca, cb in zip(cs_a, cs_b):
+                assert (ca.orig, ca.wire, ca.idx, ca.sizes, ca.shapes,
+                        ca.total) == (cb.orig, cb.wire, cb.idx, cb.sizes,
+                                      cb.shapes, cb.total)
+        # Geometry invariants: every leaf appears exactly once; 0-size
+        # leaves contribute 0 elements; chunk totals match their sizes.
+        seen = sorted(i for cs in a.chunks for c in cs for i in c.idx)
+        assert seen == list(range(len(self.METAS)))
+        for cs in a.chunks:
+            for c in cs:
+                assert c.total == sum(c.sizes)
+        flat_sizes = {i: s for cs in a.chunks for c in cs
+                      for i, s in zip(c.idx, c.sizes)}
+        assert flat_sizes[2] == 0  # the (0, 5) leaf
+
+    def test_wire_fields_change_fingerprint(self):
+        import jax.numpy as jnp
+
+        exact = _derive_schedule(self.METAS, 256, None)
+        wire = _derive_schedule(self.METAS, 256, jnp.bfloat16)
+        assert exact.fingerprint != wire.fingerprint
+        # Wire compression narrows float chunks but never int chunks.
+        wire_dtypes = {str(c.wire) for cs in wire.chunks for c in cs}
+        assert "bfloat16" in wire_dtypes
+        assert any(str(c.wire) == "int64" for cs in wire.chunks
+                   for c in cs)
+
+    def test_schedule_cached_across_participant_and_healer_views(self):
+        """Participant (device leaves), healer, and spare (host zero
+        leaves) ranks must land on ONE cached schedule: the cache key is
+        metadata-only, so the same object — hence byte-identical chunk
+        geometry — serves all three roles."""
+        import jax.numpy as jnp
+
+        from torchft_tpu.manager import _zero_like
+
+        client = MagicMock()
+        client.quorum.return_value = quorum_result()
+        client.should_commit.return_value = True
+        m = make_manager(client, allreduce_bucket_bytes=64,
+                         allreduce_wire_dtype=jnp.bfloat16)
+        try:
+            tree = {"a": jnp.ones((9, 3), jnp.float32),
+                    "b": jnp.zeros((40,), jnp.float32)}
+            leaves, treedef = jax.tree_util.tree_flatten(tree)
+            healer_leaves = [_zero_like(x) for x in leaves]
+            s_part = m._get_schedule(treedef, leaves)
+            s_heal = m._get_schedule(treedef, healer_leaves)
+            assert s_part is s_heal  # one cache entry, identical geometry
+            assert m._get_schedule(treedef, leaves) is s_part  # steady state
+        finally:
+            m.shutdown()
+
+
+def _make_test_rings(world):
+    """Socketpair ring for world thread-ranks: pair[i] connects rank i's
+    next-hop to rank (i+1)%world's prev-hop. No store rendezvous, no
+    native control plane — the real ring transport over real sockets."""
+    import socket as _socket
+
+    from torchft_tpu.backends.host import _Ring
+
+    pairs = [_socket.socketpair() for _ in range(world)]
+    return [_Ring(pairs[r][0], pairs[(r - 1) % world][1], _socket.socket())
+            for r in range(world)]
+
+
+def _wired_comm(ring, rank, world):
+    """HostCommunicator with the store rendezvous replaced by a
+    pre-wired ring, so the full pipelined allreduce — pack, async D2H,
+    wire ring, device unpack — runs without the native library."""
+    from torchft_tpu.backends.host import HostCommunicator
+
+    class WiredComm(HostCommunicator):
+        def configure(self, store_addr, rank, world_size):
+            pass  # pre-wired
+
+    c = WiredComm(timeout_sec=15)
+    c._ring, c._rank, c._world = ring, rank, world
+    return c
+
+
+class TestWireRingPipelined:
+    """End-to-end pipelined allreduce over real ring sockets (socketpair
+    transport, mocked control plane): the tier-1 spelling of the
+    numerics guarantees that don't need the native store."""
+
+    def _run(self, world, tree_fn, **mkw):
+        import threading as _t
+
+        rings = _make_test_rings(world)
+        results = [None] * world
+        metrics = [None] * world
+        errors = []
+
+        def run(rank):
+            client = MagicMock()
+            client.quorum.return_value = quorum_result(
+                max_rank=rank, max_world_size=world,
+                replica_rank=rank, replica_world_size=world)
+            client.should_commit.return_value = True
+            m = make_manager(client,
+                             comm=_wired_comm(rings[rank], rank, world),
+                             min_replica_size=world, **mkw)
+            try:
+                m.step()
+                results[rank] = m.allreduce(tree_fn(rank)).result(
+                    timeout=30)
+                err = m.errored()
+                assert err is None, err
+                assert m.should_commit()
+                metrics[rank] = m.metrics()
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+            finally:
+                m.shutdown()
+
+        threads = [_t.Thread(target=run, args=(r,)) for r in range(world)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        alive = [t for t in threads if t.is_alive()]
+        for r in rings:
+            r.close()
+        assert not alive, "pipelined allreduce deadlocked"
+        assert not errors, errors
+        return results, metrics
+
+    BASE = {
+        "a": np.random.default_rng(0).normal(size=(257, 3)).astype(
+            np.float32),
+        "b": np.random.default_rng(1).normal(size=(1000,)).astype(
+            np.float32),
+        "empty": np.zeros((0, 5), np.float32),
+        "i": np.arange(6, dtype=np.int32),
+    }
+
+    def test_exact_mode_bitwise(self):
+        import jax.numpy as jnp
+
+        def tf(rank):
+            return jax.tree_util.tree_map(
+                lambda a: jnp.asarray(a) * (rank + 1), self.BASE)
+
+        results, metrics = self._run(2, tf, allreduce_bucket_bytes=1024)
+        for out in results:
+            for k in ("a", "b"):
+                np.testing.assert_array_equal(
+                    np.asarray(out[k]),
+                    (self.BASE[k] * 1.5).astype(np.float32))
+            assert out["empty"].shape == (0, 5)
+            np.testing.assert_array_equal(np.asarray(out["i"]),
+                                          (self.BASE["i"] * 3) // 2)
+        mx = metrics[0]
+        # Fetch split populated; exact mode moves identical bytes on
+        # both legs (D2H and ring) at world 2.
+        assert mx["allreduce_fetch_dispatch_ms_total"] > 0
+        assert mx["allreduce_fetch_wait_ms_total"] > 0
+        assert mx["allreduce_ring_wire_bytes_total"] == \
+            mx["allreduce_wire_bytes_total"] > 0
+
+    def test_bf16_wire_matches_upcast_path_bitwise_at_world2(self):
+        import jax.numpy as jnp
+
+        def tf(rank):
+            return jax.tree_util.tree_map(
+                lambda a: jnp.asarray(a) * (rank + 1), self.BASE)
+
+        results, metrics = self._run(
+            2, tf, allreduce_bucket_bytes=1024,
+            allreduce_wire_dtype=jnp.bfloat16)
+        for k in ("a", "b"):
+            q = [np.asarray(
+                jnp.ravel(jnp.asarray(self.BASE[k] * (r + 1)))
+                .astype(jnp.bfloat16).astype(jnp.float32))
+                .reshape(self.BASE[k].shape) for r in range(2)]
+            expected = (q[0] + q[1]) / 2
+            # Quantization bound evaluated in f64 (f32 evaluation of the
+            # bound itself would flake on ulps — diff and bound are
+            # mathematically EQUAL here), with an ulp cushion for the
+            # f32 rounding of the accumulator sum.
+            x64 = self.BASE[k].astype(np.float64)
+            exact = 1.5 * x64
+            bound = (np.abs(q[0].astype(np.float64) - x64)
+                     + np.abs(q[1].astype(np.float64) - 2 * x64)) / 2
+            cushion = 1e-6 * (1.0 + np.abs(exact))
+            for out in results:
+                got = np.asarray(out[k])
+                assert np.dtype(got.dtype) == np.float32
+                # Bitwise the upcast-before-ring result, and within one
+                # bf16 quantization per contribution of the exact mean.
+                np.testing.assert_array_equal(got, expected)
+                diff = np.abs(got.astype(np.float64) - exact)
+                assert np.all(diff <= bound + cushion)
+        mx = metrics[0]
+        # Float payload halves on BOTH legs; the int chunk stays wide.
+        float_bytes = sum(self.BASE[k].size * 4 for k in ("a", "b"))
+        int_bytes = self.BASE["i"].size * 4
+        assert mx["allreduce_wire_bytes_total"] == \
+            float_bytes / 2 + int_bytes
+        assert mx["allreduce_ring_wire_bytes_total"] == \
+            float_bytes / 2 + int_bytes
+
+    def test_world3_wire_cross_rank_bitwise(self):
+        import jax.numpy as jnp
+
+        def tf(rank):
+            return {"g": jnp.asarray(self.BASE["b"] * (rank + 1))}
+
+        results, _ = self._run(3, tf, allreduce_wire_dtype=jnp.bfloat16)
+        # Canonical-rank-order fold: all three ranks bitwise identical.
+        g0 = np.asarray(results[0]["g"])
+        np.testing.assert_array_equal(g0, np.asarray(results[1]["g"]))
+        np.testing.assert_array_equal(g0, np.asarray(results[2]["g"]))
+        q = [np.asarray(jnp.asarray(self.BASE["b"] * (r + 1))
+                        .astype(jnp.bfloat16).astype(jnp.float32))
+             for r in range(3)]
+        np.testing.assert_array_equal(g0, ((q[0] + q[1]) + q[2]) / 3)
+
+    def test_healer_gets_averaged_grads_without_contributing(self):
+        import jax.numpy as jnp
+
+        def tf(rank):
+            return {"g": jnp.asarray(self.BASE["b"] * (rank + 1))}
+
+        # Rank 1 is a healer (max_rank None): zero contribution, but it
+        # still receives the participants' average.
+        import threading as _t
+
+        rings = _make_test_rings(2)
+        results = [None] * 2
+        errors = []
+
+        def run(rank):
+            client = MagicMock()
+            client.quorum.return_value = quorum_result(
+                max_rank=(0 if rank == 0 else None), max_world_size=1,
+                replica_rank=rank, replica_world_size=2,
+                heal=(rank == 1))
+            client.should_commit.return_value = True
+            m = make_manager(client,
+                             comm=_wired_comm(rings[rank], rank, 2),
+                             min_replica_size=1)
+            try:
+                m.step()
+                results[rank] = m.allreduce(tf(rank)).result(
+                    timeout=30)
+                err = m.errored()
+                assert err is None, err
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+            finally:
+                m.shutdown()
+
+        state = {"user": {}, "torchft": {"step": 1,
+                                         "batches_committed": 0}}
+        # Patch ONCE on the main thread around both workers: mock.patch
+        # mutates the class attribute, so nested per-thread patching
+        # races on unpatch and can leave the mock installed globally.
+        cp = patch(
+            "torchft_tpu.manager.CheckpointServer.load_from_address",
+            return_value=state)
+        pc = patch("torchft_tpu.manager.ManagerClient")
+        with cp, pc:
+            threads = [_t.Thread(target=run, args=(r,))
+                       for r in range(2)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=60)
+        for r in rings:
+            r.close()
+        assert not errors, errors
+        # Participant world is 1; healer contributed zeros. Both see the
+        # participant's grads unscaled (sum/1).
+        np.testing.assert_array_equal(np.asarray(results[0]["g"]),
+                                      self.BASE["b"])
+        np.testing.assert_array_equal(np.asarray(results[1]["g"]),
+                                      self.BASE["b"])

@@ -28,7 +28,7 @@ import socket
 import struct
 import threading
 from concurrent.futures import Future
-from typing import Any, List, Optional, Tuple
+from typing import Any, List, Optional, Sequence, Tuple
 
 import numpy as np
 import jax
@@ -69,6 +69,13 @@ def _recv_exact_into(sock: socket.socket, view: memoryview) -> None:
 # before the full chunk has crossed the wire; a power of two so every
 # segment boundary is element-aligned for any power-of-two itemsize.
 _SEG_BYTES = 1 << 18  # 256 KB
+
+
+def _as_bytes(arr: np.ndarray) -> memoryview:
+    """Writable byte view of a contiguous array. Routed through a uint8
+    view because numpy's buffer protocol rejects custom dtypes (ml_dtypes
+    bfloat16 — exactly the wire dtype this transport exists to carry)."""
+    return memoryview(arr.view(np.uint8)).cast("B")
 
 
 class _Ring:
@@ -151,6 +158,10 @@ class HostCommunicator(Communicator):
         self._rank = 0
         self._world = 1
         self._ring: Optional[_Ring] = None
+        # Allreduce payload bytes this rank has sent over the ring
+        # (exact + wire paths). Written on the single op-worker thread
+        # only; read via ring_bytes_total() for Manager.metrics().
+        self._ring_bytes = 0.0
         self._epoch = 0
         self._lock = threading.Lock()
         self._ops: "queue.Queue[Optional[Tuple]]" = queue.Queue()
@@ -448,6 +459,8 @@ class HostCommunicator(Communicator):
                         raise CommunicatorError("aborted by reconfigure")
                 if kind == "allreduce":
                     fut.set_result(self._do_allreduce(ring, *args))
+                elif kind == "allreduce_wire":
+                    fut.set_result(self._do_allreduce_wire(ring, *args))
                 elif kind == "broadcast":
                     fut.set_result(self._do_broadcast(ring, *args))
                 elif kind == "allgather":
@@ -465,6 +478,16 @@ class HostCommunicator(Communicator):
         if self._world == 1:
             return self._immediate(tree)
         return self._submit("allreduce", tree, op)
+
+    def allreduce_wire(self, buffers: Sequence[Any],
+                       orig_dtypes: Sequence[Any],
+                       op: str = "sum") -> Future:
+        origs = [np.dtype(d) for d in orig_dtypes]
+        if self._world == 1:
+            return self._immediate([
+                np.ravel(np.asarray(b)).astype(d, copy=False)
+                for b, d in zip(buffers, origs)])
+        return self._submit("allreduce_wire", list(buffers), origs, op)
 
     def broadcast(self, tree: Any, root: int = 0) -> Future:
         if self._world == 1:
@@ -492,8 +515,18 @@ class HostCommunicator(Communicator):
             by_dtype.setdefault(a.dtype.str, []).append(i)
         out: List[Optional[np.ndarray]] = [None] * len(arrs)
         for dtype_str, idxs in by_dtype.items():
-            flat = np.concatenate(
-                [arrs[i].reshape(-1) for i in idxs]) if idxs else None
+            if (len(idxs) == 1 and arrs[idxs[0]].ndim == 1
+                    and arrs[idxs[0]].flags.c_contiguous
+                    and arrs[idxs[0]].flags.writeable):
+                # A single already-contiguous 1-D leaf IS the ring
+                # buffer: skip the redundant np.concatenate memcpy (the
+                # shape every packed-chunk caller hits) and reduce in
+                # place — allowed by the Communicator.allreduce
+                # ownership contract (such leaves are consumed).
+                flat = arrs[idxs[0]]
+            else:
+                flat = np.concatenate(
+                    [arrs[i].reshape(-1) for i in idxs])
             reduced = self._ring_allreduce_buffer(ring, flat)
             if op == "mean":
                 if np.issubdtype(reduced.dtype, np.inexact):
@@ -522,11 +555,11 @@ class HostCommunicator(Communicator):
         """
         n = self._world
         rank = self._rank
-        # Reduces in place: `flat` must be a fresh buffer owned by the
-        # caller's collective (the per-dtype np.concatenate above always
-        # allocates one), so no defensive copy on the hot gradient path.
+        # Reduces in place: `flat` is either a fresh per-dtype concat or
+        # a caller-owned packed chunk (consumed per the allreduce
+        # ownership contract), so no defensive copy on the hot path.
         acc = flat if flat.flags.c_contiguous else np.ascontiguousarray(flat)
-        acc_bytes = memoryview(acc).cast("B")
+        acc_bytes = _as_bytes(acc)
         bounds = np.linspace(0, acc.size, n + 1, dtype=np.int64)
         itemsize = acc.itemsize
 
@@ -546,7 +579,9 @@ class HostCommunicator(Communicator):
             # Chunks of the contiguous 1-D accumulator are contiguous
             # views: the sender streams directly from acc (the chunk being
             # sent is never the one being reduced this step).
-            fut = ring.send_async(chunk_bytes(rank - step))
+            send_view = chunk_bytes(rank - step)
+            self._ring_bytes += len(send_view)
+            fut = ring.send_async(send_view)
             recv_c = chunk(rank - step - 1)
             nbytes = recv_c.size * itemsize
             off = 0
@@ -560,9 +595,112 @@ class HostCommunicator(Communicator):
                 off += k
             fut.result()
         for step in range(n - 1):
-            fut = ring.send_async(chunk_bytes(rank + 1 - step))
+            send_view = chunk_bytes(rank + 1 - step)
+            self._ring_bytes += len(send_view)
+            fut = ring.send_async(send_view)
             _recv_exact_into(ring.prev_sock, chunk_bytes(rank - step))
             fut.result()
+        return acc
+
+    def _do_allreduce_wire(self, ring: Optional[_Ring],
+                           buffers: List[Any], origs: List[np.dtype],
+                           op: str) -> List[np.ndarray]:
+        if ring is None:
+            raise CommunicatorError("communicator not configured")
+        out: List[np.ndarray] = []
+        for buf, orig in zip(buffers, origs):
+            a = np.ravel(np.asarray(buf))
+            if not a.flags.c_contiguous:
+                a = np.ascontiguousarray(a)
+            if a.dtype == orig:
+                if not a.flags.writeable:
+                    # device_get can hand back a read-only view of the
+                    # transfer buffer; the exact ring accumulates in
+                    # place, so that one case pays a copy. (The wire
+                    # path below only ever READS its buffer.)
+                    a = np.array(a)
+                # Uncompressed chunk: the standard in-place exact ring.
+                reduced = self._ring_allreduce_buffer(ring, a)
+            else:
+                reduced = self._ring_allreduce_wire(ring, a, orig)
+            if op == "mean":
+                if np.issubdtype(reduced.dtype, np.inexact):
+                    reduced /= self._world
+                else:
+                    reduced //= self._world
+            out.append(reduced)
+        return out
+
+    def _ring_allreduce_wire(self, ring: _Ring, wire_buf: np.ndarray,
+                             orig: np.dtype) -> np.ndarray:
+        """Wire-dtype ring allreduce: narrow bytes on the TCP ring,
+        full-precision accumulation.
+
+        Raw (pack-time-quantized) contributions — never partial sums —
+        cross the wire, so each rank's contribution is quantized exactly
+        once regardless of world size, and every rank folds them into
+        its accumulator in canonical rank order, keeping results bitwise
+        identical across ranks. The transport is a ring allgather of the
+        raw wire buffers: (world-1) * wire bytes sent per rank, vs the
+        exact ring's 2*(world-1)/world * orig bytes — exactly half at
+        world 2 with a bf16 wire, cheaper through world*wire <= 2*orig.
+        Past that crossover raw forwarding would cost MORE than the
+        exact ring, so the buffer upcasts locally and takes the standard
+        in-place ring instead (numerics unchanged — the one quantization
+        already happened at pack; only the byte saving is forfeited).
+
+        At world 2 the inbound contribution is upcast-folded per
+        received _SEG_BYTES segment, overlapping the wire with the
+        accumulate exactly like the exact ring's reduce-scatter (the
+        segment path TORCHFT_CHAOS short-read faults exercise in the
+        bench-smoke chaos tier).
+        """
+        n, rank = self._world, self._rank
+        wdt = wire_buf.dtype
+        if n * wdt.itemsize > 2 * orig.itemsize:
+            return self._ring_allreduce_buffer(ring, wire_buf.astype(orig))
+        size = wire_buf.size
+        nbytes = size * wdt.itemsize
+        send_view = _as_bytes(np.ascontiguousarray(wire_buf))
+        if n == 2:
+            # One hop: stream my raw wire buffer out while folding the
+            # peer's into the f32 accumulator segment by segment. The
+            # two-term f32 sum is order-insensitive, so both ranks get
+            # bitwise-identical results — and bitwise-identical to the
+            # upcast-before-ring path they replace.
+            acc = wire_buf.astype(orig)
+            self._ring_bytes += nbytes
+            fut = ring.send_async(send_view)
+            scratch = bytearray(min(_SEG_BYTES, max(nbytes, 1)))
+            sv = memoryview(scratch)
+            off = 0
+            while off < nbytes:
+                k = min(_SEG_BYTES, nbytes - off)
+                seg = sv[:k]
+                _recv_exact_into(ring.prev_sock, seg)
+                lo = off // wdt.itemsize
+                acc[lo:lo + k // wdt.itemsize] += np.frombuffer(
+                    seg, dtype=wdt).astype(orig)
+                off += k
+            fut.result()
+            return acc
+        # world 3+ (within the byte crossover): ring-allgather the raw
+        # wire buffers (each step forwards the previously received one),
+        # then fold once in canonical rank order 0..n-1 so every rank
+        # reproduces the identical f32 sum bit for bit.
+        bufs: List[Optional[np.ndarray]] = [None] * n
+        bufs[rank] = wire_buf
+        for step in range(n - 1):
+            self._ring_bytes += nbytes
+            fut = ring.send_async(send_view)
+            recv = np.empty(size, wdt)
+            _recv_exact_into(ring.prev_sock, _as_bytes(recv))
+            fut.result()
+            bufs[(rank - step - 1) % n] = recv
+            send_view = _as_bytes(recv)
+        acc = np.zeros(size, orig)
+        for b in bufs:
+            acc += b.astype(orig)
         return acc
 
     def _do_broadcast(self, ring: Optional[_Ring], tree: Any,
@@ -608,6 +746,9 @@ class HostCommunicator(Communicator):
 
     def rank(self) -> int:
         return self._rank
+
+    def ring_bytes_total(self) -> float:
+        return self._ring_bytes
 
     def shutdown(self) -> None:
         if self._shutdown:

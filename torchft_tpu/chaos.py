@@ -572,6 +572,14 @@ class ChaosCommunicator(Communicator):
         return self._inject("allreduce",
                             lambda: self._comm.allreduce(tree, op))
 
+    def allreduce_wire(self, buffers: Any, orig_dtypes: Any,
+                       op: str = "sum") -> Future:
+        # Own op stream: the wire path's decision sequence stays
+        # reproducible independent of how many plain allreduces ran.
+        return self._inject(
+            "allreduce_wire",
+            lambda: self._comm.allreduce_wire(buffers, orig_dtypes, op))
+
     def broadcast(self, tree: Any, root: int = 0) -> Future:
         return self._inject("broadcast",
                             lambda: self._comm.broadcast(tree, root))
@@ -595,6 +603,9 @@ class ChaosCommunicator(Communicator):
 
     def set_retry_policy(self, policy: Any, stats: Any = None) -> None:
         self._comm.set_retry_policy(policy, stats)
+
+    def ring_bytes_total(self) -> float:
+        return self._comm.ring_bytes_total()
 
     def shutdown(self) -> None:
         self._comm.shutdown()

@@ -34,9 +34,21 @@ from __future__ import annotations
 import logging
 from abc import ABC, abstractmethod
 from concurrent.futures import Future
-from typing import Any, Callable, Optional
+from typing import Any, Callable, List, Optional, Sequence
+
+import numpy as np
 
 logger: logging.Logger = logging.getLogger(__name__)
+
+
+def _upcast_buffers(buffers: Sequence[Any],
+                    orig_dtypes: Sequence[Any]) -> List[np.ndarray]:
+    """Flatten + upcast wire buffers to their accumulator dtypes (the
+    default / fallback spelling of :meth:`Communicator.allreduce_wire`)."""
+    return [
+        np.ravel(np.asarray(b)).astype(np.dtype(d), copy=False)
+        for b, d in zip(buffers, orig_dtypes)
+    ]
 
 
 class CommunicatorError(RuntimeError):
@@ -55,7 +67,43 @@ class Communicator(ABC):
 
     @abstractmethod
     def allreduce(self, tree: Any, op: str = "sum") -> Future:
-        """Sum (or mean) a pytree of numpy arrays across the world."""
+        """Sum (or mean) a pytree of numpy arrays across the world.
+
+        Ownership: leaves that are already contiguous 1-D buffers may be
+        reduced **in place** (backends skip the defensive concat/copy on
+        that hot-path shape) — callers must treat inputs as consumed and
+        use only the resolved result."""
+
+    def allreduce_wire(self, buffers: Sequence[Any],
+                       orig_dtypes: Sequence[Any],
+                       op: str = "sum") -> Future:
+        """Wire-aware allreduce over a flat list of contiguous 1-D numpy
+        buffers (the Manager's packed bucket chunks).
+
+        ``buffers[k]`` holds this rank's contribution already cast to the
+        narrow *wire* dtype (== the accumulator dtype when uncompressed);
+        ``orig_dtypes[k]`` names the full-precision accumulator dtype the
+        reduced result must come back in. Resolves to a list of 1-D numpy
+        arrays in the accumulator dtypes. Buffers are consumed: backends
+        may reduce them in place.
+
+        The default upcasts locally and reuses :meth:`allreduce` — wire
+        compression then only thins the device->host leg, the pre-wire-
+        ring behavior. Byte-counted transports override it to keep the
+        narrow dtype on the TCP ring end-to-end and fold received
+        segments into a full-precision accumulator
+        (:class:`~torchft_tpu.backends.host.HostCommunicator`). Wrappers
+        MUST forward — a wrapper falling back to the default silently
+        doubles the ring bytes."""
+        return self.allreduce(_upcast_buffers(buffers, orig_dtypes), op=op)
+
+    def ring_bytes_total(self) -> float:
+        """Cumulative allreduce payload bytes this rank has *sent* over
+        the collective transport, surfaced by the Manager as
+        ``allreduce_ring_wire_bytes_total`` so wire-compression savings
+        are observable per leg (D2H vs ring). Backends without a
+        byte-counted transport report 0.0; wrappers MUST forward."""
+        return 0.0
 
     @abstractmethod
     def broadcast(self, tree: Any, root: int = 0) -> Future:
@@ -157,7 +205,14 @@ class ErrorSwallowingCommunicator(Communicator):
     This keeps every rank's step structure identical even when collectives
     fail mid-step, deferring the consequence to the commit vote — the
     reference's ``ErrorSwallowingProcessGroupWrapper``
-    (``process_group.py:347-440``)."""
+    (``process_group.py:347-440``).
+
+    The fallback promise is STRUCTURE, not values: per the allreduce
+    ownership contract, contiguous 1-D leaves may have been partially
+    reduced in place by the backend before an in-flight failure, so the
+    swallowed result's values are unspecified — the latched error is the
+    signal that they must be discarded (the Manager's commit vote does
+    exactly that)."""
 
     def __init__(self, comm: Communicator,
                  on_error: Optional[Callable[[Exception], None]] = None):
@@ -180,6 +235,13 @@ class ErrorSwallowingCommunicator(Communicator):
         self._comm.configure(store_addr, rank, world_size)
 
     def _wrap(self, fut: Future, fallback: Any) -> Future:
+        return self._wrap_lazy(fut, lambda: fallback)
+
+    def _wrap_lazy(self, fut: Future,
+                   fallback_fn: Callable[[], Any]) -> Future:
+        """Like :meth:`_wrap` but the fallback is built only on error —
+        so a hot path needn't pre-pay a fallback allocation it will
+        almost never use."""
         out: Future = Future()
 
         def relay(f: Future) -> None:
@@ -188,7 +250,7 @@ class ErrorSwallowingCommunicator(Communicator):
                 out.set_result(f.result())
             else:
                 self.report_error(e)
-                out.set_result(fallback)
+                out.set_result(fallback_fn())
 
         fut.add_done_callback(relay)
         return out
@@ -201,6 +263,28 @@ class ErrorSwallowingCommunicator(Communicator):
         except Exception as e:
             self.report_error(e)
             return _done_future(tree)
+
+    def allreduce_wire(self, buffers: Sequence[Any],
+                       orig_dtypes: Sequence[Any],
+                       op: str = "sum") -> Future:
+        # Fallback built LAZILY at error time: the success path pays no
+        # upcast allocation, and the fallback promises STRUCTURE and
+        # dtypes only — buffers are consumed by the backend, so after an
+        # in-flight failure they may hold partially-reduced values (the
+        # error latch means callers discard them; the Manager aborts the
+        # step at the commit vote).
+        def fallback() -> Any:
+            return _upcast_buffers(buffers, orig_dtypes)
+
+        if self._error is not None:
+            return _done_future(fallback())
+        try:
+            return self._wrap_lazy(
+                self._comm.allreduce_wire(buffers, orig_dtypes, op),
+                fallback)
+        except Exception as e:
+            self.report_error(e)
+            return _done_future(fallback())
 
     def broadcast(self, tree: Any, root: int = 0) -> Future:
         if self._error is not None:
@@ -237,6 +321,9 @@ class ErrorSwallowingCommunicator(Communicator):
     def set_retry_policy(self, policy: Any, stats: Any = None) -> None:
         self._comm.set_retry_policy(policy, stats)
 
+    def ring_bytes_total(self) -> float:
+        return self._comm.ring_bytes_total()
+
     def shutdown(self) -> None:
         self._comm.shutdown()
 
@@ -258,6 +345,10 @@ class ManagedCommunicator(Communicator):
         self._comm.configure(store_addr, rank, world_size)
 
     def _guard(self, fut: Future, fallback: Any) -> Future:
+        return self._guard_lazy(fut, lambda: fallback)
+
+    def _guard_lazy(self, fut: Future,
+                    fallback_fn: Callable[[], Any]) -> Future:
         out: Future = Future()
 
         def relay(f: Future) -> None:
@@ -266,7 +357,7 @@ class ManagedCommunicator(Communicator):
                 out.set_result(f.result())
             else:
                 self._manager.report_error(e)
-                out.set_result(fallback)
+                out.set_result(fallback_fn())
 
         fut.add_done_callback(relay)
         return out
@@ -279,6 +370,25 @@ class ManagedCommunicator(Communicator):
         except Exception as e:
             self._manager.report_error(e)
             return _done_future(tree)
+
+    def allreduce_wire(self, buffers: Sequence[Any],
+                       orig_dtypes: Sequence[Any],
+                       op: str = "sum") -> Future:
+        # Lazy fallback: structure/dtypes only — see
+        # ErrorSwallowingCommunicator.allreduce_wire (the buffers are
+        # consumed by the backend; the error latch aborts the step).
+        def fallback() -> Any:
+            return _upcast_buffers(buffers, orig_dtypes)
+
+        if self._manager.errored() is not None:
+            return _done_future(fallback())
+        try:
+            return self._guard_lazy(
+                self._comm.allreduce_wire(buffers, orig_dtypes, op),
+                fallback)
+        except Exception as e:
+            self._manager.report_error(e)
+            return _done_future(fallback())
 
     def broadcast(self, tree: Any, root: int = 0) -> Future:
         if self._manager.errored() is not None:
@@ -310,6 +420,9 @@ class ManagedCommunicator(Communicator):
 
     def set_retry_policy(self, policy: Any, stats: Any = None) -> None:
         self._comm.set_retry_policy(policy, stats)
+
+    def ring_bytes_total(self) -> float:
+        return self._comm.ring_bytes_total()
 
     @property
     def wants_device_arrays(self) -> bool:

@@ -114,9 +114,13 @@ class Manager:
             host-path allreduce (see :meth:`_host_allreduce_pipelined`);
             smaller buckets overlap more but dispatch more.
         allreduce_wire_dtype: optional narrower float dtype (e.g.
-            ``jnp.bfloat16``) for the device->host leg of the host-path
-            allreduce. Local contributions are quantized once; the ring
-            sum and 1/n run in full precision. ``None`` (default) keeps
+            ``jnp.bfloat16``) carried END-TO-END by the host-path
+            allreduce: the device->host fetch AND the TCP ring both move
+            the narrow dtype (``Communicator.allreduce_wire``), so both
+            legs halve their bytes. Every local float contribution —
+            host-native leaves included — is quantized exactly once; the
+            ring fold and 1/n run in full precision (see
+            docs/design/allreduce_pipeline.md). ``None`` (default) keeps
             the exchange bit-exact.
         auth_token: shared job secret (env ``TORCHFT_AUTH_TOKEN``). When
             set, the checkpoint server requires it as a bearer token (and
@@ -209,8 +213,16 @@ class Manager:
             # Stage breakdown of the pipelined host allreduce (cumulative
             # BUSY ms per stage; stages overlap across buckets, so sums
             # can exceed allreduce_ms_total — they attribute, not
-            # partition). wire_bytes counts what actually crossed D2H.
-            "allreduce_fetch_ms_total": 0.0, "allreduce_ring_ms_total": 0.0,
+            # partition). fetch = dispatch + wait: dispatch is the cost
+            # of kicking off packs + async D2H copies, wait is the time
+            # blocked on DMA completion. wire_bytes counts what actually
+            # crossed D2H; the ring leg's bytes
+            # (allreduce_ring_wire_bytes_total) come from the backend's
+            # own send counter and are merged in metrics().
+            "allreduce_fetch_ms_total": 0.0,
+            "allreduce_fetch_dispatch_ms_total": 0.0,
+            "allreduce_fetch_wait_ms_total": 0.0,
+            "allreduce_ring_ms_total": 0.0,
             "allreduce_put_ms_total": 0.0, "allreduce_wire_bytes_total": 0.0,
             "commit_count": 0, "commit_ms_total": 0.0,
             "committed_steps": 0, "aborted_steps": 0,
@@ -264,6 +276,10 @@ class Manager:
         self._put_executor = ThreadPoolExecutor(
             max_workers=1, thread_name_prefix="allreduce_put"
         )
+        # Memoized bucket/chunk schedules for the host allreduce, keyed
+        # by (treedef, leaf metadata, bucket_bytes, wire_dtype) — see
+        # _get_schedule().
+        self._sched_cache: Dict[tuple, _AllreduceSchedule] = {}
 
         # --- checkpoint transport (component 8) --------------------------
         # Shared-secret + bind hardening (round-3 verdict weak #6): the
@@ -503,8 +519,14 @@ class Manager:
             setter = getattr(self._comm, "set_allreduce_config_fingerprint",
                              None)
             if setter is not None:
+                # payload=wire-v2 marks the ring payload format (narrow
+                # wire-dtype segments, not upcast buffers): a mixed
+                # launch of pre/post-wire-ring builds must fail fast at
+                # rendezvous, not wedge mid-collective on mismatched
+                # byte counts.
                 setter(f"bucket_bytes={self._bucket_bytes};"
-                       f"wire_dtype={self._wire_dtype}")
+                       f"wire_dtype={self._wire_dtype};"
+                       f"payload=wire-v2")
             reconf_t0 = time.perf_counter()
             self._comm.configure(
                 store_prefixed, q.replica_rank, q.replica_world_size
@@ -666,111 +688,77 @@ class Manager:
 
     def _host_allreduce_pipelined(self, tree: Any, leaves: list,
                                   treedef: Any) -> Future:
-        """Bucketed, pipelined cross-group allreduce for host backends.
+        """Bucketed, fetch-overlapped, wire-dtype-preserving cross-group
+        allreduce for host backends.
 
         The reference overlaps its cross-group allreduce with the backward
         pass per-DDP-bucket (torchft/ddp.py:47-65, manager.py:222-240). JAX
         grads materialize all at once when the jitted backward finishes, so
         the overlap available here is *between stages*: the grad pytree is
-        split into ~``allreduce_bucket_bytes`` buckets and each bucket flows
-        through a three-stage pipeline on three threads —
+        split into ~``allreduce_bucket_bytes`` buckets (sized in WIRE
+        bytes), each bucket's leaves packed on device into one contiguous
+        wire-dtype buffer per (accumulator, wire) dtype pair, flowing
+        through four overlapped stages —
 
-            caller thread:    pack + device_get(bucket i+1) (D2H)
-            comm worker:      ring allreduce of bucket i    (DCN/TCP)
-            put thread:       1/n scale + device_put of i-1 (H2D)
+            caller thread: 1. pack-dispatch — EVERY bucket's cached jitted
+                              pack is dispatched up front and its D2H DMA
+                              started immediately (``copy_to_host_async``),
+                              so device->host transfer of the whole pytree
+                              overlaps the entire ring instead of the old
+                              one-bucket lookahead; a per-bucket batched
+                              ``device_get`` is the fallback when the
+                              runtime lacks the async-copy API;
+                           2. fetch-wait — per bucket, in order: block
+                              until its wire buffers are on host, hand
+                              them to the comm worker;
+            comm worker:   3. wire ring — ``Communicator.allreduce_wire``
+                              keeps the narrow wire dtype on the TCP ring
+                              END-TO-END, upcasting received segments into
+                              a full-precision accumulator during the fold
+                              (backends/host.py); uncompressed chunks take
+                              the exact in-place ring;
+            put thread:    4. device scale/put — one H2D transfer of the
+                              reduced buffer, then a cached jitted
+                              1/n-scale + split + reshape on device
+                              (host-native leaves keep a host scale path).
 
-        so wire transfer, device fetch, and device restore all overlap
-        instead of running back-to-back. Each bucket's leaves are PACKED
-        on device into one contiguous buffer per dtype before the fetch:
-        separate transfers pay a full dispatch round trip each on
-        latency-bound links, and the per-leaf fetch measured ~8x the
-        packed cost on this project's tunnel rig (770ms of an 880ms
-        allreduce for an 8-leaf 1.2MB bucket). Results are bitwise
-        identical across ranks (every rank derives the same
-        metadata-deterministic bucket + chunk schedule and ring order).
-        At world_size 2 they are also bitwise identical to the
-        single-shot path (two-term sums are order-insensitive; asserted by
-        tests/test_manager.py::TestNumerics::test_bucketed_matches_single);
-        at world_size >= 3 ring chunk boundaries shift with bucketing and
-        packing, so per-element accumulation *order* can differ from the
-        single-shot path by last-ulp rounding — the same reorder
-        tolerance any ring collective already implies across world sizes.
+        The bucket/chunk schedule and its pack/unpack executables are
+        memoized on a (treedef, shapes, dtypes, bucket_bytes, wire_dtype)
+        fingerprint (:meth:`_get_schedule` / :func:`_derive_schedule`), so
+        steady-state steps skip the per-step Python re-derivation and the
+        retrace risk. The schedule is METADATA-deterministic: participant,
+        healer, and spare ranks derive byte-identical geometry or the ring
+        would wedge on mismatched payload boundaries (asserted by
+        tests/test_manager.py::TestSchedule).
 
-        The ``allreduce_ms_total`` metric for this path spans the whole
-        exchange — device fetch, ring, scale, and device restore — i.e.
-        the full cross-group cost a step pays; the on-device mesh path's
-        metric covers only its single fused reduction.
+        Numerics (docs/design/allreduce_pipeline.md): exact mode (no wire
+        dtype) stays bitwise identical across ranks, and at world_size 2
+        bitwise identical to the single-shot path (two-term sums are
+        order-insensitive; at world_size >= 3 chunk boundaries shift with
+        bucketing, allowing last-ulp reorder vs single-shot — the reorder
+        tolerance any ring collective already implies). bf16 wire mode
+        quantizes each local contribution EXACTLY ONCE — including
+        host-native float leaves, which now ride the wire dtype too,
+        unlike the pre-v2 pipeline that upcast the payload before the
+        ring — while summation and 1/n stay full-precision.
+
+        ``allreduce_ms_total`` spans the whole exchange; stage metrics are
+        cumulative BUSY ms (stages overlap, so sums can exceed the total).
+        The fetch stage is split into ``allreduce_fetch_dispatch_ms_total``
+        vs ``allreduce_fetch_wait_ms_total`` so a fetch-bound profile is
+        attributable to dispatch cost vs DMA wait, and the two wire legs
+        split across ``allreduce_wire_bytes_total`` (D2H) and
+        ``allreduce_ring_wire_bytes_total`` (TCP ring, counted by the
+        backend).
         """
         n = max(self.num_participants(), 1)
         participating = self.is_participating()
         ar_t0 = time.perf_counter()
-
-        # Optional wire compression (allreduce_wire_dtype, e.g. bfloat16):
-        # wider float leaves are cast down ON DEVICE in one fused call, so
-        # the device->host fetch — the dominant cross-group cost on
-        # PCIe/tunnel-attached hosts — moves half the bytes. The host
-        # upcasts before the ring, so summation and 1/n stay full-precision:
-        # the only rounding is one bf16 quantization of each local
-        # contribution, the standard gradient-compression tradeoff the
-        # reference lacks entirely (round-3 verdict weak #3).
-        wire = self._wire_dtype
-
-        # (orig_dtype, wire_dtype) per leaf, from METADATA only: every
-        # rank — participant, healer, spare — must derive the identical
-        # chunking and bucket schedule below or the ring wedges on
-        # mismatched payload boundaries. Wire compression
-        # (allreduce_wire_dtype) shows up here as a narrower wire dtype
-        # for wide float leaves.
-        def leaf_dtypes(leaf: Any) -> tuple:
-            orig = np.dtype(
-                getattr(leaf, "dtype", None) or np.asarray(leaf).dtype)
-            if (wire is not None and np.issubdtype(orig, np.floating)
-                    and orig.itemsize > wire.itemsize):
-                return orig, np.dtype(wire)
-            return orig, orig
-
-        # Bucket by *wire* bytes — compressed sizes for compressible
-        # leaves — so each bucket actually moves ~bucket_bytes over the
-        # D2H leg it exists to amortize.
-        def wire_nbytes(leaf: Any) -> int:
-            return (int(np.prod(np.shape(leaf)) or 1)
-                    * leaf_dtypes(leaf)[1].itemsize)
-        buckets = _make_buckets(
-            [wire_nbytes(leaf) for leaf in leaves], self._bucket_bytes)
-
-        # Within a bucket, leaves are PACKED into one contiguous buffer
-        # per (orig, wire) dtype pair before the device->host fetch: on a
-        # tunnel/PCIe-attached host every separate transfer pays the
-        # dispatch round trip (~95ms through this rig's tunnel), so
-        # fetching an 8-leaf bucket leaf-by-leaf costs ~8 round trips of
-        # latency against ONE for the packed buffer — measured as the
-        # dominant term of the host allreduce (fetch 770ms of 880ms at
-        # 1.2MB). The ring then also moves one buffer per chunk instead
-        # of one per leaf. Chunking is metadata-deterministic (dtype
-        # pairs by first occurrence), so every rank's payload matches.
-        def chunk_bucket(idx: list) -> list:
-            by_key: Dict[tuple, dict] = {}
-            chunks: list = []
-            for i in idx:
-                orig, wdt = leaf_dtypes(leaves[i])
-                key = (str(orig), str(wdt))
-                c = by_key.get(key)
-                if c is None:
-                    c = by_key[key] = {
-                        "orig": orig, "wire": wdt, "idx": [], "sizes": []}
-                    chunks.append(c)
-                c["idx"].append(i)
-                # TRUE element count — 0-element leaves must contribute 0
-                # to the split/payload geometry (an `or 1` here would make
-                # participants' packed buffers one element longer than
-                # their sizes sum and wedge the ring; the `or 1` in
-                # wire_nbytes is only advisory bucket sizing).
-                c["sizes"].append(int(np.prod(np.shape(leaves[i]))))
-            return chunks
+        sched = self._get_schedule(treedef, leaves)
         agg: Future = Future()
         out_leaves: list = [None] * len(leaves)
         lock = threading.Lock()
-        pending = [len(buckets)]
+        pending = [len(sched.buckets)]
 
         # Completion races: the caller thread, the comm callback, and the
         # put executor can all try to settle `agg` (first error wins). A
@@ -787,30 +775,49 @@ class Manager:
         def finish_bucket(chunks: list, reduced: list) -> None:
             try:
                 put_t0 = time.perf_counter()
-                # Unpack each reduced chunk buffer back into leaves:
-                # scale once per chunk, split by the recorded sizes.
-                idx = [i for c in chunks for i in c["idx"]]
                 scaled: Dict[int, Any] = {}
                 for c, arr in zip(chunks, reduced):
+                    if c.total and all(isinstance(leaves[i], jax.Array)
+                                       for i in c.idx):
+                        # All-device chunk: ONE H2D transfer of the
+                        # reduced buffer, then the schedule's cached
+                        # jitted 1/n-scale + split + reshape runs on
+                        # device — the put stage stays off the Python
+                        # float path entirely (no host div, no per-leaf
+                        # np.split copies). n is traced, so membership
+                        # changes don't retrace.
+                        outs = _unpack_scale(c)(np.ascontiguousarray(arr),
+                                                n)
+                        placed = jax.device_put(
+                            list(outs),
+                            [leaves[i].sharding for i in c.idx])
+                        for i, a in zip(c.idx, placed):
+                            scaled[i] = a
+                        continue
+                    # Host / mixed / empty chunk: host-side scale+split,
+                    # device leaves restored in one batched put.
                     arr = div_by_count(np.asarray(arr), n)
-                    parts = np.split(arr, np.cumsum(c["sizes"])[:-1])
-                    for i, part in zip(c["idx"], parts):
-                        scaled[i] = part.reshape(np.shape(leaves[i]))
-                put_idx = [i for i in idx
-                           if isinstance(leaves[i], jax.Array)]
-                if put_idx:
-                    # One batched transfer per bucket, back onto each
-                    # input's own sharding.
-                    placed = jax.device_put(
-                        [scaled[i] for i in put_idx],
-                        [leaves[i].sharding for i in put_idx])
-                    for i, a in zip(put_idx, placed):
-                        scaled[i] = a
+                    parts = np.split(arr, np.cumsum(c.sizes)[:-1])
+                    put_idx: list = []
+                    put_vals: list = []
+                    for i, shape, part in zip(c.idx, c.shapes, parts):
+                        val = part.reshape(shape)
+                        if isinstance(leaves[i], jax.Array):
+                            put_idx.append(i)
+                            put_vals.append(val)
+                        else:
+                            scaled[i] = val
+                    if put_idx:
+                        placed = jax.device_put(
+                            put_vals,
+                            [leaves[i].sharding for i in put_idx])
+                        for i, a in zip(put_idx, placed):
+                            scaled[i] = a
                 self._record(allreduce_put_ms_total=(
                     time.perf_counter() - put_t0) * 1e3)
                 with lock:
-                    for i in idx:
-                        out_leaves[i] = scaled[i]
+                    for i, a in scaled.items():
+                        out_leaves[i] = a
                     pending[0] -= 1
                     done = pending[0] == 0
                 if done:
@@ -851,89 +858,139 @@ class Manager:
                         settle_exception(e2)
             return cb
 
-        # Stage 1, on the caller thread: pack + fetch bucket i+1 while the
-        # comm worker rings bucket i (ops run in submission order there,
-        # and in the same deterministic chunk order on every rank). The
-        # ring payload per bucket is one UPCAST (original-dtype) buffer
-        # per chunk, so summation and 1/n stay full precision; wire
-        # compression costs exactly one narrow-dtype quantization of each
-        # local contribution during the on-device pack.
-        for idx in buckets:
-            chunks = chunk_bucket(idx)
+        # Stage 1: dispatch pack + async D2H for buckets AHEAD of the
+        # ring — by default all of them up front, so device DMA for the
+        # whole pytree overlaps the entire ring. The packed copies of
+        # not-yet-fetched buckets are live on device simultaneously
+        # (~an extra grad-pytree of wire bytes at peak); jobs tight on
+        # HBM can bound that with TORCHFT_ALLREDUCE_STAGE_AHEAD=<K>
+        # (stage at most K buckets beyond the one being waited on,
+        # trading overlap for peak memory).
+        n_buckets = len(sched.chunks)
+        window = _stage_ahead_window()
+        staged: list = [None] * n_buckets
+        next_to_stage = 0
+
+        def stage_through(hi: int) -> None:
+            nonlocal next_to_stage
+            while next_to_stage < min(hi, n_buckets):
+                staged[next_to_stage] = self._stage_bucket(
+                    sched.chunks[next_to_stage], leaves)
+                next_to_stage += 1
+
+        # Stage 2: per bucket, in order — wait for its wire buffers and
+        # hand them to the comm worker (ops run in submission order
+        # there, and in the same deterministic chunk order on every
+        # rank) while the remaining buckets' DMA keeps flowing. Healers
+        # and spares contribute zero wire buffers built from the shared
+        # metadata schedule (zeros are exact in any dtype).
+        for b, chunks in enumerate(sched.chunks):
             if participating:
-                fetch_t0 = time.perf_counter()
-                dev_packed = []   # (chunk_pos, packed device array)
-                mixed = []        # (chunk_pos, leaves) — any host leaf
-                host = [None] * len(chunks)
-                for ci, c in enumerate(chunks):
-                    ls = [leaves[i] for i in c["idx"]]
-                    if all(isinstance(x, jax.Array) for x in ls):
-                        dev_packed.append(
-                            (ci, _pack_leaves(ls, str(c["wire"]))))
-                    else:
-                        mixed.append((ci, ls))
-                if dev_packed:
-                    got = jax.device_get([a for _, a in dev_packed])
-                    for (ci, _), a in zip(dev_packed, got):
-                        host[ci] = np.asarray(a)
-                if mixed:
-                    # Chunks containing host-native leaves: the DEVICE
-                    # subset still packs (wire cast included) and all
-                    # mixed chunks' packs fetch in ONE batched
-                    # device_get — only the host-native leaves skip the
-                    # link (they are already here; quantizing them would
-                    # discard precision for zero transfer benefit).
-                    # Chunk geometry (metadata-only) is identical across
-                    # ranks either way; the pack/merge below is a
-                    # rank-local detail.
-                    packs = []  # (ci, [(pos_in_ls, leaf), ...], packed)
-                    for ci, ls in mixed:
-                        dev = [(j, x) for j, x in enumerate(ls)
-                               if isinstance(x, jax.Array)]
-                        if dev:
-                            packs.append((ci, dev, _pack_leaves(
-                                [x for _, x in dev],
-                                str(chunks[ci]["wire"]))))
-                    fetched = jax.device_get(
-                        [p for _, _, p in packs]) if packs else []
-                    lookup: Dict[tuple, np.ndarray] = {}
-                    for (ci, dev, _), buf in zip(packs, fetched):
-                        buf = np.asarray(buf)
-                        sizes = [int(np.prod(np.shape(x))) for _, x in dev]
-                        for (j, _), part in zip(
-                                dev, np.split(buf, np.cumsum(sizes)[:-1])):
-                            lookup[(ci, j)] = part
-                    for ci, ls in mixed:
-                        orig = chunks[ci]["orig"]
-                        parts = []
-                        for j, x in enumerate(ls):
-                            a = (lookup[(ci, j)]
-                                 if isinstance(x, jax.Array)
-                                 else np.asarray(x))
-                            parts.append(
-                                np.ravel(a).astype(orig, copy=False))
-                        host[ci] = (np.concatenate(parts) if parts
-                                    else np.zeros(0, orig))
-                for ci, c in enumerate(chunks):
-                    if host[ci].dtype != c["orig"]:  # upcast wire chunks
-                        host[ci] = host[ci].astype(c["orig"])
-                self._record(
-                    allreduce_fetch_ms_total=(
-                        time.perf_counter() - fetch_t0) * 1e3,
-                    # Bytes that actually crossed D2H: host-native leaves
-                    # never do (rank-local accounting; no cross-rank
-                    # constraint rides on this metric).
-                    allreduce_wire_bytes_total=float(
-                        sum(wire_nbytes(leaves[i]) for i in idx
-                            if isinstance(leaves[i], jax.Array))),
-                )
+                stage_through(n_buckets if window is None
+                              else b + 1 + window)
+                bufs = self._wait_bucket(staged[b], leaves)
+                staged[b] = None  # release the packed copies
             else:
-                host = [np.zeros(sum(c["sizes"]), c["orig"])
-                        for c in chunks]
-            self._comm.allreduce(host, op="sum").add_done_callback(
-                on_bucket(chunks, time.perf_counter()))
+                bufs = [np.zeros(c.total, c.wire) for c in chunks]
+            self._comm.allreduce_wire(
+                bufs, [str(c.orig) for c in chunks], op="sum"
+            ).add_done_callback(on_bucket(chunks, time.perf_counter()))
 
         return self.wrap_future(agg, default=tree)
+
+    def _get_schedule(self, treedef: Any, leaves: list
+                      ) -> "_AllreduceSchedule":
+        """Memoized bucket/chunk schedule for this grad-pytree signature
+        (treedef + per-leaf shape/dtype + bucket_bytes + wire_dtype):
+        steady-state steps reuse the derived geometry and its cached
+        pack/unpack executables instead of re-deriving per step."""
+        metas = tuple(
+            (tuple(np.shape(leaf)),
+             str(np.dtype(getattr(leaf, "dtype", None)
+                          or np.asarray(leaf).dtype)))
+            for leaf in leaves)
+        key = (treedef, metas, self._bucket_bytes, str(self._wire_dtype))
+        sched = self._sched_cache.get(key)
+        if sched is None:
+            # Tiny bound: a training loop has one or two grad signatures;
+            # clearing on overflow keeps a pathological caller (changing
+            # shapes every step) from leaking schedules.
+            if len(self._sched_cache) >= 8:
+                self._sched_cache.clear()
+            sched = _derive_schedule(
+                metas, self._bucket_bytes, self._wire_dtype)
+            self._sched_cache[key] = sched
+        return sched
+
+    def _stage_bucket(self, chunks: list, leaves: list) -> list:
+        """Fetch stage 1 (dispatch): kick off one bucket's cached jitted
+        packs and start each packed buffer's D2H copy immediately —
+        without blocking — so DMA overlaps the ring. Returns the
+        bucket's staging records for :meth:`_wait_bucket`."""
+        t0 = time.perf_counter()
+        recs = []
+        for c in chunks:
+            dev = [(j, leaves[i]) for j, i in enumerate(c.idx)
+                   if isinstance(leaves[i], jax.Array)]
+            packed = None
+            if dev:
+                packed = _pack_leaves([x for _, x in dev], str(c.wire))
+                _start_copy_to_host(packed)
+            recs.append((c, dev, packed))
+        ms = (time.perf_counter() - t0) * 1e3
+        self._record(allreduce_fetch_dispatch_ms_total=ms,
+                     allreduce_fetch_ms_total=ms)
+        return recs
+
+    def _wait_bucket(self, recs: list, leaves: list) -> list:
+        """Fetch stage 2 (wait): block until this bucket's packed wire
+        buffers are on host — one batched ``device_get``, which merely
+        collects when the async copies already landed — and assemble the
+        per-chunk ring buffers. Host-native leaves fold in here, cast to
+        the wire dtype: the wire format is end-to-end, so every float
+        contribution is quantized exactly once (the pre-v2 pipeline kept
+        host leaves full-precision but upcast the whole payload before
+        the ring, which is why bf16 only ever thinned the D2H leg)."""
+        t0 = time.perf_counter()
+        got = iter(jax.device_get(
+            [p for _, _, p in recs if p is not None]))
+        bufs = []
+        d2h = 0
+        for c, dev, packed in recs:
+            fetched = None
+            if packed is not None:
+                fetched = np.asarray(next(got))
+                d2h += fetched.nbytes
+                if len(dev) == len(c.idx):
+                    # device_get returns a fresh host buffer this rank
+                    # owns — handed to the ring as-is (it reduces in
+                    # place; no concat, no upcast copy).
+                    bufs.append(np.ascontiguousarray(fetched))
+                    continue
+            # Mixed / host-only chunk: scatter the packed device parts
+            # and the wire-cast host leaves into one fresh ring buffer.
+            buf = np.empty(c.total, c.wire)
+            offsets = np.cumsum([0] + c.sizes)
+            dev_pos = {j for j, _ in dev}
+            fpos = 0
+            for j, i in enumerate(c.idx):
+                seg = buf[offsets[j]:offsets[j + 1]]
+                if j in dev_pos:
+                    k = c.sizes[j]
+                    seg[:] = fetched[fpos:fpos + k]
+                    fpos += k
+                else:
+                    seg[:] = np.ravel(np.asarray(leaves[i])).astype(
+                        c.wire, copy=False)
+            bufs.append(buf)
+        ms = (time.perf_counter() - t0) * 1e3
+        self._record(
+            allreduce_fetch_wait_ms_total=ms,
+            allreduce_fetch_ms_total=ms,
+            # Bytes that actually crossed D2H (host-native leaves never
+            # do; rank-local accounting, no cross-rank constraint).
+            allreduce_wire_bytes_total=float(d2h))
+        return bufs
 
     # alias matching the reference's gradient-specific spelling
     allreduce_grad = allreduce
@@ -1113,6 +1170,14 @@ class Manager:
         with self._metrics_lock:
             out = dict(self._metrics)
         out.update(self._retry_stats.snapshot())
+        # Bytes that actually crossed the TCP ring, counted by the
+        # backend at its send sites (halved vs allreduce_wire_bytes_total
+        # under bf16 wire at world 2 — the per-leg observability the
+        # wire-dtype ring exists for). getattr tolerates bare duck-typed
+        # comms in tests.
+        ring_bytes = getattr(self._comm, "ring_bytes_total", None)
+        out["allreduce_ring_wire_bytes_total"] = (
+            float(ring_bytes()) if ring_bytes is not None else 0.0)
         return out
 
     # ----------------------------------------------------------- state dicts
@@ -1236,6 +1301,163 @@ def _pack_leaves(leaves: list, wire_dtype_str: str) -> Any:
 
         fn = _PACK_FNS[wire_dtype_str] = jax.jit(pack)
     return fn(leaves)
+
+
+def _stage_ahead_window() -> Optional[int]:
+    """How many buckets beyond the one being waited on may hold live
+    packed copies on device. ``None`` (default) = unbounded: the whole
+    pytree's D2H overlaps the whole ring, at the cost of ~one extra
+    grad-pytree of wire bytes at peak. ``TORCHFT_ALLREDUCE_STAGE_AHEAD``
+    bounds it for HBM-tight jobs (0 restores the old one-bucket-at-a-
+    time footprint)."""
+    raw = os.environ.get("TORCHFT_ALLREDUCE_STAGE_AHEAD", "").strip()
+    if not raw:
+        return None
+    try:
+        return max(int(raw), 0)
+    except ValueError:
+        # Anyone setting this wants a CAP: fall back to the most
+        # conservative bound, not to unlimited staging — a typo must not
+        # invert the operator's intent into the OOM they were avoiding.
+        logger.warning("non-integer TORCHFT_ALLREDUCE_STAGE_AHEAD=%r; "
+                       "treating as 0 (no stage-ahead)", raw)
+        return 0
+
+
+_COPY_TO_HOST_ASYNC = True  # latched False once if the API is absent
+
+
+def _start_copy_to_host(arr: Any) -> None:
+    """Start the packed buffer's D2H DMA without blocking; the later
+    batched ``device_get`` then just collects the landed bytes. Latches
+    off — falling back to the plain batched device_get — only when the
+    runtime's Array type lacks ``copy_to_host_async``; a transient
+    runtime error skips this one copy (device_get stays correct) without
+    permanently disabling the overlap for the whole process."""
+    global _COPY_TO_HOST_ASYNC
+    if not _COPY_TO_HOST_ASYNC:
+        return
+    try:
+        arr.copy_to_host_async()
+    except (AttributeError, NotImplementedError, TypeError):
+        _COPY_TO_HOST_ASYNC = False  # API absent on this runtime
+    except Exception:  # noqa: BLE001 — transient; this copy just waits
+        logger.debug("copy_to_host_async failed; falling back to "
+                     "device_get for this buffer", exc_info=True)
+
+
+class _ChunkPlan:
+    """Geometry of one packed ring chunk: the leaves (by flat index) that
+    concatenate into a single contiguous 1-D wire buffer of one
+    (accumulator, wire) dtype pair. Pure metadata, so every rank derives
+    identical plans; doubles as the cache key source for the chunk's
+    jitted unpack executable (:func:`_unpack_scale`)."""
+
+    __slots__ = ("orig", "wire", "idx", "sizes", "shapes", "total")
+
+    def __init__(self, orig: np.dtype, wire: np.dtype) -> None:
+        self.orig = orig
+        self.wire = wire
+        self.idx: list = []
+        self.sizes: list = []
+        self.shapes: list = []
+        self.total = 0
+
+
+class _AllreduceSchedule:
+    """Memoized bucket/chunk schedule for one grad-pytree signature."""
+
+    __slots__ = ("buckets", "chunks", "fingerprint")
+
+    def __init__(self, buckets: list, chunks: list,
+                 fingerprint: str) -> None:
+        self.buckets = buckets
+        self.chunks = chunks
+        self.fingerprint = fingerprint
+
+
+def _wire_pair(dtype: Any, wire: Optional[np.dtype]) -> tuple:
+    """(accumulator, wire) dtype pair for a leaf, from METADATA only.
+    Wire compression applies to float leaves wider than the wire dtype;
+    everything else keeps its dtype end-to-end."""
+    orig = np.dtype(dtype)
+    if (wire is not None and np.issubdtype(orig, np.floating)
+            and orig.itemsize > wire.itemsize):
+        return orig, np.dtype(wire)
+    return orig, orig
+
+
+def _derive_schedule(metas: tuple, bucket_bytes: int,
+                     wire_dtype: Optional[Any]) -> _AllreduceSchedule:
+    """Derive the bucket + chunk schedule from per-leaf (shape, dtype)
+    METADATA only: participant, healer, and spare ranks must produce
+    byte-identical geometry or the ring wedges on mismatched payload
+    boundaries. Buckets are sized in WIRE bytes (compressed sizes) so
+    each bucket moves ~bucket_bytes over the D2H leg it amortizes;
+    within a bucket, leaves group into one chunk per (accumulator, wire)
+    dtype pair in first-occurrence order. ``fingerprint`` is a stable
+    string of the resulting geometry (the cross-rank determinism test
+    compares it directly)."""
+    wire = np.dtype(wire_dtype) if wire_dtype is not None else None
+    pairs = [_wire_pair(dt, wire) for _, dt in metas]
+    # `or 1` is advisory bucket sizing only (a scalar still costs a
+    # dispatch); the TRUE element counts below keep 0-size leaves at 0 —
+    # an `or 1` there would make participants' packed buffers one
+    # element longer than their sizes sum and wedge the ring.
+    adv = [int(np.prod(shape) or 1) * pairs[i][1].itemsize
+           for i, (shape, _) in enumerate(metas)]
+    buckets = _make_buckets(adv, bucket_bytes)
+    chunks: list = []
+    for idx in buckets:
+        by_key: Dict[tuple, _ChunkPlan] = {}
+        cs: list = []
+        for i in idx:
+            orig, wdt = pairs[i]
+            key = (str(orig), str(wdt))
+            c = by_key.get(key)
+            if c is None:
+                c = by_key[key] = _ChunkPlan(orig, wdt)
+                cs.append(c)
+            c.idx.append(i)
+            c.sizes.append(int(np.prod(metas[i][0])))
+            c.shapes.append(tuple(metas[i][0]))
+        for c in cs:
+            c.total = int(sum(c.sizes))
+        chunks.append(cs)
+    fingerprint = "wire-v2|" + "|".join(
+        ";".join(f"{c.orig}:{c.wire}:{','.join(map(str, c.sizes))}"
+                 for c in cs)
+        for cs in chunks)
+    return _AllreduceSchedule(buckets, chunks, fingerprint)
+
+
+_UNPACK_FNS: Dict[tuple, Any] = {}
+
+
+def _unpack_scale(chunk: _ChunkPlan) -> Any:
+    """Cached jitted scale-and-unpack for one chunk geometry: H2D the
+    reduced 1-D buffer once, then dtype-aware 1/n + split + reshape in
+    one fused device computation — the put stage's replacement for the
+    host-side ``div_by_count(np.asarray(...))`` + np.split float path.
+    ``n`` is traced, so membership changes don't retrace."""
+    key = (str(chunk.orig), tuple(chunk.sizes), tuple(chunk.shapes))
+    fn = _UNPACK_FNS.get(key)
+    if fn is None:
+        if len(_UNPACK_FNS) >= 64:
+            # Same shape-churn bound as the schedule cache: a caller
+            # whose grad shapes change every step must not leak one
+            # jitted executable per geometry forever.
+            _UNPACK_FNS.clear()
+        splits = np.cumsum(chunk.sizes)[:-1].tolist()
+        shapes = tuple(chunk.shapes)
+
+        def unpack(buf, n):
+            parts = jnp.split(buf, splits)
+            return [div_by_count(p, n).reshape(s)
+                    for p, s in zip(parts, shapes)]
+
+        fn = _UNPACK_FNS[key] = jax.jit(unpack)
+    return fn
 
 
 def _zero_like(leaf: Any) -> np.ndarray:

@@ -162,7 +162,12 @@ def _self_check() -> None:  # pragma: no cover - manual smoke hook
 
         def forward(self, session_id, comm):
             comm.broadcast(self.weights, root=0).result()
-            self.weights = comm.allreduce(self.weights, op="mean").result()
+            # allreduce consumes contiguous 1-D leaves (reduces them in
+            # place); hand it a copy so a mid-collective failure can't
+            # corrupt the server's long-lived weights.
+            self.weights = comm.allreduce(
+                {k: np.array(v) for k, v in self.weights.items()},
+                op="mean").result()
 
     ps = EchoPS()
     comm = EchoPS.new_session(ps.address())
