@@ -47,6 +47,20 @@ if [[ "${1:-}" == "bench-smoke" ]]; then
     exit 0
 fi
 
+# Heal-soak tier: seeded chaos soak of repeated heals with donor churn —
+# every round the primary donor is killed mid-stream while resets/short
+# reads pepper the heal channel; each heal must complete bitwise-
+# identical by failing over + resuming, with resumed bytes staying well
+# under restart-from-zero cost (see docs/design/healing.md). heal_soak
+# tests are also marked `slow`+`nightly`, so they ride the nightly tier
+# too; run this tier on heal/checkpointing changes.
+if [[ "${1:-}" == "heal-soak" ]]; then
+    stage heal-soak env JAX_PLATFORMS=cpu \
+        python -m pytest tests/test_chaos.py -q -m heal_soak
+    echo "== total: ${SECONDS}s"
+    exit 0
+fi
+
 stage core bash -c '
     cmake -B torchft_tpu/_core/build -S torchft_tpu/_core -G Ninja \
         -DCMAKE_BUILD_TYPE=Release >/dev/null

@@ -334,6 +334,59 @@ class TestHealUnderChaos:
             srv.shutdown()
 
 
+class TestDonorKill:
+    """The donor-kill fault family: a killed endpoint hangs up its
+    in-flight stream and refuses every later dial — the way a dead donor
+    process behaves — deterministically (kill_after_bytes) or drawn from
+    the seeded stream (kill_rate)."""
+
+    def test_kill_rate_latches_endpoint_dead(self):
+        sched = ChaosSchedule(
+            seed=1, endpoints={"heal": EndpointChaos(kill_rate=1.0)})
+        with pytest.raises(ConnectionResetError, match="died"):
+            chaos.begin("heal:1.2.3.4:77", "dial", sched)
+        assert sched.is_dead("heal:1.2.3.4:77")
+        with pytest.raises(ConnectionRefusedError, match="refused"):
+            chaos.begin("heal:1.2.3.4:77", "dial", sched)
+        # a different donor has its own life
+        assert not sched.is_dead("heal:5.6.7.8:99")
+        sched.revive_endpoint("heal:1.2.3.4:77")
+        assert not sched.is_dead("heal:1.2.3.4:77")
+
+    def test_kill_after_bytes_hangs_up_mid_stream(self):
+        import io
+
+        sched = ChaosSchedule(
+            seed=0,
+            endpoints={"heal": EndpointChaos(kill_after_bytes=100)})
+        reader = chaos.wrap_reader(io.BytesIO(bytes(300)), "heal:a:1",
+                                   sched)
+        got = b""
+        with pytest.raises(ConnectionResetError, match="dead"):
+            while True:
+                part = reader.read(40)
+                if not part:
+                    break
+                got += part
+        # the packet crossing the threshold is still delivered; the NEXT
+        # read hits the dead latch
+        assert 100 <= len(got) <= 140
+        assert sched.is_dead("heal:a:1")
+        with pytest.raises(ConnectionRefusedError):
+            chaos.begin("heal:a:1", "dial", sched)
+        # an independent donor (own byte counter) still streams
+        reader2 = chaos.wrap_reader(io.BytesIO(b"x" * 50), "heal:b:2",
+                                    sched)
+        assert reader2.read(50) == b"x" * 50
+
+    def test_spec_parses_kill_fields(self):
+        sched = parse_spec(
+            "seed=3;heal:kill_rate=0.5,kill_after_bytes=1000000")
+        cfg = sched.config_for("heal:any:1")
+        assert cfg.kill_rate == 0.5
+        assert cfg.kill_after_bytes == 1000000
+
+
 class TestPoisonedRingRecovery:
     """A transient collective failure with UNCHANGED membership must not
     wedge the job: a latched CommunicatorError poisons the communicator
@@ -614,3 +667,74 @@ class TestChaosSoak:
         for d in trace:
             replay.decide(d.endpoint, d.op)
         assert replay.trace() == trace
+
+
+@pytest.mark.slow
+@pytest.mark.nightly
+@pytest.mark.heal_soak
+class TestHealSoak:
+    """Seeded chaos soak of repeated heals with donor churn
+    (``scripts/test.sh heal-soak``; also rides the nightly tier): every
+    round the primary donor is killed mid-stream at a deterministic byte
+    offset while resets/short-reads pepper the heal channel. Every heal
+    must complete with bitwise-identical state by FAILING OVER and
+    RESUMING — the retry traffic must stay well under
+    restart-from-byte-0 cost."""
+
+    ROUNDS = 6
+
+    def test_repeated_heals_with_donor_churn(self):
+        import urllib.parse
+
+        from torchft_tpu.checkpointing import CheckpointServer
+        from torchft_tpu.serialization import plan_pytree
+
+        total_resent = 0.0
+        total_payload = 0.0
+        for seed in range(self.ROUNDS):
+            rng = np.random.RandomState(seed)
+            state = {f"w{i}": rng.rand(2048).astype(np.float32)
+                     for i in range(6)}
+            donors_srv = [
+                CheckpointServer(lambda s=state: s, bind_host="127.0.0.1")
+                for _ in range(2)
+            ]
+            for srv in donors_srv:
+                srv.allow_checkpoint(1)
+            payload = plan_pytree(state).total_len
+            netloc_a = urllib.parse.urlparse(
+                donors_srv[0].address()).netloc
+            kill_at = int(payload * (0.3 + 0.4 * rng.rand()))
+            sched = ChaosSchedule(seed=seed, endpoints={
+                "heal": EndpointChaos(reset_rate=0.02, short_rate=0.02),
+                f"heal:{netloc_a}": EndpointChaos(
+                    reset_rate=0.02, short_rate=0.02,
+                    kill_after_bytes=kill_at),
+            })
+            chaos.install(sched)
+            try:
+                stats = {}
+                out = CheckpointServer.load_from_address(
+                    donors_srv[0].address(), state, device_put=False,
+                    stats=stats,
+                    retry_policy=RetryPolicy(max_attempts=8,
+                                             base_delay_ms=1.0,
+                                             jitter=0.0),
+                    stall_timeout_sec=10,
+                    donors=lambda i: donors_srv[1].address())
+                for key, arr in state.items():
+                    assert out[key].tobytes() == arr.tobytes(), (
+                        f"round {seed}: leaf {key} not bitwise identical")
+                assert stats["donor_failovers"] == 1, (seed, stats)
+                assert stats["bytes_resumed"] < stats["payload_bytes"], (
+                    seed, stats)
+                total_resent += stats["bytes_resumed"]
+                total_payload += stats["payload_bytes"]
+            finally:
+                chaos.uninstall()
+                for srv in donors_srv:
+                    srv.shutdown()
+        # Across the soak, resume must beat restart-from-zero by a wide
+        # margin: donors die mid-transfer every round, yet the re-sent
+        # traffic stays under one payload's worth per round on average.
+        assert total_resent < total_payload, (total_resent, total_payload)

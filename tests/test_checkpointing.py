@@ -1,19 +1,27 @@
 """Checkpoint transfer tests (reference checkpointing semantics:
 step gating, live lazy state, 400 on step mismatch —
-/root/reference/torchft/checkpointing.py)."""
+/root/reference/torchft/checkpointing.py) plus the resilient-heal
+protocol: manifest + digests, HTTP Range resume, donor failover, and
+the stall watchdog."""
 
 import io
+import json
+import socket
 import subprocess
 import sys
 import threading
+import time
 import urllib.error
+import urllib.parse
 import urllib.request
 
 import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from torchft_tpu.checkpointing import CheckpointServer
+import torchft_tpu.checkpointing as checkpointing
+from torchft_tpu.checkpointing import CheckpointServer, HealCorruptError
+from torchft_tpu.retry import RetryPolicy
 from torchft_tpu.serialization import (
     iter_pytree_chunks,
     load_pytree,
@@ -365,5 +373,514 @@ class TestCheckpointServer:
             restored = CheckpointServer.load_from_address(
                 server.address(), {"x": np.ones(1)}, device_put=False)
             assert restored["x"].shape == (1,)
+        finally:
+            server.shutdown()
+
+
+class _FlakyProxy:
+    """Deterministic TCP proxy in front of a CheckpointServer, injecting
+    exactly one data-stream fault (manifest requests pass through):
+
+    * ``cut``   — forward ``fault_after`` body bytes of the first data
+                  response, then close the connection (mid-stream reset);
+    * ``stall`` — forward ``fault_after`` body bytes, then go silent
+                  while holding the socket open (a black-holed stream);
+    * ``die``   — like ``cut``, but also stop listening: every later
+                  dial is refused, the way a dead donor process behaves;
+    * ``flip``  — flip one byte at body offset ``flip_at`` and keep
+                  streaming (in-transit corruption a digest must catch).
+
+    After the fault fires once, later connections pass through clean
+    (except ``die``)."""
+
+    def __init__(self, upstream_url: str, mode: str = "cut",
+                 fault_after: int = 1 << 60, flip_at: int = -1,
+                 persistent: bool = False) -> None:
+        u = urllib.parse.urlparse(upstream_url)
+        self._up = (u.hostname, u.port)
+        self._mode = mode
+        self._fault_after = fault_after
+        self._flip_at = flip_at
+        self._persistent = persistent
+        self._fired = False
+        self._lock = threading.Lock()
+        self._ls = socket.socket()
+        self._ls.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._ls.bind(("127.0.0.1", 0))
+        self._ls.listen(32)
+        self.port = self._ls.getsockname()[1]
+        threading.Thread(target=self._serve, daemon=True).start()
+
+    def address(self, step: int) -> str:
+        return f"http://127.0.0.1:{self.port}/checkpoint/{step}"
+
+    def close(self) -> None:
+        # shutdown() first: a bare close() leaves the accept() blocked in
+        # another thread holding the open file description alive, so the
+        # port would KEEP accepting — shutdown wakes it and refuses new
+        # dials immediately (the dead-donor behavior 'die' mode needs).
+        try:
+            self._ls.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self._ls.close()
+        except OSError:
+            pass
+
+    def _serve(self) -> None:
+        while True:
+            try:
+                conn, _ = self._ls.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._handle, args=(conn,),
+                             daemon=True).start()
+
+    def _handle(self, conn: socket.socket) -> None:
+        up = None
+        try:
+            conn.settimeout(30)
+            req = b""
+            while b"\r\n\r\n" not in req:
+                part = conn.recv(65536)
+                if not part:
+                    return
+                req += part
+            is_data = b"/manifest" not in req.split(b"\r\n", 1)[0]
+            up = socket.create_connection(self._up, timeout=30)
+            up.sendall(req)
+            buf = b""
+            while b"\r\n\r\n" not in buf:
+                part = up.recv(65536)
+                if not part:
+                    return
+                buf += part
+            head, body0 = buf.split(b"\r\n\r\n", 1)
+            conn.sendall(head + b"\r\n\r\n")
+            with self._lock:
+                fire = is_data and (self._persistent or not self._fired)
+                if fire:
+                    self._fired = True
+            sent = 0
+            flipped = False
+
+            def feed():
+                yield body0
+                while True:
+                    part = up.recv(65536)
+                    if not part:
+                        return
+                    yield part
+
+            for data in feed():
+                if not fire:
+                    conn.sendall(data)
+                    continue
+                if (self._mode == "flip" and not flipped
+                        and sent <= self._flip_at < sent + len(data)):
+                    mutable = bytearray(data)
+                    mutable[self._flip_at - sent] ^= 0xFF
+                    data = bytes(mutable)
+                    flipped = True
+                if (self._mode in ("cut", "stall", "die")
+                        and sent + len(data) > self._fault_after):
+                    keep = max(0, self._fault_after - sent)
+                    if keep:
+                        conn.sendall(data[:keep])
+                    if self._mode == "stall":
+                        time.sleep(60)  # hold the socket, send nothing
+                    elif self._mode == "die":
+                        self.close()  # later dials: connection refused
+                    return
+                conn.sendall(data)
+                sent += len(data)
+        except OSError:
+            pass
+        finally:
+            for s in (conn, up):
+                try:
+                    if s is not None:
+                        s.close()
+                except OSError:
+                    pass
+
+
+def _heal_state(n_leaves: int = 8, leaf_elems: int = 4096) -> dict:
+    rng = np.random.RandomState(7)
+    return {f"w{i}": rng.rand(leaf_elems).astype(np.float32)
+            for i in range(n_leaves)}
+
+
+def _fetch_manifest(server_addr: str) -> dict:
+    with urllib.request.urlopen(server_addr + "/manifest",
+                                timeout=10) as resp:
+        return json.loads(resp.read())
+
+
+_FAST_RETRY = RetryPolicy(max_attempts=4, base_delay_ms=5.0,
+                          max_delay_ms=20.0, jitter=0.0)
+
+
+class TestManifestAndRange:
+    def test_manifest_describes_stream(self):
+        state = _heal_state(3, 100)
+        state["step"] = 11
+        server = CheckpointServer(lambda: state)
+        try:
+            server.allow_checkpoint(11)
+            mf = _fetch_manifest(server.address())
+            data = save_pytree(state)
+            assert mf["format"] == "tft-manifest-1"
+            assert mf["digest"] == "crc32"
+            assert mf["step"] == 11
+            assert mf["total_len"] == len(data)
+            arrays = [e for e in mf["leaves"] if e["kind"] == "array"]
+            assert len(arrays) == 3
+            import zlib
+            for e in arrays:
+                lo = mf["preamble_len"] + e["offset"]
+                assert e["crc32"] == zlib.crc32(
+                    data[lo:lo + e["nbytes"]])
+            # py leaves ride the manifest directly
+            assert any(e["kind"] == "py" and e["value"] == 11
+                       for e in mf["leaves"])
+        finally:
+            server.shutdown()
+
+    def test_range_requests(self):
+        state = _heal_state(4, 512)
+        server = CheckpointServer(lambda: state)
+        try:
+            server.allow_checkpoint(1)
+            data = save_pytree(state)
+            total = len(data)
+            for lo, hi in [(0, total), (100, total), (total // 2,
+                                                      total // 2 + 37)]:
+                req = urllib.request.Request(
+                    server.address(),
+                    headers={"Range": f"bytes={lo}-{hi - 1}"})
+                with urllib.request.urlopen(req, timeout=10) as resp:
+                    assert resp.status == 206
+                    assert resp.headers["Content-Range"] == \
+                        f"bytes {lo}-{hi - 1}/{total}"
+                    assert resp.read() == data[lo:hi]
+            # open-ended suffix
+            req = urllib.request.Request(
+                server.address(), headers={"Range": f"bytes={total - 5}-"})
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                assert resp.status == 206
+                assert resp.read() == data[-5:]
+            # past-the-end start: 416
+            req = urllib.request.Request(
+                server.address(), headers={"Range": f"bytes={total}-"})
+            with pytest.raises(urllib.error.HTTPError) as exc_info:
+                urllib.request.urlopen(req, timeout=10)
+            assert exc_info.value.code == 416
+        finally:
+            server.shutdown()
+
+    def test_pre_manifest_build_falls_back_to_legacy(self):
+        """Rolling upgrade: a pre-manifest donor parses the step out of
+        '<step>/manifest' and answers 400 "bad step" (not 404) — the
+        healer must still fall back to the legacy whole-stream fetch
+        instead of failing the heal."""
+        from http.server import BaseHTTPRequestHandler, HTTPServer
+
+        state = _heal_state(3, 512)
+        payload = save_pytree(state)
+
+        class OldBuildHandler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                pass
+
+            def do_GET(self):
+                # Faithful to the pre-manifest handler: int() the whole
+                # suffix, 400 on anything non-numeric.
+                try:
+                    int(self.path[len("/checkpoint/"):])
+                except ValueError:
+                    self.send_error(400, "bad step")
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "application/octet-stream")
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+        server = HTTPServer(("127.0.0.1", 0), OldBuildHandler)
+        threading.Thread(target=server.serve_forever,
+                         daemon=True).start()
+        try:
+            addr = f"http://127.0.0.1:{server.server_port}/checkpoint/1"
+            stats = {}
+            restored = CheckpointServer.load_from_address(
+                addr, state, device_put=False, stats=stats)
+            tree_equal(restored, state)
+            assert stats["bytes"] == len(payload)
+            # the Content-Length claim seeds payload_bytes on the
+            # legacy path
+            assert stats["payload_bytes"] == len(payload)
+        finally:
+            server.shutdown()
+            server.server_close()
+
+    def test_lock_streaming_has_no_manifest_and_falls_back(self):
+        """lock_streaming serves live state (no immutable snapshot to
+        digest): manifest is 404 and the healer's legacy whole-stream
+        path still restores correctly."""
+        state = _heal_state(2, 256)
+        server = CheckpointServer(lambda: state, lock_streaming=True)
+        try:
+            server.allow_checkpoint(1)
+            with pytest.raises(urllib.error.HTTPError) as exc_info:
+                urllib.request.urlopen(server.address() + "/manifest",
+                                       timeout=10)
+            assert exc_info.value.code == 404
+            stats = {}
+            restored = CheckpointServer.load_from_address(
+                server.address(), state, device_put=False, stats=stats)
+            tree_equal(restored, state)
+            # legacy path still counts bytes truthfully (the full stream)
+            assert stats["bytes"] == len(save_pytree(state))
+        finally:
+            server.shutdown()
+
+
+class TestResumableHeal:
+    def test_byte_accounting_counts_actual_reads(self):
+        """stats["bytes"] is what actually crossed the wire (the
+        manifest path skips the preamble via Range), never the donor's
+        Content-Length claim."""
+        state = _heal_state(4, 1024)
+        server = CheckpointServer(lambda: state)
+        try:
+            server.allow_checkpoint(1)
+            mf = _fetch_manifest(server.address())
+            stats = {}
+            restored = CheckpointServer.load_from_address(
+                server.address(), state, device_put=False, stats=stats)
+            tree_equal(restored, state)
+            assert stats["payload_bytes"] == mf["total_len"]
+            assert stats["bytes"] == mf["total_len"] - mf["preamble_len"]
+            assert stats["bytes_resumed"] == 0
+            assert stats["attempts"] == 1
+        finally:
+            server.shutdown()
+
+    def test_resume_after_cut_transfers_only_remaining(self):
+        """A mid-stream reset resumes from the last verified leaf: the
+        retry re-sends strictly less than the payload (O(remaining), not
+        O(state))."""
+        state = _heal_state(8, 4096)  # 8 x 16KB leaves
+        server = CheckpointServer(lambda: state)
+        proxy = None
+        try:
+            server.allow_checkpoint(1)
+            mf = _fetch_manifest(server.address())
+            body = mf["total_len"] - mf["preamble_len"]
+            proxy = _FlakyProxy(server.address(), mode="cut",
+                                fault_after=body // 2)
+            stats = {}
+            restored = CheckpointServer.load_from_address(
+                proxy.address(1), state, device_put=False, stats=stats,
+                retry_policy=_FAST_RETRY, stall_timeout_sec=10)
+            tree_equal(restored, state)
+            assert stats["attempts"] == 2
+            # the resumed attempt re-sent only what was missing
+            assert 0 < stats["bytes_resumed"] <= body // 2 + 16 * 4096
+            assert stats["bytes_resumed"] < stats["payload_bytes"]
+            # total wire cost: one full body's worth plus the re-read of
+            # at most the one leaf the cut truncated
+            assert stats["bytes"] < body + 2 * 16384
+        finally:
+            if proxy is not None:
+                proxy.close()
+            server.shutdown()
+
+    def test_corrupted_leaf_detected_and_never_placed(self, monkeypatch):
+        """A flipped byte in transit is caught by the leaf digest BEFORE
+        device_put: the corrupt buffer is re-fetched, and placement only
+        ever sees bytes that verified."""
+        state = _heal_state(6, 2048)
+        placed = []
+        real_put = checkpointing.device_put_like
+
+        def recording_put(arr, tleaf):
+            placed.append(arr.copy())
+            return real_put(arr, tleaf)
+
+        monkeypatch.setattr(checkpointing, "device_put_like",
+                            recording_put)
+        server = CheckpointServer(lambda: state)
+        proxy = None
+        try:
+            server.allow_checkpoint(1)
+            mf = _fetch_manifest(server.address())
+            # flip a byte inside the 4th array leaf's body span
+            entry = [e for e in mf["leaves"] if e["kind"] == "array"][3]
+            proxy = _FlakyProxy(server.address(), mode="flip",
+                                flip_at=entry["offset"] + 17)
+            stats = {}
+            restored = CheckpointServer.load_from_address(
+                proxy.address(1), state, device_put=True, stats=stats,
+                retry_policy=_FAST_RETRY, stall_timeout_sec=10)
+            tree_equal(restored, state)
+            assert stats["digest_mismatches"] == 1
+            assert stats["attempts"] == 2
+            # every array the placer saw was bitwise-correct state
+            good = {arr.tobytes() for arr in state.values()}
+            for arr in placed:
+                assert arr.tobytes() in good
+        finally:
+            if proxy is not None:
+                proxy.close()
+            server.shutdown()
+
+    def test_donor_death_fails_over_and_completes(self):
+        """ISSUE 3 acceptance: the donor dies at >=50% transfer progress
+        — the healer fails over to a second donor, completes the SAME
+        resumable transfer, restores bitwise-identical state, and
+        bytes_resumed shows the retry re-sent strictly less than the
+        payload."""
+        state = _heal_state(8, 4096)
+        donor_a = CheckpointServer(lambda: state)
+        donor_b = CheckpointServer(lambda: state)
+        proxy = None
+        try:
+            donor_a.allow_checkpoint(1)
+            donor_b.allow_checkpoint(1)
+            mf = _fetch_manifest(donor_a.address())
+            body = mf["total_len"] - mf["preamble_len"]
+            proxy = _FlakyProxy(donor_a.address(), mode="die",
+                                fault_after=int(body * 0.6))
+            resolved = []
+
+            def donors(i):
+                resolved.append(i)
+                return donor_b.address()
+
+            stats = {}
+            restored = CheckpointServer.load_from_address(
+                proxy.address(1), state, device_put=False, stats=stats,
+                retry_policy=_FAST_RETRY, stall_timeout_sec=10,
+                donors=donors)
+            # bitwise-identical restored state
+            for key, arr in state.items():
+                assert restored[key].tobytes() == arr.tobytes()
+            assert stats["donor_failovers"] == 1
+            assert resolved == [0]
+            assert 0 < stats["bytes_resumed"] < stats["payload_bytes"]
+            # >=50% came from donor A, so the resume moved < half
+            assert stats["bytes_resumed"] <= body * 0.5 + 16384
+        finally:
+            if proxy is not None:
+                proxy.close()
+            donor_a.shutdown()
+            donor_b.shutdown()
+
+    def test_cross_donor_digest_guard(self):
+        """Failover onto a donor whose same-step snapshot DIFFERS (the
+        bitwise-identity invariant broken): verified leaves that no
+        longer match are dropped and re-fetched, so the result is a
+        consistent copy of the new donor's state — never a torn mix."""
+        state_a = _heal_state(6, 2048)
+        rng = np.random.RandomState(99)
+        state_b = {k: rng.rand(*v.shape).astype(v.dtype)
+                   for k, v in state_a.items()}
+        donor_a = CheckpointServer(lambda: state_a)
+        donor_b = CheckpointServer(lambda: state_b)
+        proxy = None
+        try:
+            donor_a.allow_checkpoint(1)
+            donor_b.allow_checkpoint(1)
+            mf = _fetch_manifest(donor_a.address())
+            body = mf["total_len"] - mf["preamble_len"]
+            proxy = _FlakyProxy(donor_a.address(), mode="die",
+                                fault_after=int(body * 0.6))
+            stats = {}
+            restored = CheckpointServer.load_from_address(
+                proxy.address(1), state_a, device_put=False, stats=stats,
+                retry_policy=_FAST_RETRY, stall_timeout_sec=10,
+                donors=lambda i: donor_b.address())
+            for key, arr in state_b.items():
+                assert restored[key].tobytes() == arr.tobytes()
+            # the committed-but-mismatched leaves were detected
+            assert stats["digest_mismatches"] >= 1
+        finally:
+            if proxy is not None:
+                proxy.close()
+            donor_a.shutdown()
+            donor_b.shutdown()
+
+    def test_stall_watchdog_aborts_fast(self):
+        """A black-holed stream dies after ~stall_timeout_sec of zero
+        bytes — not after the legacy 300 s wall clock."""
+        state = _heal_state(8, 4096)
+        server = CheckpointServer(lambda: state)
+        proxy = None
+        try:
+            server.allow_checkpoint(1)
+            mf = _fetch_manifest(server.address())
+            body = mf["total_len"] - mf["preamble_len"]
+            # headers flow, body bytes never do — a black-holed stream
+            # on every attempt (fault_after=0, persistent)
+            proxy = _FlakyProxy(server.address(), mode="stall",
+                                fault_after=0, persistent=True)
+            t0 = time.monotonic()
+            stats = {}
+            with pytest.raises(Exception) as exc_info:
+                CheckpointServer.load_from_address(
+                    proxy.address(1), state, device_put=False,
+                    stats=stats,
+                    retry_policy=RetryPolicy(max_attempts=2,
+                                             base_delay_ms=5.0,
+                                             jitter=0.0),
+                    stall_timeout_sec=1.0)
+            elapsed = time.monotonic() - t0
+            assert elapsed < 20, f"watchdog took {elapsed:.1f}s"
+            assert "timed out" in str(exc_info.value).lower() or \
+                isinstance(exc_info.value, TimeoutError)
+            # a FAILED heal still reports its attempt history truthfully
+            assert stats["attempts"] == 2
+            assert stats["payload_bytes"] == mf["total_len"]
+        finally:
+            if proxy is not None:
+                proxy.close()
+            server.shutdown()
+
+    def test_persistent_corruption_is_fatal(self):
+        """A leaf that mismatches on EVERY fetch (donor-side corruption)
+        fails loudly with HealCorruptError instead of looping."""
+        state = _heal_state(3, 512)
+        server = CheckpointServer(lambda: state)
+        try:
+            server.allow_checkpoint(1)
+            mf = _fetch_manifest(server.address())
+            # lie about a digest: the real stream can never match
+            bad = dict(mf)
+            bad["leaves"] = [dict(e) for e in mf["leaves"]]
+            for e in bad["leaves"]:
+                if e["kind"] == "array":
+                    e["crc32"] = (e["crc32"] + 1) & 0xFFFFFFFF
+                    break
+
+            orig = CheckpointServer._fetch_manifest
+
+            def lying_manifest(addr, stall, auth, endpoint):
+                real = orig(addr, stall, auth, endpoint)
+                return bad if real is not None else None
+
+            with pytest.MonkeyPatch.context() as mp:
+                mp.setattr(CheckpointServer, "_fetch_manifest",
+                           staticmethod(lying_manifest))
+                with pytest.raises(HealCorruptError):
+                    CheckpointServer.load_from_address(
+                        server.address(), state, device_put=False,
+                        retry_policy=RetryPolicy(
+                            max_attempts=8, base_delay_ms=1.0,
+                            jitter=0.0),
+                        stall_timeout_sec=10)
         finally:
             server.shutdown()

@@ -203,6 +203,171 @@ class TestManagerHealing:
             m.shutdown()
 
 
+class TestManagerHealFailover:
+    """ISSUE 3 acceptance, manager level, pure Python (no native lib):
+    the donor dies at >=50% heal-transfer progress; the Manager
+    re-resolves a fresh donor via re-quorum and the SAME resumable
+    transfer completes from the second donor with bitwise-identical
+    state, re-sending strictly less than the full payload."""
+
+    def test_donor_death_mid_heal_fails_over_via_requorum(self):
+        import urllib.parse
+
+        from torchft_tpu import chaos as chaos_mod
+        from torchft_tpu.chaos import ChaosSchedule, EndpointChaos
+        from torchft_tpu.checkpointing import CheckpointServer
+        from torchft_tpu.serialization import plan_pytree
+
+        rng = np.random.RandomState(3)
+        user_state = {f"w{i}": rng.rand(4096).astype(np.float32)
+                      for i in range(8)}
+        donor_state = {"user": user_state,
+                       "torchft": {"step": 20, "batches_committed": 40}}
+        donor_a = CheckpointServer(lambda: donor_state,
+                                   bind_host="127.0.0.1")
+        donor_b = CheckpointServer(lambda: donor_state,
+                                   bind_host="127.0.0.1")
+        donor_a.allow_checkpoint(20)
+        donor_b.allow_checkpoint(20)
+        payload = plan_pytree(donor_state).total_len
+        netloc_a = urllib.parse.urlparse(donor_a.address()).netloc
+        # donor A's stream dies deterministically at ~60% of the payload
+        chaos_mod.install(ChaosSchedule(seed=0, endpoints={
+            f"heal:{netloc_a}": EndpointChaos(
+                kill_after_bytes=int(payload * 0.6)),
+        }))
+
+        def heal_quorum(recover):
+            return quorum_result(
+                quorum_id=1, max_step=20, max_rank=None, max_world_size=1,
+                replica_rank=1, replica_world_size=2, heal=True,
+                recover_manager_address=recover)
+
+        client = MagicMock()
+        # initial quorum names donor A; the mid-heal re-quorum (after A's
+        # death) names donor B
+        client.quorum.side_effect = [heal_quorum("managerA"),
+                                     heal_quorum("managerB")]
+        client.should_commit.return_value = True
+        ckpt_addrs = {"managerA": donor_a.address(),
+                      "managerB": donor_b.address()}
+
+        def make_client(addr, **kwargs):
+            mc = MagicMock()
+            mc.checkpoint_address.return_value = ckpt_addrs[addr]
+            return mc
+
+        loaded = MagicMock()
+        pc = patch("torchft_tpu.manager.ManagerClient",
+                   side_effect=make_client)
+        m = make_manager(
+            client, use_async_quorum=True, load_state_dict=loaded,
+            min_replica_size=1,
+            state_dict=lambda: {f"w{i}": np.zeros(4096, np.float32)
+                                for i in range(8)})
+        try:
+            with pc:
+                m.step()
+                assert m.should_commit()
+        finally:
+            m.shutdown()
+            chaos_mod.uninstall()
+            donor_a.shutdown()
+            donor_b.shutdown()
+
+        # healed user state applied at commit, bitwise identical
+        loaded.assert_called_once()
+        healed = loaded.call_args[0][0]
+        for key, arr in user_state.items():
+            assert healed[key].tobytes() == arr.tobytes()
+        assert m.current_step() == 20  # manager metadata restored
+
+        mx = m.metrics()
+        assert mx["heal_count"] == 1
+        assert mx["heal_donor_failovers"] == 1
+        assert mx["heal_attempts_total"] >= 2
+        # the resumed leg re-sent strictly less than the full payload
+        assert 0 < mx["heal_bytes_resumed_total"] < payload
+        # >=50% of the transfer survived the donor's death
+        assert mx["heal_bytes_resumed_total"] <= payload * 0.5
+        assert mx["heal_bytes_total"] > 0
+        # live progress gauge landed on a completed transfer
+        assert mx["heal_last_payload_bytes"] == payload
+        assert mx["heal_last_bytes_committed"] > 0
+        # both quorum joins happened (initial + mid-heal re-resolution)
+        assert client.quorum.call_count == 2
+        events = [e["event"] for e in m.history()]
+        assert "heal_failover" in events
+        assert "heal" in events
+
+    def test_requorum_moved_on_aborts_failover(self):
+        """When the mid-heal re-quorum no longer heals at the same
+        max_step (the world moved on), the failover is abandoned and the
+        heal fails cleanly — the next step starts a fresh heal."""
+        import urllib.parse
+
+        from torchft_tpu import chaos as chaos_mod
+        from torchft_tpu.chaos import ChaosSchedule, EndpointChaos
+        from torchft_tpu.checkpointing import CheckpointServer
+        from torchft_tpu.serialization import plan_pytree
+
+        user_state = {"w": np.arange(8192, dtype=np.float32)}
+        donor_state = {"user": user_state,
+                       "torchft": {"step": 20, "batches_committed": 40}}
+        donor_a = CheckpointServer(lambda: donor_state,
+                                   bind_host="127.0.0.1")
+        donor_a.allow_checkpoint(20)
+        payload = plan_pytree(donor_state).total_len
+        netloc_a = urllib.parse.urlparse(donor_a.address()).netloc
+        chaos_mod.install(ChaosSchedule(seed=0, endpoints={
+            f"heal:{netloc_a}": EndpointChaos(
+                kill_after_bytes=int(payload * 0.5)),
+        }))
+
+        client = MagicMock()
+        client.quorum.side_effect = [
+            quorum_result(quorum_id=1, max_step=20, max_rank=None,
+                          max_world_size=1, replica_rank=1,
+                          replica_world_size=2, heal=True,
+                          recover_manager_address="managerA"),
+            # re-quorum: everyone advanced, no heal offered at step 20
+            quorum_result(quorum_id=1, max_step=25, max_rank=1,
+                          max_world_size=2, replica_rank=1,
+                          replica_world_size=2, heal=False),
+        ]
+        client.should_commit.return_value = False
+        loaded = MagicMock()
+
+        def make_client(addr, **kwargs):
+            mc = MagicMock()
+            mc.checkpoint_address.return_value = donor_a.address()
+            return mc
+
+        m = make_manager(
+            client, use_async_quorum=True, load_state_dict=loaded,
+            min_replica_size=1,
+            state_dict=lambda: {"w": np.zeros(8192, np.float32)})
+        try:
+            with patch("torchft_tpu.manager.ManagerClient",
+                       side_effect=make_client):
+                m.step()
+                # heal failed (donor dead, no replacement): the step
+                # aborts instead of wedging
+                assert not m.should_commit()
+        finally:
+            m.shutdown()
+            chaos_mod.uninstall()
+            donor_a.shutdown()
+        loaded.assert_not_called()
+        assert m.errored() is not None
+        mx = m.metrics()
+        assert mx["heal_donor_failovers"] == 0
+        assert mx["heal_count"] == 1
+        # failed heals still record their wire cost + attempt history
+        assert mx["heal_attempts_total"] >= 1
+        assert mx["heal_bytes_total"] > 0
+
+
 class TestManagerErrors:
     """reference manager_test.py:260-342"""
 

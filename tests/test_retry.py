@@ -58,6 +58,46 @@ class TestClassification:
     def test_transient(self, exc):
         assert is_transient(exc)
 
+    def test_serve_window_closed_503_is_transient(self):
+        """The donor's 503 while its serve window is shut at commit is
+        transient BY CONSTRUCTION (the window reopens at the donor's
+        next step start) — it must retry with backoff, not surface as a
+        failed heal alongside real refusals."""
+        import urllib.error
+
+        def http_error(code, msg):
+            return urllib.error.HTTPError(
+                "http://donor/checkpoint/5", code, msg, None, None)
+
+        assert is_transient(http_error(503, "serve window closed (commit)"))
+        # heal-specific classifier agrees
+        from torchft_tpu.checkpointing import _heal_transient
+        assert _heal_transient(http_error(503,
+                                          "serve window closed (commit)"))
+        # ...but shutdown, auth and step refusals stay fatal
+        assert not is_transient(http_error(503, "shutting down"))
+        assert not _heal_transient(http_error(503, "shutting down"))
+        assert not is_transient(
+            http_error(400, "invalid checkpoint requested: serving 5 "
+                            "but got 3"))
+        assert not _heal_transient(
+            http_error(400, "invalid checkpoint requested: serving 5 "
+                            "but got 3"))
+        assert not _heal_transient(http_error(401,
+                                              "missing/bad bearer token"))
+
+    def test_heal_corrupt_vs_digest_classification(self):
+        from torchft_tpu.checkpointing import (HealCorruptError,
+                                               LeafDigestError,
+                                               _heal_transient)
+
+        # in-transit corruption: re-fetch fixes it
+        assert _heal_transient(LeafDigestError("2 leaves failed digest "
+                                               "verification"))
+        # donor-side corruption: retrying the same donor cannot help
+        assert not _heal_transient(HealCorruptError(
+            "leaf 'w' failed digest verification 3 times"))
+
     @pytest.mark.parametrize("exc", [
         RuntimeError("store get timeout waiting for key: foo/bar"),
         RuntimeError("invalid checkpoint requested: serving 5 but got 3"),

@@ -20,7 +20,9 @@ injects exactly those, deterministically, at every Python-side transport:
   :class:`ChaosCommunicator`, a fault-injecting Communicator shim.
 
 Faults come from a :class:`ChaosSchedule`: a per-endpoint configuration
-(latency, jitter, connection resets, short reads/writes, black-holes)
+(latency, jitter, connection resets, short reads/writes, black-holes,
+donor kills — ``kill_rate`` / ``kill_after_bytes`` latch an endpoint
+dead so later dials are refused like a dead peer process)
 driven by per-channel deterministic RNG streams — the decision sequence
 for a channel is a pure function of ``(seed, channel, op index)``, so the
 same schedule replayed over the same per-channel op sequence reproduces
@@ -90,13 +92,20 @@ class EndpointChaos:
     short_rate: float = 0.0      # partial read/write, then reset
     blackhole_rate: float = 0.0  # op stalls, then times out
     blackhole_ms: float = 5_000.0  # stall bound for black-holed ops
+    # Donor-kill: the endpoint DIES (not just this op). A "kill" fault
+    # hangs up the in-flight stream and latches the endpoint dead —
+    # every later dial/read against it raises connection-refused, the
+    # way a dead peer process behaves — until ChaosSchedule.revive().
+    kill_rate: float = 0.0       # per-op probability of dying mid-op
+    kill_after_bytes: float = -1.0  # die once this many bytes streamed
     max_faults: int = -1         # cap on hard faults per channel (-1 = inf)
 
 
 @dataclass(frozen=True)
 class Decision:
     """One injection decision. ``fault`` is ``None``, ``"reset"``,
-    ``"short"`` or ``"blackhole"``; ``phase`` is ``"pre"`` (request never
+    ``"short"``, ``"blackhole"`` or ``"kill"`` (the endpoint dies and
+    stays dead); ``phase`` is ``"pre"`` (request never
     arrived) or ``"post"`` (response lost) and is honored by the RPC
     shims only — socket faults fire at IO time. ``frac`` is the fraction
     of a short transfer that completes."""
@@ -141,6 +150,10 @@ class ChaosSchedule:
         self._faults_left: Dict[str, int] = {}
         self._trace: List[Decision] = []
         self._fault_count = 0
+        # Donor-kill state: endpoints latched dead, and per-endpoint
+        # streamed-byte counters for the kill_after_bytes trigger.
+        self._dead: Dict[str, bool] = {}
+        self._bytes: Dict[str, int] = {}
 
     # ------------------------------------------------------------- config
 
@@ -186,6 +199,9 @@ class ChaosSchedule:
             elif u < (cfg.reset_rate + cfg.short_rate
                       + cfg.blackhole_rate):
                 fault = "blackhole"
+            elif u < (cfg.reset_rate + cfg.short_rate
+                      + cfg.blackhole_rate + cfg.kill_rate):
+                fault = "kill"
             # Draw phase/frac unconditionally so the stream position does
             # not depend on whether a fault fired (keeps decision n a pure
             # function of (seed, channel, n) even across config edits).
@@ -217,6 +233,61 @@ class ChaosSchedule:
         cap)."""
         with self._lock:
             return self._fault_count
+
+    # ----------------------------------------------------- donor kills
+
+    def kill_endpoint(self, endpoint: str) -> None:
+        """Latch ``endpoint`` dead (tests use this for a deterministic
+        donor kill at an exact moment; the ``kill_rate`` /
+        ``kill_after_bytes`` faults call it internally). Dead endpoints
+        refuse every dial and hang up every in-flight stream."""
+        with self._lock:
+            self._dead[endpoint] = True
+
+    def revive_endpoint(self, endpoint: str) -> None:
+        """Clear a dead latch (a donor "restarted")."""
+        with self._lock:
+            self._dead.pop(endpoint, None)
+
+    def is_dead(self, endpoint: str) -> bool:
+        with self._lock:
+            return self._dead.get(endpoint, False)
+
+    def dead_endpoints(self) -> List[str]:
+        with self._lock:
+            return [e for e, d in self._dead.items() if d]
+
+    def kill_allowance(self, endpoint: str) -> Optional[int]:
+        """Bytes this endpoint may still stream before its
+        ``kill_after_bytes`` threshold; ``None`` when no threshold is
+        configured. Readers clamp their reads to this, so the death
+        lands at the EXACT configured byte offset regardless of read
+        sizes."""
+        cfg = self.config_for(endpoint)
+        if cfg is None or cfg.kill_after_bytes < 0:
+            return None
+        with self._lock:
+            return max(0, int(cfg.kill_after_bytes)
+                       - self._bytes.get(endpoint, 0))
+
+    def note_bytes(self, endpoint: str, n: int) -> bool:
+        """Account ``n`` streamed bytes against ``endpoint``; returns
+        True exactly once, when the cumulative count reaches the
+        channel's ``kill_after_bytes`` threshold — the endpoint is then
+        latched dead (deterministic mid-stream donor death at a byte
+        offset, independent of read sizes and thread timing)."""
+        cfg = self.config_for(endpoint)
+        if cfg is None or cfg.kill_after_bytes < 0:
+            return False
+        with self._lock:
+            before = self._bytes.get(endpoint, 0)
+            self._bytes[endpoint] = before + n
+            if (before < cfg.kill_after_bytes
+                    <= before + n and not self._dead.get(endpoint)):
+                self._dead[endpoint] = True
+                self._fault_count += 1
+                return True
+            return False
 
 
 # ----------------------------------------------------------------- spec
@@ -321,6 +392,12 @@ def begin(endpoint: str, op: str,
     sched = schedule if schedule is not None else active()
     if sched is None:
         return None
+    if sched.is_dead(endpoint):
+        # Dead endpoints refuse dials the way a dead peer process does —
+        # no RNG draw, so the channel's decision stream stays pure.
+        raise ConnectionRefusedError(
+            f"[chaos] {endpoint}/{op}: connection refused (endpoint "
+            "dead)")
     d = sched.decide(endpoint, op)
     if d is None:
         return None
@@ -330,6 +407,11 @@ def begin(endpoint: str, op: str,
         time.sleep(d.blackhole_ms / 1e3)
         raise TimeoutError(
             f"[chaos] {endpoint}/{op}#{d.n}: black-holed, timed out")
+    if d.fault == "kill":
+        sched.kill_endpoint(endpoint)
+        raise ConnectionResetError(
+            f"[chaos] {endpoint}/{op}#{d.n}: connection reset by peer "
+            "(peer process died)")
     if d.fault in ("reset", "short") and d.phase == "pre":
         raise ConnectionResetError(
             f"[chaos] {endpoint}/{op}#{d.n}: connection reset by peer "
@@ -378,11 +460,22 @@ class ChaosSocket:
     def _pre(self, op: str) -> Optional[Decision]:
         if self._from_global and active() is not self._schedule:
             return None
+        if self._schedule.is_dead(self._endpoint):
+            self._abort()
+            raise ConnectionResetError(
+                f"[chaos] {self._endpoint}/{op}: connection reset by "
+                "peer (endpoint dead)")
         d = self._schedule.decide(self._endpoint, op)
         if d is None:
             return None
         if d.delay_ms > 0:
             time.sleep(d.delay_ms / 1e3)
+        if d.fault == "kill":
+            self._schedule.kill_endpoint(self._endpoint)
+            self._abort()
+            raise ConnectionResetError(
+                f"[chaos] {self._endpoint}/{op}#{d.n}: connection reset "
+                "by peer (peer process died)")
         if d.fault == "blackhole":
             tmo = self._sock.gettimeout()
             stall = d.blackhole_ms / 1e3
@@ -479,6 +572,23 @@ class _ChaosReader:
         return getattr(self._raw, name)
 
     def read(self, n: int = -1) -> bytes:
+        if self._schedule.is_dead(self._endpoint):
+            # The peer died while this stream was open: RST mid-read.
+            raise ConnectionResetError(
+                f"[chaos] {self._endpoint}/read: connection reset by "
+                "peer (endpoint dead)")
+        allow = self._schedule.kill_allowance(self._endpoint)
+        if allow is not None:
+            if allow <= 0:
+                self._schedule.kill_endpoint(self._endpoint)
+                raise ConnectionResetError(
+                    f"[chaos] {self._endpoint}/read: connection reset "
+                    "by peer (peer process died)")
+            if n is None or n < 0 or n > allow:
+                # Clamp so the hangup lands at the exact configured byte
+                # offset; note_bytes latches the endpoint dead when the
+                # clamped read delivers the final allowed bytes.
+                n = allow
         d = self._schedule.decide(self._endpoint, "read")
         if d is not None:
             if d.delay_ms > 0:
@@ -488,6 +598,11 @@ class _ChaosReader:
                 raise TimeoutError(
                     f"[chaos] {self._endpoint}/read#{d.n}: black-holed, "
                     "timed out")
+            if d.fault == "kill":
+                self._schedule.kill_endpoint(self._endpoint)
+                raise ConnectionResetError(
+                    f"[chaos] {self._endpoint}/read#{d.n}: connection "
+                    "reset by peer (peer process died)")
             if d.fault == "reset":
                 raise ConnectionResetError(
                     f"[chaos] {self._endpoint}/read#{d.n}: "
@@ -497,7 +612,14 @@ class _ChaosReader:
                 raise ConnectionResetError(
                     f"[chaos] {self._endpoint}/read#{d.n}: short read, "
                     "connection reset")
-        return self._raw.read(n)
+        data = self._raw.read(n)
+        if data:
+            # kill_after_bytes: the bytes that crossed the threshold are
+            # still delivered (the peer's last packets), the NEXT read
+            # hits the dead latch — a mid-stream hangup at a
+            # deterministic byte offset.
+            self._schedule.note_bytes(self._endpoint, len(data))
+        return data
 
     def readinto(self, b) -> int:
         # load_pytree_from may use readinto on some paths; route through

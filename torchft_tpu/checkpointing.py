@@ -38,21 +38,31 @@ TPU-native differences from the reference:
 
 from __future__ import annotations
 
+import json
 import logging
+import re
 import socket
 import threading
 import time
+import urllib.error
+import urllib.parse
 import urllib.request
+import zlib
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Any, Callable, Optional, Tuple, TypeVar
+from typing import Any, Callable, Dict, List, Optional, Tuple, TypeVar
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from torchft_tpu import chaos
-from torchft_tpu.retry import RetryPolicy, RetryStats, call_with_retry
+from torchft_tpu.retry import RetryError, RetryPolicy, RetryStats, \
+    is_transient
 from torchft_tpu.utils import advertise_host
 from torchft_tpu.serialization import (
+    _match_entries,
+    _read_exact_into,
+    _resolve_dtype,
     device_put_like,
     iter_pytree_chunks,
     load_pytree_from,
@@ -61,6 +71,251 @@ from torchft_tpu.serialization import (
 
 T = TypeVar("T")
 logger: logging.Logger = logging.getLogger(__name__)
+
+MANIFEST_SUFFIX = "/manifest"
+MANIFEST_FORMAT = "tft-manifest-1"
+# Re-fetch budget per leaf before a digest mismatch is declared
+# persistent (donor-side corruption, not corruption in transit) and the
+# heal fails loudly instead of looping.
+MAX_LEAF_REFETCHES = 3
+
+
+class HealCorruptError(ValueError):
+    """A leaf's digest mismatched on every re-fetch: the donor's copy
+    itself is corrupt (or the manifest lies). Fatal — retrying the same
+    donor cannot help; a failover to another donor can."""
+
+
+class LeafDigestError(ValueError):
+    """One or more leaves failed digest verification in transit.
+    Transient: the bytes were corrupted on the wire, a re-fetch is the
+    fix (bounded per leaf by ``MAX_LEAF_REFETCHES``)."""
+
+
+_RANGE_RE = re.compile(r"bytes=(\d+)-(\d+)?$")
+
+
+def build_manifest(plan: Any, step: int) -> dict:
+    """JSON transfer manifest for one serialized snapshot: the header's
+    leaf entries (array entries annotated with ``offset``/``nbytes``
+    body coordinates and a ``crc32`` content digest) plus the stream
+    geometry a resuming healer needs (``preamble_len``, ``total_len``).
+    Digests come from :meth:`PytreePlan.digests` — computed once per
+    snapshot, cached, shared by every healer."""
+    digs = iter(plan.digests())
+    leaves = []
+    for e in plan.header["leaves"]:
+        e = dict(e)
+        if e["kind"] == "array":
+            e["crc32"] = next(digs)
+        leaves.append(e)
+    return {
+        "format": MANIFEST_FORMAT,
+        "step": int(step),
+        "digest": "crc32",
+        "preamble_len": len(plan.preamble),
+        "total_len": int(plan.total_len),
+        "leaves": leaves,
+    }
+
+
+def _open_url(url: str, stall: float, auth_token: Optional[str],
+              headers: Optional[Dict[str, str]] = None) -> Any:
+    """Dial a checkpoint URL. ``stall`` becomes the socket-op timeout:
+    it bounds how long ANY read may sit with zero bytes arriving — the
+    stall watchdog — rather than the whole transfer's wall clock."""
+    req = urllib.request.Request(url)
+    if auth_token is not None:
+        req.add_header("Authorization", f"Bearer {auth_token}")
+    for k, v in (headers or {}).items():
+        req.add_header(k, v)
+    return urllib.request.urlopen(req, timeout=stall)
+
+
+def _heal_endpoint(addr: str) -> str:
+    """Per-donor chaos endpoint (``heal:<host:port>``): donor-kill
+    faults latch a single donor dead while the ``heal`` channel's config
+    and RNG stream stay shared across donors."""
+    netloc = urllib.parse.urlparse(addr).netloc
+    return f"heal:{netloc}" if netloc else "heal"
+
+
+def _heal_transient(exc: BaseException) -> bool:
+    """Heal-specific retryability: 503 "serve window closed (commit)" is
+    transient BY CONSTRUCTION — the donor reopens the window at its next
+    step start — while step/auth refusals (400/401) and shutdown stay
+    fatal. In-transit digest mismatches re-fetch; persistent ones
+    (:class:`HealCorruptError`) don't. Everything else defers to the
+    shared :func:`torchft_tpu.retry.is_transient` classification."""
+    if isinstance(exc, HealCorruptError):
+        return False
+    if isinstance(exc, LeafDigestError):
+        return True
+    if isinstance(exc, urllib.error.HTTPError):
+        reason = str(getattr(exc, "reason", "") or exc).lower()
+        return exc.code == 503 and "shutting down" not in reason
+    return is_transient(exc)
+
+
+def _looks_donor_dead(exc: BaseException) -> bool:
+    """Connection-refused means the donor's server socket is GONE (a
+    dead process / freed port) — unlike the resets and timeouts a
+    live-but-flaky donor produces — so it short-circuits straight to
+    donor failover instead of burning the retry budget against a
+    corpse."""
+    e: Optional[BaseException] = exc
+    for _ in range(5):
+        if e is None:
+            break
+        if isinstance(e, ConnectionRefusedError):
+            return True
+        reason = getattr(e, "reason", None)
+        e = reason if isinstance(reason, BaseException) else e.__cause__
+    return "connection refused" in str(exc).lower()
+
+
+class _CountingReader:
+    """Read-through wrapper counting bytes actually delivered to the
+    healer — the truthful transfer-volume source (the donor's
+    Content-Length claim is 0 when absent and a lie under
+    truncation)."""
+
+    def __init__(self, raw: Any, counter: list) -> None:
+        self._raw = raw
+        self._counter = counter
+
+    def read(self, n: int = -1) -> bytes:
+        data = self._raw.read(n)
+        self._counter[0] += len(data)
+        return data
+
+    def readinto(self, b) -> int:
+        if hasattr(self._raw, "readinto"):
+            n = self._raw.readinto(b)
+        else:
+            data = self._raw.read(len(b))
+            n = len(data)
+            b[:n] = data
+        self._counter[0] += n or 0
+        return n
+
+
+class _HealSession:
+    """Cross-attempt, cross-donor state of one resumable heal transfer:
+    which leaves are committed (digest-verified and placed), their
+    verified digests (the cross-donor identity check), and the truthful
+    byte counters. Survives transport failures and donor failovers; a
+    fresh attempt re-enters at the first missing leaf."""
+
+    def __init__(self, target: Any,
+                 device_put_fn: Optional[Callable]) -> None:
+        self.target = target
+        self.device_put_fn = device_put_fn
+        self.treedef: Any = None
+        self.pairs: Optional[list] = None   # [(entry, target_leaf)]
+        self.arr_order: List[int] = []      # array pair indices, body order
+        self.committed: Dict[int, Any] = {}
+        self.crcs: Dict[int, int] = {}      # verified crc32 per pair idx
+        self.refetches: Dict[int, int] = {}
+        self.preamble_len = 0
+        self.total_len = 0
+        self.committed_bytes = 0
+        self.bytes_read = 0
+        self.bytes_resumed = 0
+        self.rounds = 0                     # data fetch rounds (attempts)
+        self.failovers = 0
+        self.digest_mismatches = 0
+
+    def adopt_manifest(self, mf: dict) -> None:
+        """Validate a donor's manifest against the target (structure,
+        shapes, dtypes — the same untrusted-header discipline as the
+        byte stream) and reconcile committed progress: on a failover,
+        leaves stay committed iff the new donor's digest matches the one
+        we verified — the runtime check of the same-step
+        bitwise-identity invariant. A violation drops just those leaves
+        back into the missing set (and is loud: it means two donors
+        disagree about the same step's state)."""
+        pairs, treedef = _match_entries({"leaves": mf["leaves"]},
+                                        self.target)
+        first = self.pairs is None
+        self.pairs = pairs
+        self.treedef = treedef
+        self.arr_order = [i for i, (e, _) in enumerate(pairs)
+                          if e["kind"] == "array"]
+        self.preamble_len = int(mf["preamble_len"])
+        self.total_len = int(mf["total_len"])
+        if not first:
+            # A fresh donor gets a fresh per-leaf refetch budget: the
+            # persistent-mismatch verdict was about the OLD donor's copy.
+            self.refetches.clear()
+            for i in list(self.committed):
+                entry = pairs[i][0]
+                if entry["kind"] != "array":
+                    continue
+                want = entry.get("crc32")
+                if want is not None and i in self.crcs \
+                        and int(want) != self.crcs[i]:
+                    logger.warning(
+                        "heal: cross-donor digest mismatch on leaf %r "
+                        "(had %08x, new donor claims %08x) — same-step "
+                        "snapshots should be bitwise identical; "
+                        "re-fetching it from the new donor",
+                        entry["key"], self.crcs[i], int(want))
+                    self.digest_mismatches += 1
+                    del self.committed[i]
+                    self.crcs.pop(i, None)
+                    self.committed_bytes -= int(entry["nbytes"])
+        # py leaves and zero-byte arrays commit straight off the
+        # manifest — no wire bytes to wait for.
+        for i, (entry, tleaf) in enumerate(pairs):
+            if i in self.committed:
+                continue
+            if entry["kind"] == "py":
+                self.committed[i] = entry["value"]
+            elif int(entry["nbytes"]) == 0:
+                arr = np.empty(entry["shape"],
+                               _resolve_dtype(entry["dtype"]))
+                self.commit(i, arr, zlib.crc32(b""))
+
+    def commit(self, i: int, arr: np.ndarray, crc: int) -> None:
+        tleaf = self.pairs[i][1]
+        self.committed[i] = (self.device_put_fn(arr, tleaf)
+                             if self.device_put_fn is not None else arr)
+        self.crcs[i] = crc
+        self.committed_bytes += int(self.pairs[i][0]["nbytes"])
+
+    def note_bytes(self, n: int) -> None:
+        self.bytes_read += n
+        if self.rounds > 1:
+            self.bytes_resumed += n
+
+    def missing(self) -> List[int]:
+        return [i for i in self.arr_order if i not in self.committed]
+
+    def complete(self) -> bool:
+        return (self.pairs is not None
+                and len(self.committed) == len(self.pairs))
+
+    def spans(self) -> List[list]:
+        """Missing leaves coalesced into contiguous ``[start, end,
+        [pair indices]]`` byte spans (absolute stream offsets), one
+        Range request each — the first attempt is a single span covering
+        the whole body; later attempts cover only what's left."""
+        out: List[list] = []
+        for i in self.missing():
+            entry = self.pairs[i][0]
+            a = self.preamble_len + int(entry["offset"])
+            b = a + int(entry["nbytes"])
+            if out and out[-1][1] == a:
+                out[-1][1] = b
+                out[-1][2].append(i)
+            else:
+                out.append([a, b, [i]])
+        return out
+
+    def assemble(self) -> Any:
+        leaves = [self.committed[i] for i in range(len(self.pairs))]
+        return jax.tree_util.tree_unflatten(self.treedef, leaves)
 
 
 class _CheckpointHTTPServer(ThreadingHTTPServer):
@@ -173,8 +428,12 @@ class CheckpointServer:
                 if not self.path.startswith(prefix):
                     self.send_error(404, "unknown path")
                     return
+                path = self.path
+                want_manifest = path.endswith(MANIFEST_SUFFIX)
+                if want_manifest:
+                    path = path[:-len(MANIFEST_SUFFIX)]
                 try:
-                    req_step = int(self.path[len(prefix):])
+                    req_step = int(path[len(prefix):])
                 except ValueError:
                     self.send_error(400, "bad step")
                     return
@@ -201,6 +460,14 @@ class CheckpointServer:
                             f"invalid checkpoint requested: serving "
                             f"{srv._step} but got {req_step}")
                         return
+                    if want_manifest and srv._lock_streaming:
+                        # Live lock-streamed state has no immutable
+                        # snapshot to digest; healers fall back to the
+                        # legacy (non-resumable) full-stream fetch.
+                        self.send_error(
+                            404, "manifest unavailable (lock_streaming "
+                            "serves live state)")
+                        return
                     try:
                         state, plan = srv._capture_locked()
                     except Exception as e:  # surface to healer, keep serving
@@ -215,17 +482,56 @@ class CheckpointServer:
                 # never holds more than one leaf + one chunk in host RAM;
                 # socket-write backpressure paces the device_get fetches.
                 try:
-                    self.send_response(200)
+                    if want_manifest:
+                        # Digest pass runs outside the serve lock too
+                        # (the snapshot is immutable); computed once per
+                        # snapshot, shared by every healer and attempt.
+                        body = json.dumps(
+                            build_manifest(plan, req_step)).encode()
+                        self.send_response(200)
+                        self.send_header("Content-Type", "application/json")
+                        self.send_header("Content-Length", str(len(body)))
+                        self.end_headers()
+                        self.connection.settimeout(srv._send_timeout_sec)
+                        self.wfile.write(body)
+                        return
+                    total = plan[1]
+                    start, end = 0, total
+                    status = 200
+                    rng = self.headers.get("Range")
+                    if rng:
+                        m = _RANGE_RE.match(rng.strip())
+                        if m:
+                            start = int(m.group(1))
+                            if m.group(2) is not None:
+                                end = min(int(m.group(2)) + 1, total)
+                            if start >= total or start >= end:
+                                self.send_response(416)
+                                self.send_header("Content-Range",
+                                                 f"bytes */{total}")
+                                self.send_header("Content-Length", "0")
+                                self.end_headers()
+                                return
+                            status = 206
+                        # Unparseable Range: ignore it and serve the full
+                        # stream with 200, as HTTP permits.
+                    self.send_response(status)
                     self.send_header("Content-Type",
                                      "application/octet-stream")
-                    self.send_header("Content-Length", str(plan[1]))
+                    self.send_header("Content-Length", str(end - start))
+                    if status == 206:
+                        self.send_header(
+                            "Content-Range",
+                            f"bytes {start}-{end - 1}/{total}")
                     self.end_headers()
-                    # 200 is already committed: a device_get failure
-                    # mid-stream can only short-close the socket (healer
-                    # sees "truncated"), so log the real cause here.
+                    # The status line is already committed: a device_get
+                    # failure mid-stream can only short-close the socket
+                    # (healer sees "truncated"), so log the real cause
+                    # here.
                     self.connection.settimeout(srv._send_timeout_sec)
                     try:
-                        for chunk in iter_pytree_chunks(state, plan=plan):
+                        for chunk in iter_pytree_chunks(
+                                state, plan=plan, start=start, end=end):
                             self.wfile.write(chunk)
                     except Exception:
                         logger.exception(
@@ -311,50 +617,322 @@ class CheckpointServer:
                           stats: Optional[dict] = None,
                           auth_token: Optional[str] = None,
                           retry_policy: Optional[RetryPolicy] = None,
-                          retry_stats: Optional[RetryStats] = None) -> T:
+                          retry_stats: Optional[RetryStats] = None,
+                          stall_timeout_sec: Optional[float] = None,
+                          donors: Optional[Callable[[int], Optional[str]]]
+                          = None,
+                          max_donor_failovers: int = 3,
+                          progress_cb: Optional[Callable[[int, int], None]]
+                          = None) -> T:
         """Fetch a peer's live checkpoint and restore it into ``target``'s
         structure (and shardings, when ``device_put``). Streams: each leaf
-        is read off the socket into a preallocated buffer and device_put
-        before the next is read — healing never buffers the full payload.
+        is read off the socket into a preallocated buffer, digest-verified
+        against the donor's manifest, and only then device_put — corrupt
+        or truncated bytes never reach the device.
 
-        Transient transport failures (connection reset mid-stream, a
-        truncated body, a refused dial while the donor restarts its
-        server) retry under ``retry_policy`` with backoff; each attempt
-        restarts the fetch from scratch, which is safe because the donor
-        serves an immutable per-step snapshot. Step/auth refusals (400 /
-        401 / 503) are fatal and surface immediately. Chaos injection
-        (endpoint ``heal``) wraps both the dial and the streamed body.
+        The transfer is RESUMABLE: the donor's ``/manifest`` endpoint
+        describes the stream (per-leaf offsets + crc32 digests), and each
+        fetch uses HTTP ``Range`` to re-enter at the first unverified
+        leaf, so a transport failure costs O(remaining), not O(state).
+        Transient failures (resets, truncation, a 503 while the donor's
+        serve window is closed at commit) retry under ``retry_policy``
+        with backoff — and because progress is durable, the attempt
+        budget bounds *consecutive zero-progress* failures, not total
+        failures, so a huge transfer that keeps advancing is never killed
+        by an arbitrary deadline. Step/auth refusals (400/401) stay
+        fatal. Donors without a manifest (``lock_streaming`` mode, older
+        builds) fall back to the legacy whole-stream fetch.
 
-        ``stats``, when given, is filled with ``{"bytes": <payload size>}``
-        so callers (Manager metrics) can report transfer volume without
-        re-parsing logs."""
+        Liveness comes from a stall watchdog, not a wall clock:
+        ``stall_timeout_sec`` bounds how long any single socket operation
+        may sit with no bytes arriving (default: ``timeout_sec``, the
+        legacy knob). A black-holed stream dies in seconds; a slow but
+        moving stream runs forever.
+
+        ``donors``, when given, enables DONOR FAILOVER: when the current
+        donor is classified dead (connection refused — its server socket
+        is gone — or a persistently corrupt leaf, or the zero-progress
+        budget is exhausted), ``donors(failover_index)`` is asked for a
+        fresh data URL and the SAME transfer continues there — committed
+        leaves are kept iff the new donor's manifest digests match what
+        was already verified, which is the runtime check of the
+        same-step-snapshots-are-bitwise-identical invariant.
+
+        ``stats``, when given, is filled with truthful counters:
+        ``bytes`` (payload bytes actually read off the wire, across all
+        attempts — NOT the donor's Content-Length claim),
+        ``payload_bytes`` (full serialized size), ``bytes_resumed``
+        (bytes fetched by resumed attempts after the first),
+        ``donor_failovers``, ``digest_mismatches``, and ``attempts`` —
+        filled on failure too, so a FAILED heal's wire cost and attempt
+        history still reach the caller's metrics/event log.
+        ``progress_cb(bytes_committed, payload_bytes)`` fires after every
+        verified leaf. Chaos injection uses per-donor endpoints
+        ``heal:<host:port>`` (channel ``heal``)."""
         logger.info("fetching checkpoint from %s", address)
         t0 = time.perf_counter()
-
-        def fetch_once() -> Tuple[T, int]:
-            tok = chaos.begin("heal", "fetch")
-            req = urllib.request.Request(address)
-            if auth_token is not None:
-                req.add_header("Authorization", f"Bearer {auth_token}")
-            with urllib.request.urlopen(req, timeout=timeout_sec) as resp:
-                nbytes = int(resp.headers.get("Content-Length", 0))
-                out = load_pytree_from(
-                    chaos.wrap_reader(resp, "heal"), target,
-                    device_put_fn=device_put_like if device_put else None)
-            chaos.end(tok)
-            return out, nbytes
-
-        # None keeps the pre-existing fail-on-first-error semantics of
-        # this public API (same convention as AsyncCheckpointer); the
-        # Manager opts in by passing its policy.
-        out, nbytes = call_with_retry(
-            fetch_once,
-            retry_policy if retry_policy is not None
-            else RetryPolicy(max_attempts=1),
-            stats=retry_stats, op="heal.fetch")
+        pol = (retry_policy if retry_policy is not None
+               else RetryPolicy(max_attempts=1))
+        stall = (stall_timeout_sec if stall_timeout_sec is not None
+                 else timeout_sec)
+        deadline = (t0 + pol.overall_deadline_ms / 1e3
+                    if pol.overall_deadline_ms > 0 else None)
+        dput = device_put_like if device_put else None
+        session = _HealSession(target, dput)
+        try:
+            out = cls._run_heal_loop(
+                session, address, stall, auth_token, pol, deadline,
+                donors, max_donor_failovers, progress_cb, retry_stats)
+        finally:
+            # Fill stats on BOTH outcomes: a failed heal's wire cost,
+            # attempts, and failovers are exactly what the runbook's
+            # "heal keeps failing" diagnosis reads from the event log.
+            if stats is not None:
+                stats["bytes"] = float(session.bytes_read)
+                stats["payload_bytes"] = float(session.total_len)
+                stats["bytes_resumed"] = float(session.bytes_resumed)
+                stats["donor_failovers"] = float(session.failovers)
+                stats["digest_mismatches"] = float(
+                    session.digest_mismatches)
+                stats["attempts"] = float(session.rounds)
         dt = time.perf_counter() - t0
-        logger.info("checkpoint transfer: %.1f MB in %.2fs (%.0f MB/s)",
-                    nbytes / 1e6, dt, nbytes / 1e6 / max(dt, 1e-9))
-        if stats is not None:
-            stats["bytes"] = float(nbytes)
+        logger.info(
+            "checkpoint transfer: %.1f MB in %.2fs (%.0f MB/s; "
+            "%d attempt(s), %.1f MB resumed, %d failover(s), "
+            "%d digest mismatch(es))",
+            session.bytes_read / 1e6, dt,
+            session.bytes_read / 1e6 / max(dt, 1e-9), session.rounds,
+            session.bytes_resumed / 1e6, session.failovers,
+            session.digest_mismatches)
+        return out
+
+    @classmethod
+    def _run_heal_loop(cls, session: "_HealSession", addr: str,
+                       stall: float, auth_token: Optional[str],
+                       pol: RetryPolicy, deadline: Optional[float],
+                       donors: Optional[Callable[[int], Optional[str]]],
+                       max_donor_failovers: int,
+                       progress_cb: Optional[Callable[[int, int], None]],
+                       retry_stats: Optional[RetryStats]) -> Any:
+        endpoint = _heal_endpoint(addr)
+        attempts = max(int(pol.max_attempts), 1)
+        no_progress = 0
+        legacy: Optional[bool] = None
+        need_manifest = True
+        while True:
+            marker = len(session.committed)
+            try:
+                if legacy is not True and need_manifest:
+                    mf = cls._fetch_manifest(addr, stall, auth_token,
+                                             endpoint)
+                    if mf is None:
+                        legacy = True
+                        logger.info(
+                            "heal: %s has no manifest; using legacy "
+                            "non-resumable fetch", addr)
+                    else:
+                        legacy = False
+                        session.adopt_manifest(mf)
+                        need_manifest = False
+                if legacy:
+                    session.rounds += 1
+                    return cls._legacy_fetch(
+                        addr, session.target, stall, auth_token,
+                        session.device_put_fn, session, endpoint)
+                if not session.complete():
+                    session.rounds += 1
+                    for span in session.spans():
+                        cls._fetch_span(addr, session, span, stall,
+                                        auth_token, endpoint, progress_cb)
+                if session.complete():
+                    return session.assemble()
+                # Every remaining leaf mismatched its digest this round:
+                # corruption in transit — transient, re-fetch (bounded
+                # per leaf by MAX_LEAF_REFETCHES inside _fetch_span).
+                raise LeafDigestError(
+                    f"{len(session.missing())} leaves failed digest "
+                    "verification; re-fetching")
+            except Exception as e:  # noqa: BLE001 — classified below
+                transient = _heal_transient(e)
+                dead = (isinstance(e, HealCorruptError)
+                        or _looks_donor_dead(e))
+                if not transient and not dead:
+                    raise
+                if len(session.committed) > marker:
+                    no_progress = 0
+                else:
+                    no_progress += 1
+                if ((dead or no_progress >= attempts)
+                        and donors is not None
+                        and session.failovers < max_donor_failovers):
+                    nxt: Optional[str] = None
+                    try:
+                        nxt = donors(session.failovers)
+                    except Exception:  # noqa: BLE001
+                        logger.exception("heal: donor resolver failed")
+                    if nxt:
+                        logger.warning(
+                            "heal: donor %s unusable (%s); failing over "
+                            "to %s with %d/%d leaves committed", addr, e,
+                            nxt, len(session.committed),
+                            len(session.pairs or ()))
+                        session.failovers += 1
+                        addr = nxt
+                        endpoint = _heal_endpoint(addr)
+                        need_manifest = True
+                        legacy = None
+                        no_progress = 0
+                        continue
+                if not transient or no_progress >= attempts:
+                    if retry_stats is not None and no_progress > 0:
+                        retry_stats.record_giveup()
+                    raise
+                delay = pol.delay_ms(min(max(no_progress - 1, 0), 16)) / 1e3
+                if (deadline is not None
+                        and time.perf_counter() + delay > deadline):
+                    if retry_stats is not None:
+                        retry_stats.record_giveup()
+                    raise RetryError(
+                        f"heal.fetch: overall retry deadline "
+                        f"({pol.overall_deadline_ms:.0f}ms) exhausted"
+                    ) from e
+                if retry_stats is not None:
+                    retry_stats.record_retry(delay * 1e3)
+                logger.warning(
+                    "heal fetch attempt failed (%s); retrying from "
+                    "%d/%d committed leaves", e, len(session.committed),
+                    len(session.pairs or ()))
+                time.sleep(delay)
+
+    @staticmethod
+    def _fetch_manifest(addr: str, stall: float,
+                        auth_token: Optional[str],
+                        endpoint: str) -> Optional[dict]:
+        """GET the donor's transfer manifest; ``None`` when the donor
+        cannot serve one (404: lock_streaming mode or an older build) —
+        the caller then uses the legacy whole-stream fetch."""
+        tok = chaos.begin(endpoint, "manifest")
+        try:
+            resp = _open_url(addr + MANIFEST_SUFFIX, stall, auth_token)
+        except urllib.error.HTTPError as e:
+            reason = str(getattr(e, "reason", "") or e).lower()
+            # 404: this build, lock_streaming mode. 400 "bad step": a
+            # PRE-manifest build, whose handler parses the step out of
+            # "<step>/manifest" and chokes — either way, no manifest to
+            # be had; fall back to the legacy whole-stream fetch. A real
+            # step mismatch says "invalid checkpoint requested" and
+            # stays fatal.
+            if e.code == 404 or (e.code == 400 and "bad step" in reason):
+                chaos.end(tok)
+                return None
+            raise
+        with resp:
+            # Read to EOF in bounded pieces: a single read(-1) could be
+            # truncated by the chaos kill clamp (or a flaky transport)
+            # and then fail as a confusing JSON parse error — looping
+            # lets the truncation surface as the transport error it is,
+            # and a short body below is checked against Content-Length.
+            reader = chaos.wrap_reader(resp, endpoint)
+            want = int(resp.headers.get("Content-Length", -1))
+            parts = []
+            while True:
+                piece = reader.read(65536)
+                if not piece:
+                    break
+                parts.append(piece)
+            body = b"".join(parts)
+            if 0 <= want != len(body):
+                raise ValueError("truncated checkpoint manifest")
+        chaos.end(tok)
+        mf = json.loads(body)
+        if mf.get("format") != MANIFEST_FORMAT:
+            raise ValueError(
+                f"invalid checkpoint manifest format {mf.get('format')!r}")
+        return mf
+
+    @staticmethod
+    def _fetch_span(addr: str, session: "_HealSession", span: list,
+                    stall: float, auth_token: Optional[str],
+                    endpoint: str,
+                    progress_cb: Optional[Callable[[int, int], None]]
+                    ) -> None:
+        """Fetch one contiguous byte span of missing leaves via an HTTP
+        Range request; verify + commit each leaf as it lands. Raises on
+        transport failure (committed leaves are retained by the session)
+        and :class:`HealCorruptError` when a leaf keeps mismatching."""
+        a, b, idxs = span
+        tok = chaos.begin(endpoint, "fetch")
+        resp = _open_url(addr, stall, auth_token,
+                         headers={"Range": f"bytes={a}-{b - 1}"})
+        counter = [0]
+        try:
+            reader = _CountingReader(
+                chaos.wrap_reader(resp, endpoint), counter)
+            status = getattr(resp, "status", None) or resp.getcode()
+            if status == 200 and a > 0:
+                # Server ignored Range (shouldn't happen against our own
+                # CheckpointServer): discard the prefix. The discarded
+                # bytes are still counted — they really crossed the wire.
+                remaining = a
+                while remaining > 0:
+                    chunk = reader.read(min(1 << 20, remaining))
+                    if not chunk:
+                        raise ValueError("truncated checkpoint stream")
+                    remaining -= len(chunk)
+            for i in idxs:
+                entry, tleaf = session.pairs[i]
+                arr = np.empty(entry["shape"],
+                               _resolve_dtype(entry["dtype"]))
+                mv = arr.reshape(-1).view(np.uint8).data
+                _read_exact_into(reader, mv)
+                crc = zlib.crc32(mv)
+                if "crc32" in entry and crc != int(entry["crc32"]):
+                    session.digest_mismatches += 1
+                    n = session.refetches[i] = \
+                        session.refetches.get(i, 0) + 1
+                    logger.warning(
+                        "heal: leaf %r digest mismatch "
+                        "(got %08x, manifest %08x; refetch %d/%d)",
+                        entry["key"], crc, int(entry["crc32"]), n,
+                        MAX_LEAF_REFETCHES)
+                    if n >= MAX_LEAF_REFETCHES:
+                        raise HealCorruptError(
+                            f"leaf {entry['key']!r} failed digest "
+                            f"verification {n} times; the donor's copy "
+                            "is corrupt")
+                    continue  # stays missing; next round re-spans it
+                session.commit(i, arr, crc)
+                if progress_cb is not None:
+                    progress_cb(session.committed_bytes, session.total_len)
+        finally:
+            resp.close()
+            session.note_bytes(counter[0])
+        chaos.end(tok)
+
+    @staticmethod
+    def _legacy_fetch(addr: str, target: T, stall: float,
+                      auth_token: Optional[str],
+                      device_put_fn: Optional[Callable],
+                      session: "_HealSession", endpoint: str) -> T:
+        """Whole-stream fetch for donors without a manifest. Restarts
+        from byte 0 on every attempt; bytes are still counted truthfully
+        via the wrapping reader (never the Content-Length claim)."""
+        tok = chaos.begin(endpoint, "fetch")
+        resp = _open_url(addr, stall, auth_token)
+        counter = [0]
+        try:
+            # Best-effort payload size for the progress gauge /
+            # resume-ratio consumers; the Content-Length CLAIM is fine
+            # here because stats["bytes"] stays counted, not claimed.
+            claimed = int(resp.headers.get("Content-Length", 0) or 0)
+            if claimed > 0 and session.total_len == 0:
+                session.total_len = claimed
+            out = load_pytree_from(
+                _CountingReader(chaos.wrap_reader(resp, endpoint),
+                                counter),
+                target, device_put_fn=device_put_fn)
+        finally:
+            resp.close()
+            session.note_bytes(counter[0])
+        chaos.end(tok)
         return out

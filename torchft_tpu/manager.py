@@ -138,7 +138,19 @@ class Manager:
             timing. Retry counts/latencies surface in :meth:`metrics` and
             the manager's ``/metrics.json``; the
             ``max_consecutive_failures`` fail-fast streak acts as the
-            circuit breaker above this layer.
+            circuit breaker above this layer. For the heal fetch the
+            attempt budget bounds *consecutive zero-progress* failures —
+            the transfer is resumable, so progress resets the budget.
+        heal_stall_timeout_sec: heal progress watchdog (env
+            ``TORCHFT_HEAL_STALL_SEC``, default 30): a heal transfer is
+            aborted when NO bytes arrive for this long — replacing the
+            old fixed 300 s wall clock, which killed huge transfers that
+            were moving and kept wedged ones alive for minutes. The
+            fetch is resumable, so an abort costs O(remaining), not
+            O(state).
+        heal_max_donor_failovers: how many times one heal may fail over
+            to a freshly-resolved donor (via re-quorum) after the
+            current donor is classified dead.
     """
 
     def __init__(
@@ -165,6 +177,8 @@ class Manager:
         auth_token: Optional[str] = None,
         checkpoint_bind_host: Optional[str] = None,
         retry_policy: Optional[RetryPolicy] = None,
+        heal_stall_timeout_sec: Optional[float] = None,
+        heal_max_donor_failovers: int = 3,
         _manager_client: Optional[ManagerClient] = None,
     ) -> None:
         self._comm = comm
@@ -209,6 +223,18 @@ class Manager:
             "reconfigure_count": 0, "reconfigure_ms_total": 0.0,
             "heal_count": 0,
             "heal_ms_total": 0.0, "heal_bytes_total": 0.0,
+            # Resilient-heal observability: bytes re-sent by resumed
+            # attempts (strictly less than the payload when resume
+            # works), donor failovers, leaves caught by digest
+            # verification, fetch rounds, and a live progress gauge
+            # (committed/payload bytes of the CURRENT transfer, updated
+            # per verified leaf — visible mid-heal in /metrics.json).
+            "heal_bytes_resumed_total": 0.0,
+            "heal_donor_failovers": 0.0,
+            "heal_leaf_digest_mismatches": 0.0,
+            "heal_attempts_total": 0.0,
+            "heal_last_bytes_committed": 0.0,
+            "heal_last_payload_bytes": 0.0,
             "allreduce_count": 0, "allreduce_ms_total": 0.0,
             # Stage breakdown of the pipelined host allreduce (cumulative
             # BUSY ms per stage; stages overlap across buckets, so sums
@@ -236,6 +262,14 @@ class Manager:
         self._retry_policy = (retry_policy if retry_policy is not None
                               else RetryPolicy())
         self._retry_stats = RetryStats()
+        # Heal resilience knobs: the stall watchdog (no-bytes-for-N-sec
+        # abort; the fetch resumes, so an abort is cheap) and the donor-
+        # failover budget of one heal.
+        if heal_stall_timeout_sec is None:
+            heal_stall_timeout_sec = float(
+                os.environ.get("TORCHFT_HEAL_STALL_SEC", 30.0))
+        self._heal_stall_timeout_sec = float(heal_stall_timeout_sec)
+        self._heal_max_donor_failovers = int(heal_max_donor_failovers)
         # Hand the policy + shared counters to the communicator we drive:
         # its own transport retries (ring dial, rendezvous store client)
         # must follow the one configured policy and show up in metrics()
@@ -567,13 +601,21 @@ class Manager:
                     self._rank, timeout_ms=self._timeout_ms
                 )
                 target = self._manager_state_dict()
+                with self._metrics_lock:  # fresh gauges for this transfer
+                    self._metrics["heal_last_bytes_committed"] = 0.0
+                    self._metrics["heal_last_payload_bytes"] = 0.0
                 state = cast(
                     Dict[str, Any],
                     CheckpointServer.load_from_address(
                         ckpt_addr, target, stats=heal_stats,
                         auth_token=self._auth_token,
                         retry_policy=self._retry_policy,
-                        retry_stats=self._retry_stats),
+                        retry_stats=self._retry_stats,
+                        stall_timeout_sec=self._heal_stall_timeout_sec,
+                        donors=lambda i: self._resolve_next_donor(i, q),
+                        max_donor_failovers=(
+                            self._heal_max_donor_failovers),
+                        progress_cb=self._heal_progress),
                 )
             finally:
                 # Failed heals count too: without this, an aborted fetch's
@@ -584,12 +626,24 @@ class Manager:
                 self._record(
                     heal_ms_total=heal_ms,
                     heal_bytes_total=heal_stats.get("bytes", 0.0),
+                    heal_bytes_resumed_total=heal_stats.get(
+                        "bytes_resumed", 0.0),
+                    heal_donor_failovers=heal_stats.get(
+                        "donor_failovers", 0.0),
+                    heal_leaf_digest_mismatches=heal_stats.get(
+                        "digest_mismatches", 0.0),
+                    heal_attempts_total=heal_stats.get("attempts", 0.0),
                 )
                 self._log_event(
                     event="heal", step=self._step,
                     source=q.recover_manager_address,
                     ms=round(heal_ms, 1),
                     bytes=heal_stats.get("bytes", 0.0),
+                    resumed=heal_stats.get("bytes_resumed", 0.0),
+                    attempts=heal_stats.get("attempts", 0.0),
+                    failovers=heal_stats.get("donor_failovers", 0.0),
+                    digest_mismatches=heal_stats.get(
+                        "digest_mismatches", 0.0),
                 )
             # Manager metadata restores immediately on this thread; the user
             # pytree is staged and applied on the main thread at commit
@@ -602,6 +656,71 @@ class Manager:
         logger.info("%s applying healed user state", self._replica_id)
         self._user_load_state_dict(self._pending_state_dict["user"])
         self._pending_state_dict = None
+
+    def _heal_progress(self, committed: int, payload: int) -> None:
+        """Per-verified-leaf progress gauge of the current heal transfer
+        (rides metrics()/metrics.json, so an operator can watch a heal
+        advance instead of staring at a silent multi-minute fetch)."""
+        with self._metrics_lock:
+            self._metrics["heal_last_bytes_committed"] = float(committed)
+            self._metrics["heal_last_payload_bytes"] = float(payload)
+
+    def _resolve_next_donor(self, failover_idx: int,
+                            q: Any) -> Optional[str]:
+        """The current donor died mid-heal: re-resolve a fresh one.
+
+        Joins a NEW quorum round — the dead donor's lapsed heartbeat
+        drops it from membership, so the round's ``recover_manager_
+        address`` points at a healthy peer (participants join the round
+        at their next step start; the wait is bounded by the quorum
+        timeout). The resumable transfer continues against the new donor
+        only when it still serves the SAME ``max_step`` — same-step
+        snapshots are bitwise identical across replicas (verified leaf-
+        by-leaf via manifest digests), which is what makes cross-donor
+        resume sound. Returns ``None`` when no usable donor emerged (the
+        heal then fails; the step aborts and the next step's quorum
+        starts a fresh heal).
+
+        A mid-heal re-quorum can advance the quorum id; the stored
+        ``_quorum_id`` is deliberately NOT updated here, so the next
+        step's quorum round sees the change and reconfigures the
+        communicator normally. This step's collective may abort (we
+        contribute zeros while healing anyway) — the point is that the
+        TRANSFER survives, which is the expensive part."""
+        try:
+            q2 = self._client.quorum(
+                rank=self._rank,
+                step=self._step,
+                checkpoint_server_addr=self._ckpt_server.address(),
+                timeout_ms=self._quorum_timeout_ms,
+            )
+            if not q2.heal or q2.max_step != q.max_step:
+                logger.warning(
+                    "%s: donor failover abandoned — re-quorum moved on "
+                    "(heal=%s max_step %d→%d); the next step restarts "
+                    "the heal", self._replica_id, q2.heal, q.max_step,
+                    q2.max_step)
+                return None
+            primary = ManagerClient(
+                q2.recover_manager_address,
+                connect_timeout_ms=self._timeout_ms,
+                retry_policy=self._retry_policy,
+                retry_stats=self._retry_stats,
+            )
+            ckpt_addr = primary.checkpoint_address(
+                self._rank, timeout_ms=self._timeout_ms)
+            self._log_event(
+                event="heal_failover", step=self._step,
+                n=failover_idx + 1, donor=q2.recover_manager_address)
+            logger.info(
+                "%s: heal failing over to donor %s (#%d)",
+                self._replica_id, q2.recover_manager_address,
+                failover_idx + 1)
+            return ckpt_addr
+        except Exception:  # noqa: BLE001 — resolver failure ends the heal
+            logger.exception("%s: donor re-resolution failed",
+                             self._replica_id)
+            return None
 
     # ------------------------------------------------------------- allreduce
 

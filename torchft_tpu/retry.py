@@ -78,6 +78,11 @@ _TRANSIENT_MARKERS = (
     "short write",
     "truncated",
     "reconnect",
+    # The donor's HTTP 503 while its serve window is shut at commit:
+    # transient BY CONSTRUCTION — the window reopens at the donor's next
+    # step start. (503 "shutting down" stays fatal via the marker
+    # above.)
+    "serve window closed",
 )
 
 # Markers that must NEVER retry even when a transient marker also matches

@@ -30,7 +30,9 @@ cannot execute code on the healer.
 from __future__ import annotations
 
 import json
-from typing import Any, BinaryIO, Callable, Iterator, Optional, Tuple
+import threading
+import zlib
+from typing import Any, BinaryIO, Callable, Iterator, List, Optional, Tuple
 
 import jax
 import numpy as np
@@ -77,11 +79,65 @@ def _is_array_leaf(leaf: Any) -> bool:
     return isinstance(leaf, (np.ndarray, np.generic, jax.Array))
 
 
-def plan_pytree(tree: Any) -> Tuple[bytes, int, list]:
+class PytreePlan:
+    """Streaming plan for one serialized pytree: the preamble (magic +
+    header), the total serialized length, the array leaves in body order,
+    and the parsed header dict. Iterates/unpacks like the historical
+    ``(preamble, total_len, array_leaves)`` tuple so existing callers keep
+    working.
+
+    Per-leaf content digests (:meth:`digests`) are computed LAZILY — the
+    plan itself stays metadata-only (no device data fetched) until a
+    caller (the checkpoint server's manifest endpoint) actually needs
+    them, and the one digesting pass is cached so N healers / resumed
+    attempts against the same snapshot pay for it once."""
+
+    __slots__ = ("preamble", "total_len", "array_leaves", "header",
+                 "_digests", "_digest_lock")
+
+    def __init__(self, preamble: bytes, total_len: int,
+                 array_leaves: list, header: dict) -> None:
+        self.preamble = preamble
+        self.total_len = total_len
+        self.array_leaves = array_leaves
+        self.header = header
+        self._digests: Optional[List[int]] = None
+        self._digest_lock = threading.Lock()
+
+    # --- legacy (preamble, total_len, array_leaves) tuple protocol ------
+    def __iter__(self):
+        return iter((self.preamble, self.total_len, self.array_leaves))
+
+    def __getitem__(self, i):
+        return (self.preamble, self.total_len, self.array_leaves)[i]
+
+    def __len__(self) -> int:
+        return 3
+
+    def digests(self, batch_bytes: int = 0) -> List[int]:
+        """Per-array-leaf crc32 of the raw serialized bytes, in body
+        order. Computed once (a batched ``device_get`` pass at O(batch)
+        host RAM, like streaming) and cached; safe under concurrent
+        manifest requests. crc32 is not cryptographic — it detects
+        truncation/corruption in transit, and doubles as the runtime
+        check of the cross-donor same-step bitwise-identity invariant
+        (donors for one step must produce identical digests)."""
+        with self._digest_lock:
+            if self._digests is None:
+                bb = batch_bytes or DEFAULT_BATCH_BYTES
+                self._digests = [
+                    zlib.crc32(mv)
+                    for _, mv in _iter_leaf_views(self.array_leaves, bb)
+                ]
+            return list(self._digests)
+
+
+def plan_pytree(tree: Any) -> PytreePlan:
     """Compute the serialized header from leaf *metadata* only — no device
-    data is fetched. Returns ``(preamble_bytes, total_len, array_leaves)``
-    where ``preamble_bytes`` is magic+header, ``total_len`` the full
-    serialized size (so HTTP can send Content-Length before streaming), and
+    data is fetched. Returns a :class:`PytreePlan` (unpacks as the legacy
+    ``(preamble_bytes, total_len, array_leaves)`` tuple) where
+    ``preamble_bytes`` is magic+header, ``total_len`` the full serialized
+    size (so HTTP can send Content-Length before streaming), and
     ``array_leaves`` the leaves whose raw bytes follow, in body order."""
     leaves_with_path = jax.tree_util.tree_flatten_with_path(tree)[0]
     header: dict = {"leaves": []}
@@ -107,16 +163,50 @@ def plan_pytree(tree: Any) -> Tuple[bytes, int, list]:
             header["leaves"].append({"key": key, "kind": "py", "value": leaf})
     hdr = json.dumps(header).encode()
     preamble = _MAGIC + len(hdr).to_bytes(4, "little") + hdr
-    return preamble, len(preamble) + offset, array_leaves
+    return PytreePlan(preamble, len(preamble) + offset, array_leaves, header)
 
 
 DEFAULT_BATCH_BYTES = 64 * 1024 * 1024
 
 
+def _leaf_nbytes(leaf: Any) -> int:
+    return int(np.prod(leaf.shape, dtype=np.int64)
+               ) * np.dtype(leaf.dtype).itemsize
+
+
+def _iter_leaf_views(array_leaves: list, batch_bytes: int,
+                     ) -> Iterator[Tuple[int, memoryview]]:
+    """Host-materialize ``array_leaves`` in batched ``jax.device_get``
+    groups of up to ``batch_bytes`` and yield ``(leaf_index,
+    uint8_memoryview)`` per leaf, in order — the shared fetch engine
+    under streaming serialization and digest computation. Peak extra
+    host RAM is O(batch), not O(checkpoint)."""
+    group: list = []
+    group_bytes = 0
+
+    def flush():
+        fetched = jax.device_get([leaf for _, leaf in group])
+        for (i, _), arr in zip(group, fetched):
+            arr = np.ascontiguousarray(arr)
+            yield i, arr.reshape(-1).view(np.uint8).data
+
+    for i, leaf in enumerate(array_leaves):
+        nbytes = _leaf_nbytes(leaf)
+        if group and group_bytes + nbytes > batch_bytes:
+            yield from flush()
+            group, group_bytes = [], 0
+        group.append((i, leaf))
+        group_bytes += nbytes
+    if group:
+        yield from flush()
+
+
 def iter_pytree_chunks(tree: Any,
                        chunk_bytes: int = DEFAULT_CHUNK_BYTES,
-                       plan: Optional[Tuple[bytes, int, list]] = None,
+                       plan: Optional[Any] = None,
                        batch_bytes: int = DEFAULT_BATCH_BYTES,
+                       start: int = 0,
+                       end: Optional[int] = None,
                        ) -> Iterator[memoryview]:
     """Stream-serialize: yields the preamble, then the array leaves' raw
     bytes in ``chunk_bytes`` slices. Leaves are host-materialized in
@@ -125,30 +215,49 @@ def iter_pytree_chunks(tree: Any,
     dispatch round-trips, not thousands), so peak extra host RAM is
     O(batch), not O(checkpoint). Slices are zero-copy memoryviews.
     ``plan`` reuses a precomputed :func:`plan_pytree` result (the HTTP
-    server plans once for Content-Length and must stream that same plan)."""
-    preamble, _, array_leaves = plan if plan is not None else plan_pytree(tree)
-    yield memoryview(preamble)
-    group: list = []
-    group_bytes = 0
+    server plans once for Content-Length and must stream that same plan).
 
-    def flush():
-        fetched = jax.device_get(group)
-        for arr in fetched:
-            arr = np.ascontiguousarray(arr)
-            mv = arr.reshape(-1).view(np.uint8).data
+    ``start``/``end`` select a byte range of the serialized stream
+    (``end=None`` = to the end): leaves wholly outside the range are
+    skipped WITHOUT fetching any device data, which is what makes a
+    resumed heal transfer O(remaining bytes) on the donor side too, not
+    just on the wire."""
+    preamble, total_len, array_leaves = (
+        plan if plan is not None else plan_pytree(tree))
+    hi = total_len if end is None else min(int(end), total_len)
+    lo = max(int(start), 0)
+    if lo == 0 and hi >= total_len:
+        # Full-stream fast path, bitwise-identical to the historical
+        # behavior (including the single empty chunk a 0-size leaf
+        # yields).
+        yield memoryview(preamble)
+        for _, mv in _iter_leaf_views(array_leaves, batch_bytes):
             for i in range(0, len(mv) or 1, chunk_bytes):
                 yield mv[i:i + chunk_bytes]
-
-    for leaf in array_leaves:
-        nbytes = int(np.prod(leaf.shape, dtype=np.int64)
-                     ) * np.dtype(leaf.dtype).itemsize
-        if group and group_bytes + nbytes > batch_bytes:
-            yield from flush()
-            group, group_bytes = [], 0
-        group.append(leaf)
-        group_bytes += nbytes
-    if group:
-        yield from flush()
+        return
+    if lo >= hi:
+        return
+    if lo < len(preamble):
+        mv = memoryview(preamble)[lo:min(hi, len(preamble))]
+        for i in range(0, len(mv), chunk_bytes):
+            yield mv[i:i + chunk_bytes]
+    # Select only the leaves overlapping [lo, hi); record the slice of
+    # each so a range entering mid-leaf still serves exact bytes.
+    off = len(preamble)
+    wanted: list = []
+    slices: dict = {}
+    for idx, leaf in enumerate(array_leaves):
+        nbytes = _leaf_nbytes(leaf)
+        a, b = max(lo, off), min(hi, off + nbytes)
+        if a < b:
+            slices[len(wanted)] = (a - off, b - off)
+            wanted.append(leaf)
+        off += nbytes
+    for j, mv in _iter_leaf_views(wanted, batch_bytes):
+        s, e = slices[j]
+        mv = mv[s:e]
+        for i in range(0, len(mv), chunk_bytes):
+            yield mv[i:i + chunk_bytes]
 
 
 def save_pytree(tree: Any) -> bytes:
