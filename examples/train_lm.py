@@ -184,7 +184,11 @@ def main() -> None:
     if ckpt_dir:
         from torchft_tpu import checkpoint_io
 
-        path = checkpoint_io.latest(os.path.join(ckpt_dir, ckpt_name))
+        # recover(), not latest(): the newest file may be torn (crash
+        # mid-write on a non-atomic filesystem) or bit-rotted — the scan
+        # verifies digests, quarantines bad files, and falls back to the
+        # previous good snapshot instead of crashing the trainer.
+        path = checkpoint_io.recover(os.path.join(ckpt_dir, ckpt_name))
         if path:
             target = {"trainer": trainer.state_dict()}
             if not elastic:
@@ -218,9 +222,11 @@ def main() -> None:
             user = {"trainer": trainer.state_dict()}
             if not elastic:
                 user["loader"] = batches.state_dict()
-            ckpt_writer.save_async(
-                os.path.join(ckpt_dir, ckpt_name, f"ckpt_{step}"),
-                user, m.state_dict())
+            # Commit-coupled: the manager stamps step + quorum metadata
+            # into the file head and refuses to snapshot mid-heal /
+            # errored state (checkpoint cadence bounds the gap).
+            m.save_durable(ckpt_writer, os.path.join(ckpt_dir, ckpt_name),
+                           user_state=user)
         if step % 10 == 0:
             dt = time.perf_counter() - t0
             logger.info(

@@ -47,6 +47,21 @@ if [[ "${1:-}" == "bench-smoke" ]]; then
     exit 0
 fi
 
+# Cold-start tier: seeded kill-all → cold-restart soak — every round a
+# 2-group job checkpoints under disk chaos (torn writes, silent
+# bit-flips, ENOSPC), the whole fleet "dies", and recovery must come
+# back from the newest verified committed snapshot: never loading
+# unverified bytes, never regressing past the newest clean save (see
+# docs/design/durable_checkpoints.md). cold_start tests are also marked
+# `slow`+`nightly`, so they ride the nightly tier too; run this tier on
+# checkpoint_io / recovery changes.
+if [[ "${1:-}" == "cold-start" ]]; then
+    stage cold-start env JAX_PLATFORMS=cpu \
+        python -m pytest tests/test_cold_start.py -q -m cold_start
+    echo "== total: ${SECONDS}s"
+    exit 0
+fi
+
 # Heal-soak tier: seeded chaos soak of repeated heals with donor churn —
 # every round the primary donor is killed mid-stream while resets/short
 # reads pepper the heal channel; each heal must complete bitwise-

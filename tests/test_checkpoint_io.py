@@ -67,15 +67,14 @@ class TestAtomicity:
                                                    "batches_committed": 5})
         good = open(path, "rb").read()
 
-        real_iter = checkpoint_io.iter_pytree_chunks
+        real_iter = checkpoint_io._iter_leaf_views
 
-        def dies_midway(tree):
-            it = real_iter(tree)
-            yield next(it)
+        def dies_midway(leaves, batch_bytes):
+            it = real_iter(leaves, batch_bytes)
             yield next(it)
             raise OSError("disk died mid-write")
 
-        monkeypatch.setattr(checkpoint_io, "iter_pytree_chunks", dies_midway)
+        monkeypatch.setattr(checkpoint_io, "_iter_leaf_views", dies_midway)
         with pytest.raises(OSError, match="disk died"):
             checkpoint_io.save(path, user_state(9.9), {"step": 6,
                                                        "batches_committed": 6})

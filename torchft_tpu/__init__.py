@@ -20,6 +20,7 @@ from torchft_tpu._native import (
 from torchft_tpu.chaos import (ChaosCommunicator, ChaosSchedule,
                                EndpointChaos)
 from torchft_tpu.checkpointing import CheckpointServer
+from torchft_tpu.checkpoint_io import AsyncCheckpointer
 from torchft_tpu.retry import (RetryError, RetryPolicy, RetryStats,
                                call_with_retry, is_transient)
 from torchft_tpu.communicator import (
@@ -40,6 +41,7 @@ from torchft_tpu.manager import Manager, WorldSizeMode
 from torchft_tpu.optim import FTOptimizer, OptimizerWrapper
 
 __all__ = [
+    "AsyncCheckpointer",
     "BatchIterator",
     "ChaosCommunicator",
     "ChaosSchedule",

@@ -17,7 +17,10 @@ injects exactly those, deterministically, at every Python-side transport:
   never arrived, a "post" fault a lost response, which is the case the
   server-side ``call_seq`` idempotency exists for);
 * the manager's cross-group allreduce path via
-  :class:`ChaosCommunicator`, a fault-injecting Communicator shim.
+  :class:`ChaosCommunicator`, a fault-injecting Communicator shim;
+* the durable checkpoint writer (:mod:`torchft_tpu.checkpoint_io`) via
+  :func:`disk_fault` on the ``disk`` channel (torn writes, post-rename
+  bit-flips, ENOSPC, stalled IO).
 
 Faults come from a :class:`ChaosSchedule`: a per-endpoint configuration
 (latency, jitter, connection resets, short reads/writes, black-holes,
@@ -41,7 +44,7 @@ Activation:
   ``seed=<int>`` first (optional, default 0), then
   ``<channel>:<field>=<value>,...`` clauses separated by ``;`` where
   ``<channel>`` is an endpoint channel (``ring``, ``store``,
-  ``manager``, ``heal``, ``allreduce``) or ``*`` for all, and
+  ``manager``, ``heal``, ``allreduce``, ``disk``) or ``*`` for all, and
   ``<field>`` is any :class:`EndpointChaos` field.
 
 When nothing is installed and ``TORCHFT_CHAOS`` is unset, every hook is
@@ -76,6 +79,7 @@ __all__ = [
     "wrap_reader",
     "begin",
     "end",
+    "disk_fault",
 ]
 
 
@@ -98,17 +102,37 @@ class EndpointChaos:
     # way a dead peer process behaves — until ChaosSchedule.revive().
     kill_rate: float = 0.0       # per-op probability of dying mid-op
     kill_after_bytes: float = -1.0  # die once this many bytes streamed
+    # Disk faults (the ``disk`` channel, honored by
+    # :func:`torchft_tpu.checkpoint_io.save` via :func:`disk_fault`):
+    #   torn   — the process "crashes" before the atomic rename, leaving
+    #            a partial file at the DESTINATION path (modeling a
+    #            non-atomic writer or a post-power-loss rename that was
+    #            never made durable by a directory fsync);
+    #   flip   — the save succeeds, then one byte of the on-disk file is
+    #            flipped (silent storage corruption, caught only by
+    #            digest verification at load/verify time);
+    #   enospc — the write fails with ``OSError(ENOSPC)`` (fatal-but-
+    #            reported class, unlike the transient EIO family).
+    # Slow/stalled disk IO reuses latency_ms/jitter_ms and
+    # blackhole_rate/blackhole_ms (a blackholed save wedges for
+    # blackhole_ms, then fails ETIMEDOUT — what the checkpoint stall
+    # watchdog exists to bound).
+    torn_rate: float = 0.0
+    flip_rate: float = 0.0
+    enospc_rate: float = 0.0
     max_faults: int = -1         # cap on hard faults per channel (-1 = inf)
 
 
 @dataclass(frozen=True)
 class Decision:
     """One injection decision. ``fault`` is ``None``, ``"reset"``,
-    ``"short"``, ``"blackhole"`` or ``"kill"`` (the endpoint dies and
-    stays dead); ``phase`` is ``"pre"`` (request never
+    ``"short"``, ``"blackhole"``, ``"kill"`` (the endpoint dies and
+    stays dead), or a disk fault — ``"torn"``, ``"flip"``, ``"enospc"``
+    (see :func:`disk_fault`); ``phase`` is ``"pre"`` (request never
     arrived) or ``"post"`` (response lost) and is honored by the RPC
     shims only — socket faults fire at IO time. ``frac`` is the fraction
-    of a short transfer that completes."""
+    of a short transfer that completes (and doubles as the torn-write
+    prefix fraction / flipped-byte position for disk faults)."""
 
     endpoint: str
     op: str
@@ -190,18 +214,24 @@ class ChaosSchedule:
             delay = cfg.latency_ms
             if cfg.jitter_ms > 0:
                 delay += rng.uniform(0.0, cfg.jitter_ms)
+            # One uniform draw selects among the fault kinds by
+            # cumulative rate (order is part of the determinism
+            # contract: reproducing a trace requires these bands to
+            # stay stable across versions).
             fault: Optional[str] = None
             u = rng.random()
-            if u < cfg.reset_rate:
-                fault = "reset"
-            elif u < cfg.reset_rate + cfg.short_rate:
-                fault = "short"
-            elif u < (cfg.reset_rate + cfg.short_rate
-                      + cfg.blackhole_rate):
-                fault = "blackhole"
-            elif u < (cfg.reset_rate + cfg.short_rate
-                      + cfg.blackhole_rate + cfg.kill_rate):
-                fault = "kill"
+            acc = 0.0
+            for rate, kind in ((cfg.reset_rate, "reset"),
+                               (cfg.short_rate, "short"),
+                               (cfg.blackhole_rate, "blackhole"),
+                               (cfg.kill_rate, "kill"),
+                               (cfg.torn_rate, "torn"),
+                               (cfg.flip_rate, "flip"),
+                               (cfg.enospc_rate, "enospc")):
+                acc += rate
+                if u < acc:
+                    fault = kind
+                    break
             # Draw phase/frac unconditionally so the stream position does
             # not depend on whether a fault fired (keeps decision n a pure
             # function of (seed, channel, n) even across config edits).
@@ -428,6 +458,53 @@ def end(decision: Optional[Decision]) -> None:
         raise ConnectionResetError(
             f"[chaos] {decision.endpoint}/{decision.op}"
             f"#{decision.n}: connection reset by peer (response lost)")
+
+
+# ---------------------------------------------------------- disk faults
+
+
+def disk_fault(endpoint: str, op: str = "save",
+               schedule: Optional[ChaosSchedule] = None
+               ) -> Optional[Decision]:
+    """Pre-write hook for durable checkpoint saves (channel ``disk``;
+    :func:`torchft_tpu.checkpoint_io.save` calls it per save with
+    endpoint ``disk:<filename>``).
+
+    Applies latency, then raises the faults that ARE write errors:
+    ``blackhole`` sleeps ``blackhole_ms`` (a wedged NFS write — the
+    caller's stall watchdog should fire long before) and raises
+    ``OSError(ETIMEDOUT)`` (transient class); ``enospc`` raises
+    ``OSError(ENOSPC)`` (fatal-but-reported class); ``reset``/``short``/
+    ``kill`` map to ``OSError(EIO)`` (transient flaky-filesystem class).
+    ``torn`` and ``flip`` decisions are RETURNED for the writer to act
+    on — they need the serialized bytes / the final file: torn = leave a
+    ``frac``-prefix of the stream at the DESTINATION path and "crash";
+    flip = complete the save, then flip the byte at ``frac`` of the
+    file (silent corruption only digest verification can catch)."""
+    import errno
+
+    sched = schedule if schedule is not None else active()
+    if sched is None:
+        return None
+    d = sched.decide(endpoint, op)
+    if d is None:
+        return None
+    if d.delay_ms > 0:
+        time.sleep(d.delay_ms / 1e3)
+    if d.fault == "blackhole":
+        time.sleep(d.blackhole_ms / 1e3)
+        raise OSError(
+            errno.ETIMEDOUT,
+            f"[chaos] {endpoint}/{op}#{d.n}: disk IO stalled, timed out")
+    if d.fault == "enospc":
+        raise OSError(
+            errno.ENOSPC,
+            f"[chaos] {endpoint}/{op}#{d.n}: no space left on device")
+    if d.fault in ("reset", "short", "kill"):
+        raise OSError(
+            errno.EIO,
+            f"[chaos] {endpoint}/{op}#{d.n}: input/output error")
+    return d
 
 
 # ------------------------------------------------------------- sockets

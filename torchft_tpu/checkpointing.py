@@ -66,6 +66,7 @@ from torchft_tpu.serialization import (
     device_put_like,
     iter_pytree_chunks,
     load_pytree_from,
+    manifest_from,
     plan_pytree,
 )
 
@@ -101,21 +102,14 @@ def build_manifest(plan: Any, step: int) -> dict:
     body coordinates and a ``crc32`` content digest) plus the stream
     geometry a resuming healer needs (``preamble_len``, ``total_len``).
     Digests come from :meth:`PytreePlan.digests` — computed once per
-    snapshot, cached, shared by every healer."""
-    digs = iter(plan.digests())
-    leaves = []
-    for e in plan.header["leaves"]:
-        e = dict(e)
-        if e["kind"] == "array":
-            e["crc32"] = next(digs)
-        leaves.append(e)
+    snapshot, cached, shared by every healer. The digest/geometry core
+    is :func:`torchft_tpu.serialization.manifest_from`, shared with the
+    durable on-disk checkpoint trailer
+    (:mod:`torchft_tpu.checkpoint_io`)."""
     return {
         "format": MANIFEST_FORMAT,
         "step": int(step),
-        "digest": "crc32",
-        "preamble_len": len(plan.preamble),
-        "total_len": int(plan.total_len),
-        "leaves": leaves,
+        **manifest_from(plan),
     }
 
 

@@ -252,6 +252,19 @@ class Manager:
             "allreduce_put_ms_total": 0.0, "allreduce_wire_bytes_total": 0.0,
             "commit_count": 0, "commit_ms_total": 0.0,
             "committed_steps": 0, "aborted_steps": 0,
+            # Durable-checkpoint observability (cold-start resilience,
+            # docs/design/durable_checkpoints.md): corrupt snapshots
+            # quarantined / newer candidates skipped by recovery scans,
+            # cold starts performed, and commit-coupled saves refused
+            # because the state was mid-heal/errored/uncommitted. The
+            # writer-side counters (ckpt_save_count/-fatal/-stalls, last
+            # error) merge in from the attached AsyncCheckpointer in
+            # metrics().
+            "ckpt_corrupt_quarantined": 0.0,
+            "ckpt_recover_fallbacks": 0.0,
+            "ckpt_recover_legacy": 0.0,
+            "ckpt_cold_starts": 0.0,
+            "ckpt_save_skipped": 0.0,
         }
         self._metrics_lock = threading.Lock()
         # Unified transient-error retry policy + shared counters for every
@@ -314,6 +327,9 @@ class Manager:
         # by (treedef, leaf metadata, bucket_bytes, wire_dtype) — see
         # _get_schedule().
         self._sched_cache: Dict[tuple, _AllreduceSchedule] = {}
+        # Attached durable-checkpoint writer (save_durable); its save
+        # counters and last error ride metrics()/metrics.json.
+        self._ckpt_writer: Optional[Any] = None
 
         # --- checkpoint transport (component 8) --------------------------
         # Shared-secret + bind hardening (round-3 verdict weak #6): the
@@ -1297,7 +1313,114 @@ class Manager:
         ring_bytes = getattr(self._comm, "ring_bytes_total", None)
         out["allreduce_ring_wire_bytes_total"] = (
             float(ring_bytes()) if ring_bytes is not None else 0.0)
+        # Durable-writer counters (saves, fatal ENOSPC/EROFS class,
+        # stalls, bytes) + its sticky last error, so /metrics.json shows
+        # a dying checkpoint disk long before the next cold start needs
+        # it.
+        if self._ckpt_writer is not None:
+            out.update(self._ckpt_writer.metrics())
+            last = self._ckpt_writer.last_error()
+            if last:
+                out["ckpt_last_error"] = last
         return out
+
+    # ------------------------------------------------- durable checkpoints
+
+    def save_durable(self, writer: Any, directory: str,
+                     prefix: str = "ckpt_",
+                     user_state: Optional[Any] = None) -> Optional[Future]:
+        """Commit-coupled durable snapshot: write
+        ``{directory}/{prefix}{step}`` via ``writer``
+        (:class:`~torchft_tpu.checkpoint_io.AsyncCheckpointer`), stamping
+        the commit step + quorum metadata (``quorum_id``, ``replica_id``,
+        participant count) and the ``committed`` marker into the file
+        head.
+
+        Refuses — returning ``None`` and counting ``ckpt_save_skipped`` —
+        when the current state did NOT come from a committed step: a heal
+        is staged/unapplied, an error is latched, or the last commit vote
+        aborted. A snapshot taken then would durably persist exactly the
+        inconsistent state durable checkpoints exist to escape; the next
+        committed step's save covers the gap (one cadence, bounded).
+
+        ``user_state`` overrides the snapshot source for callers whose
+        durable tree is richer than the manager-registered state (e.g. a
+        trainer that checkpoints its data-loader position alongside);
+        default is this manager's registered ``state_dict`` callable.
+        Recovery is :meth:`cold_start` (or
+        :func:`torchft_tpu.checkpoint_io.recover` directly)."""
+        with self._metrics_lock:
+            healing = self._healing
+        committed = self._should_step
+        if healing or self._errored is not None or not committed:
+            logger.warning(
+                "%s: skipping durable snapshot at step %d "
+                "(healing=%s errored=%s committed=%s) — state is not a "
+                "committed step's", self._replica_id, self._step, healing,
+                self._errored is not None, committed)
+            self._record(ckpt_save_skipped=1)
+            self._log_event(
+                event="ckpt_skip", step=self._step, healing=healing,
+                errored=self._errored is not None, committed=committed)
+            return None
+        self._ckpt_writer = writer
+        meta = {
+            "committed": True,
+            "quorum_id": self._quorum_id,
+            "replica_id": self._replica_id,
+            "participants": self._participating_world_size,
+        }
+        path = os.path.join(directory, f"{prefix}{self._step}")
+        state = (user_state if user_state is not None
+                 else self._user_state_dict())
+        fut = writer.save_async(path, state, self.state_dict(), meta=meta)
+        self._log_event(event="ckpt_save", step=self._step, path=path)
+        return fut
+
+    def cold_start(self, directory: str, prefix: str = "ckpt_",
+                   ) -> Optional[str]:
+        """Correlated-failure recovery: after a kill-all / preemption,
+        restore this group from the newest **verified committed** durable
+        snapshot under ``directory``
+        (:func:`torchft_tpu.checkpoint_io.recover` — torn/corrupt files
+        are quarantined, never loaded) and return its path, or ``None``
+        for a fresh start.
+
+        Both the user pytree and the manager metadata (step /
+        batches_committed) are restored, so the next :meth:`step` joins
+        the quorum AT the recovered step. Groups that recovered divergent
+        on-disk steps converge through the existing max_step heal path:
+        the group behind sees ``heal=True`` and fetches the newest
+        committed state live — ending bitwise identical (the cold-start
+        acceptance invariant, tests/test_cold_start.py)."""
+        from torchft_tpu import checkpoint_io
+
+        stats: Dict[str, float] = {}
+        path = checkpoint_io.recover(directory, prefix=prefix,
+                                     stats=stats)
+        self._record(**stats)
+        if path is None:
+            self._log_event(
+                event="cold_start", recovered=False,
+                quarantined=stats.get("ckpt_corrupt_quarantined", 0.0))
+            return None
+        user, mgr_state = checkpoint_io.load(
+            path, target=self._user_state_dict())
+        self._user_load_state_dict(user)
+        self.load_state_dict(mgr_state)
+        self._record(ckpt_cold_starts=1)
+        self._log_event(
+            event="cold_start", recovered=True, path=path,
+            step=self._step,
+            quarantined=stats.get("ckpt_corrupt_quarantined", 0.0),
+            fallbacks=stats.get("ckpt_recover_fallbacks", 0.0))
+        logger.info(
+            "%s cold-started from %s at step %d "
+            "(%d corrupt quarantined, %d fallbacks)", self._replica_id,
+            path, self._step,
+            int(stats.get("ckpt_corrupt_quarantined", 0.0)),
+            int(stats.get("ckpt_recover_fallbacks", 0.0)))
+        return path
 
     # ----------------------------------------------------------- state dicts
 

@@ -41,6 +41,11 @@ _MAGIC = b"TFTPTREE"
 DEFAULT_CHUNK_BYTES = 8 * 1024 * 1024
 
 
+class LeafDigestMismatch(ValueError):
+    """A leaf's bytes failed crc32 verification against its manifest
+    digest — corrupt or torn data that must never reach the device."""
+
+
 def _dtype_name(dt: np.dtype) -> str:
     # ml_dtypes extension types (bfloat16, fp8 variants) stringify to void
     # via .str; their .name round-trips through _resolve_dtype.
@@ -130,6 +135,32 @@ class PytreePlan:
                     for _, mv in _iter_leaf_views(self.array_leaves, bb)
                 ]
             return list(self._digests)
+
+
+def manifest_from(plan: PytreePlan,
+                  digests: Optional[List[int]] = None) -> dict:
+    """Digest manifest of one serialized pytree: the header's leaf
+    entries with each array entry annotated with its ``crc32`` content
+    digest, plus the stream geometry (``preamble_len``/``total_len``) a
+    range-resuming or verifying reader needs. The shared spelling under
+    the heal transport's ``/manifest`` endpoint and the durable
+    checkpoint trailer (:mod:`torchft_tpu.checkpoint_io`). ``digests``
+    reuses crcs already computed (e.g. fused into a write pass);
+    otherwise :meth:`PytreePlan.digests` fetches and digests the
+    leaves."""
+    digs = iter(digests if digests is not None else plan.digests())
+    leaves = []
+    for e in plan.header["leaves"]:
+        e = dict(e)
+        if e["kind"] == "array":
+            e["crc32"] = next(digs)
+        leaves.append(e)
+    return {
+        "digest": "crc32",
+        "preamble_len": len(plan.preamble),
+        "total_len": int(plan.total_len),
+        "leaves": leaves,
+    }
 
 
 def plan_pytree(tree: Any) -> PytreePlan:
@@ -335,6 +366,7 @@ def load_pytree_from(
     fp: BinaryIO,
     target: Any,
     device_put_fn: Optional[Callable[[np.ndarray, Any], Any]] = None,
+    digests: Optional[List[int]] = None,
 ) -> Any:
     """Restore a pytree from a binary stream into the structure of
     ``target``, incrementally: each array leaf is read into a preallocated
@@ -347,6 +379,12 @@ def load_pytree_from(
     Keys are matched positionally against the flattened target and
     cross-checked by name, so a structural mismatch fails loudly instead of
     silently permuting weights.
+
+    ``digests``, when given, is the per-array-leaf crc32 list (body
+    order, e.g. from a :func:`manifest_from` manifest): every leaf is
+    digest-verified after the read and BEFORE ``device_put_fn`` — the
+    same corrupt-bytes-never-reach-the-device discipline as the heal
+    path — raising :class:`LeafDigestMismatch` on the first mismatch.
     """
     try:
         magic = _read_exact(fp, len(_MAGIC))
@@ -363,6 +401,7 @@ def load_pytree_from(
     header = json.loads(_read_exact(fp, hdr_len))
 
     pairs, treedef = _match_entries(header, target)
+    digs = iter(digests) if digests is not None else None
     out_leaves = []
     for entry, tleaf in pairs:
         if entry["kind"] == "py":
@@ -371,7 +410,20 @@ def load_pytree_from(
         # Shape/dtype already validated against the target by
         # _match_entries, so this allocation is exactly target-leaf-sized.
         arr = np.empty(entry["shape"], dtype=_resolve_dtype(entry["dtype"]))
-        _read_exact_into(fp, arr.reshape(-1).view(np.uint8).data)
+        mv = arr.reshape(-1).view(np.uint8).data
+        _read_exact_into(fp, mv)
+        if digs is not None:
+            try:
+                want = int(next(digs))
+            except StopIteration:
+                raise LeafDigestMismatch(
+                    f"digest list exhausted at leaf {entry['key']!r} — "
+                    "manifest does not cover this stream") from None
+            got = zlib.crc32(mv)
+            if got != want:
+                raise LeafDigestMismatch(
+                    f"leaf {entry['key']!r} failed digest verification "
+                    f"(crc32 {got:08x} != manifest {want:08x})")
         if device_put_fn is not None:
             # device_put immediately: jax owns the transfer, the host buffer
             # is released as soon as the copy lands, and the next leaf's
