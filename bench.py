@@ -237,7 +237,8 @@ def bench_multigroup(n_groups: int = 2, steps: int = 20,
                      hidden: int = 512, depth: int = 2,
                      backend: str = "host",
                      bucket_bytes: int = 4 << 20,
-                     wire_dtype: Optional[Any] = None) -> Dict[str, float]:
+                     wire_dtype: Optional[Any] = None,
+                     overlap_steps: int = 0) -> Dict[str, float]:
     """N replica groups as threads, real cross-group gradient traffic.
 
     backend="host": device_get -> HostCommunicator ring allreduce over
@@ -252,7 +253,14 @@ def bench_multigroup(n_groups: int = 2, steps: int = 20,
     that main()'s 2MB buckets actually multi-bucket). The result carries
     the pipelined allreduce's per-stage busy times (fetch/ring/put, from
     Manager.metrics()) so a throughput swing is attributable to a stage —
-    and, with bench_rig_probes' bandwidth lines, to the rig vs the code."""
+    and, with bench_rig_probes' bandwidth lines, to the rig vs the code.
+
+    ``overlap_steps=1`` runs the cross-step overlap engine
+    (docs/design/overlap.md): step N's exchange drains under step N+1's
+    compute; the result then also carries ``hidden_ms_avg`` /
+    ``drain_wait_ms_avg`` (comm wall hidden behind compute vs still
+    blocked on at the settle), the attribution the sync-vs-overlap A/B
+    needs."""
     from torchft_tpu import (HostCommunicator, Lighthouse, Manager,
                              MeshCommunicator, MeshWorld)
     from torchft_tpu.models import MLP
@@ -291,6 +299,7 @@ def bench_multigroup(n_groups: int = 2, steps: int = 20,
                 quorum_timeout_ms=30_000,
                 allreduce_bucket_bytes=bucket_bytes,
                 allreduce_wire_dtype=wire_dtype,
+                overlap_steps=overlap_steps,
             ),
         )
         b = {"x": x, "y": y}
@@ -302,6 +311,10 @@ def bench_multigroup(n_groups: int = 2, steps: int = 20,
             _, committed = trainer.train_step(b)
             if committed:
                 done += 1
+        # Overlap mode: settle the final in-flight step inside the timed
+        # region — sync mode pays its last drain in-loop, so the A/B
+        # must charge overlap its trailing settle too.
+        trainer.flush()
         _materialize(trainer.params)
         dt = time.perf_counter() - t0
         mx = trainer.manager.metrics()
@@ -329,6 +342,11 @@ def bench_multigroup(n_groups: int = 2, steps: int = 20,
             # end-to-end.
             "ring_wire_mbytes_per_step":
                 avg_ms("allreduce_ring_wire_bytes_total") / 1e6,
+            # Overlap attribution (0 in sync mode): comm wall hidden
+            # behind the next step's compute vs still blocked on at the
+            # settle boundary.
+            "hidden_ms_avg": avg_ms("allreduce_hidden_ms_total"),
+            "drain_wait_ms_avg": avg_ms("allreduce_drain_wait_ms_total"),
         }
         trainer.shutdown()
 
@@ -345,6 +363,7 @@ def bench_multigroup(n_groups: int = 2, steps: int = 20,
     return {
         "n_groups": n_groups,
         "backend": backend,
+        "overlap_steps": overlap_steps,
         "steps_per_s": med["steps_per_s"],
         "allreduce_ms_avg": med["allreduce_ms_avg"],
         "grad_mbytes": n_params * 4 / 1e6,
@@ -357,6 +376,8 @@ def bench_multigroup(n_groups: int = 2, steps: int = 20,
         },
         "wire_mbytes_per_step": med["wire_mbytes_per_step"],
         "ring_wire_mbytes_per_step": med["ring_wire_mbytes_per_step"],
+        "hidden_ms_avg": med["hidden_ms_avg"],
+        "drain_wait_ms_avg": med["drain_wait_ms_avg"],
     }
 
 
@@ -843,6 +864,32 @@ def main() -> None:
            "ring_wire_mbytes_per_step":
                round(mwb["ring_wire_mbytes_per_step"], 2),
            "stages_ms": stages(mwb)})
+
+    # Sync vs cross-step-overlap A/B on the same comm-bound 8MB scenario
+    # (docs/design/overlap.md): overlap drains step N's exchange under
+    # step N+1's compute, so steps/s should approach max(compute, comm)
+    # instead of their sum. hidden_comm_ms is the per-step comm wall the
+    # engine actually hid; stage busy FRACTIONS (stage busy ms per step
+    # wall ms) make a throughput swing attributable — if overlap won,
+    # the ring/fetch fraction rises (same comm, less wall) while
+    # steps/s climbs.
+    mov = bench_multigroup(bucket_bytes=2 << 20, overlap_steps=1, **big)
+
+    def busy_frac(r: Dict[str, Any]) -> Dict[str, float]:
+        wall_ms = 1e3 / max(r["steps_per_s"], 1e-9)
+        return {k: round(v / wall_ms, 3)
+                for k, v in r["stages_ms"].items()}
+
+    _emit({"metric": "multigroup_8mb_overlap_ab",
+           "grad_mbytes": round(mov["grad_mbytes"], 2),
+           "sync_steps_per_s": round(mb["steps_per_s"], 3),
+           "overlap_steps_per_s": round(mov["steps_per_s"], 3),
+           "overlap_speedup": round(
+               mov["steps_per_s"] / max(mb["steps_per_s"], 1e-9), 2),
+           "hidden_comm_ms_avg": round(mov["hidden_ms_avg"], 1),
+           "drain_wait_ms_avg": round(mov["drain_wait_ms_avg"], 1),
+           "sync_stage_busy_frac": busy_frac(mb),
+           "overlap_stage_busy_frac": busy_frac(mov)})
 
     mm = bench_multigroup(backend="mesh")
     _emit({"metric": "multigroup_mesh_steps_per_s",

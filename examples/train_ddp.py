@@ -58,6 +58,13 @@ def main() -> None:
     num_groups = int(os.environ.get("NUM_REPLICA_GROUPS", 2))
     total_steps = int(os.environ.get("TOTAL_STEPS", 200))
     batch_size = int(os.environ.get("BATCH_SIZE", 64))
+    # OVERLAP_STEPS=1 opts into the cross-step overlap engine: step N's
+    # cross-group allreduce drains under step N+1's forward/backward and
+    # commits at the N+1 boundary — one-step-stale gradients for comm
+    # hidden behind compute (docs/design/overlap.md; enable when the
+    # exchange, not the compute, bounds step time). Must match across
+    # groups.
+    overlap = int(os.environ.get("OVERLAP_STEPS", 0))
 
     # Self-contained single-group mode: with no TORCHFT_LIGHTHOUSE and
     # only one group, embed the quorum server instead of requiring the
@@ -104,6 +111,7 @@ def main() -> None:
             state_dict=save,
             min_replica_size=1,
             replica_id=f"train_ddp_{replica_group}",
+            overlap_steps=overlap,
         ),
     )
     m = trainer.manager

@@ -74,6 +74,12 @@ def main() -> None:
     total_steps = int(os.environ.get("TOTAL_STEPS", 50))
     batch_size = int(os.environ.get("BATCH_SIZE", 8))
     seq_len = int(os.environ.get("SEQ_LEN", 128))
+    # OVERLAP_STEPS=1: cross-step overlap engine — step N's cross-group
+    # allreduce drains under step N+1's forward/backward, commit deferred
+    # to the N+1 boundary (one-step-stale grads; see
+    # docs/design/overlap.md for when the trade wins). Must be set
+    # identically on every group.
+    overlap = int(os.environ.get("OVERLAP_STEPS", 0))
 
     cfg = make_config()
     model = Transformer(cfg)
@@ -158,6 +164,7 @@ def main() -> None:
             state_dict=save,
             min_replica_size=1,
             replica_id=f"train_lm_{replica_group}",
+            overlap_steps=overlap,
         ),
     )
     m = trainer.manager
@@ -219,6 +226,12 @@ def main() -> None:
         loss, committed = trainer.train_step(batch)
         step = m.current_step()
         if ckpt_writer is not None and committed and step % ckpt_every == 0:
+            # Overlap mode keeps one allreduce in flight across the step
+            # boundary; save_durable refuses such mid-flight snapshots
+            # (manager metadata and params would describe different
+            # steps). Settle it first — costs this one step's overlap,
+            # only at checkpoint cadence.
+            trainer.flush()
             user = {"trainer": trainer.state_dict()}
             if not elastic:
                 user["loader"] = batches.state_dict()

@@ -181,6 +181,12 @@ def main() -> None:
             rank=process_id,
             world_size=num_processes,
             store_addr=store_addr,
+            # OVERLAP_STEPS=1: hide the cross-group exchange behind the
+            # next step's compute (one-step-stale grads; enable when
+            # metrics.json shows the step comm-bound — see
+            # docs/design/overlap.md and the pod_runbook tuning entry).
+            # Must match on every process of every group.
+            overlap_steps=int(os.environ.get("OVERLAP_STEPS", 0)),
         ),
     )
     m = trainer.manager

@@ -47,6 +47,23 @@ if [[ "${1:-}" == "bench-smoke" ]]; then
     exit 0
 fi
 
+# Overlap tier: the cross-step overlap engine's focused gate — the
+# deferred-commit state machine, bitwise equivalence with the one-step-
+# shifted schedule (single group, two-group socketpair ring, and
+# through a mid-run heal), stale-grad drop on replica death, the
+# deterministic sync-vs-overlap >=1.5x A/B, and the bf16 pack/fetch
+# regression guards (see docs/design/overlap.md). These tests are
+# tier-1 too (not marked slow); this tier reruns just them on
+# overlap/optim/manager changes. The overlap CHAOS soak
+# (tests/test_chaos.py, overlap_steps=1 rounds) is marked
+# nightly+slow and rides the nightly tier.
+if [[ "${1:-}" == "overlap" ]]; then
+    stage overlap env JAX_PLATFORMS=cpu \
+        python -m pytest tests/test_overlap.py -q -m overlap
+    echo "== total: ${SECONDS}s"
+    exit 0
+fi
+
 # Cold-start tier: seeded kill-all → cold-restart soak — every round a
 # 2-group job checkpoints under disk chaos (torn writes, silent
 # bit-flips, ENOSPC), the whole fleet "dies", and recovery must come

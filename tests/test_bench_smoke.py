@@ -65,6 +65,44 @@ class TestAllreduceABSmoke:
         assert wire["ring_wire_mbytes_per_step"] == pytest.approx(
             exact["ring_wire_mbytes_per_step"] / 2, rel=0.02)
 
+    def test_bf16_wire_fetch_within_1p2x_of_exact_at_8mb(self):
+        """The BENCH_r05 regression gate: at the ~8.6MB payload, bf16
+        wire mode's fetch stage must stay within 1.2x of the exact
+        path's — it moves HALF the bytes, so anything past that bound
+        means the fetch fell off the packed fast path again (per-step
+        retrace, or a non-canonical-dtype transfer slow path; the pack
+        now ships canonical uint bits precisely to keep off the
+        latter). Cache-miss counters must also freeze after warmup."""
+        import jax.numpy as jnp
+
+        big = dict(hidden=1024, depth=3, steps=3,
+                   bucket_bytes=2 << 20)
+        exact = self._mg(**big)
+        wire = self._mg(wire_dtype=jnp.bfloat16, **big)
+        # Byte sanity: the halving actually happened on both legs.
+        assert wire["wire_mbytes_per_step"] == pytest.approx(
+            exact["wire_mbytes_per_step"] / 2, rel=0.02)
+        # The acceptance bound, with a small absolute cushion for
+        # timer noise on near-zero stage times.
+        assert wire["stages_ms"]["fetch"] <= \
+            exact["stages_ms"]["fetch"] * 1.2 + 2.0, (exact, wire)
+
+    def test_overlap_ab_smoke(self):
+        """Sync vs cross-step-overlap A/B plumbing at tiny size: the
+        overlap run completes, reports its hidden/drain attribution,
+        and drops nothing on the happy path. (The >=1.5x performance
+        assertion lives in tests/test_overlap.py with a deterministic
+        slowed ring; at smoke sizes the exchange is too fast for a
+        meaningful ratio.)"""
+        sync = self._mg(steps=3)
+        ov = self._mg(steps=3, overlap_steps=1)
+        assert sync["overlap_steps"] == 0
+        assert ov["overlap_steps"] == 1
+        assert ov["steps_per_s"] > 0
+        assert ov["hidden_ms_avg"] >= 0.0
+        assert ov["drain_wait_ms_avg"] >= 0.0
+        assert sync["hidden_ms_avg"] == 0.0  # sync mode never defers
+
     def test_chaos_short_read_on_wire_ring(self):
         """A seeded short-read fault injected into the ring's data plane
         lands mid-collective in the wire path's segment upcast loop; the

@@ -541,6 +541,18 @@ class TestChaosSoak:
         })
 
     def test_soak_two_groups_no_lost_or_duplicated_commits(self):
+        self._soak(overlap_steps=0)
+
+    def test_soak_two_groups_overlap_mode(self):
+        """The same seeded soak with the cross-step overlap engine
+        (``overlap_steps=1``, docs/design/overlap.md): every fault now
+        has a one-step-deferred commit in flight to corrupt, so the
+        oracles additionally prove the deferred vote drops stale grads
+        on every failure path — both groups still finish bitwise
+        identical with zero lost or duplicated commits."""
+        self._soak(overlap_steps=1)
+
+    def _soak(self, overlap_steps: int):
         import jax
         import jax.numpy as jnp
         import optax
@@ -589,19 +601,42 @@ class TestChaosSoak:
                     lighthouse_addr=lh.address(), rank=0, world_size=1,
                     timeout_ms=15_000, quorum_timeout_ms=15_000,
                     max_consecutive_failures=100,
+                    overlap_steps=overlap_steps,
                 ),
             )
             commits = []
             b = {"x": x[:16], "y": y[:16]}
             try:
+                first = True
                 while trainer.manager.current_step() < total_steps:
                     progress[group] = trainer.manager.current_step()
+                    # Overlap mode settles the PREVIOUS step inside this
+                    # call, so the (step, quorum, participants) triple a
+                    # commit belongs to is the one in effect BEFORE
+                    # step() advances (reading any of them after
+                    # train_step would describe the NEXT step's quorum).
+                    prev = (trainer.manager.current_step(),
+                            trainer.manager.quorum_id(),
+                            trainer.manager.num_participants())
                     _, committed = trainer.train_step(b)
-                    if committed:
+                    if overlap_steps:
+                        if committed and not first:
+                            commits.append(prev)
+                        first = False
+                    elif committed:
                         commits.append(
                             (trainer.manager.current_step(),
                              trainer.manager.quorum_id(),
                              trainer.manager.num_participants()))
+                # Overlap mode: settle the final in-flight step BEFORE
+                # snapshotting params, or the oracle would compare
+                # boundary states one update apart.
+                final = trainer.flush()
+                if overlap_steps and final:
+                    commits.append(
+                        (trainer.manager.current_step(),
+                         trainer.manager.quorum_id(),
+                         trainer.manager.num_participants()))
                 return {
                     "params": jax.device_get(trainer.params),
                     "step": trainer.manager.current_step(),

@@ -38,7 +38,8 @@ from torchft_tpu.data import (BatchIterator, DistributedSampler,
 from torchft_tpu.local_sgd import (DiLoCoTrainer, StreamingDiLoCoTrainer,
                                    diloco_outer_optimizer)
 from torchft_tpu.manager import Manager, WorldSizeMode
-from torchft_tpu.optim import FTOptimizer, OptimizerWrapper
+from torchft_tpu.optim import (DelayedOptimizer, FTOptimizer,
+                               OptimizerWrapper)
 
 __all__ = [
     "AsyncCheckpointer",
@@ -54,6 +55,7 @@ __all__ = [
     "is_transient",
     "Communicator",
     "CommunicatorError",
+    "DelayedOptimizer",
     "DiLoCoTrainer",
     "StreamingDiLoCoTrainer",
     "DistributedSampler",

@@ -151,6 +151,25 @@ class Manager:
         heal_max_donor_failovers: how many times one heal may fail over
             to a freshly-resolved donor (via re-quorum) after the
             current donor is classified dead.
+        overlap_steps: opt-in cross-step overlap (docs/design/overlap.md).
+            ``0`` (default) is the classic sync protocol: the trainer
+            drains the allreduce and votes within the same step. ``1``
+            enables the delayed-gradient-application mode: step N's
+            cross-group allreduce stays IN FLIGHT across the step
+            boundary (tracked via :meth:`stage_deferred`), draining
+            concurrently with step N+1's forward/backward, and step N's
+            reduced grads are applied — and its ``should_commit`` vote
+            cast — at the N+1 boundary
+            (:class:`~torchft_tpu.optim.DelayedOptimizer` /
+            :class:`~torchft_tpu.parallel.step.FTTrainer` implement the
+            loop). Gradients are then one step stale; every failure path
+            (vote abort, latched comm error, heal) DROPS the stale
+            in-flight grads instead of applying them. The flag itself is
+            the opt-in contract read by the trainer/bench wiring — the
+            Manager enforces the state machine (``step()`` refuses to
+            advance over an unsettled deferred step, ``save_durable``
+            refuses mid-flight snapshots) whenever a deferred step is
+            staged.
     """
 
     def __init__(
@@ -174,6 +193,7 @@ class Manager:
         max_consecutive_failures: int = 20,
         allreduce_bucket_bytes: int = 4 << 20,
         allreduce_wire_dtype: Optional[Any] = None,
+        overlap_steps: int = 0,
         auth_token: Optional[str] = None,
         checkpoint_bind_host: Optional[str] = None,
         retry_policy: Optional[RetryPolicy] = None,
@@ -187,6 +207,16 @@ class Manager:
             np.dtype(allreduce_wire_dtype)
             if allreduce_wire_dtype is not None else None
         )
+        if overlap_steps not in (0, 1):
+            raise ValueError(
+                "overlap_steps must be 0 (sync commit) or 1 (one-step "
+                f"deferred commit), got {overlap_steps!r}")
+        self._overlap_steps = int(overlap_steps)
+        # Cross-step overlap engine state: the ONE in-flight deferred
+        # allreduce (future + dispatch/done timestamps) whose grads apply
+        # at the next step boundary. None outside overlap mode or when
+        # the previous step has been settled.
+        self._deferred: Optional[tuple] = None
         self._user_load_state_dict = load_state_dict
         self._user_state_dict = state_dict
         self._min_replica_size = min_replica_size
@@ -250,6 +280,19 @@ class Manager:
             "allreduce_fetch_wait_ms_total": 0.0,
             "allreduce_ring_ms_total": 0.0,
             "allreduce_put_ms_total": 0.0, "allreduce_wire_bytes_total": 0.0,
+            # Cross-step overlap engine (docs/design/overlap.md):
+            # hidden = comm wall that ran concurrently with the caller's
+            # compute between dispatch and drain (the ms the engine
+            # exists to hide); drain_wait = what the caller still
+            # blocked on at the settle boundary; inflight = live
+            # allreduce futures right now (gauge); deferred/dropped
+            # count staged steps and stale-grad drops (vote aborts,
+            # latched comm errors, heals).
+            "allreduce_hidden_ms_total": 0.0,
+            "allreduce_drain_wait_ms_total": 0.0,
+            "allreduce_inflight": 0,
+            "overlap_steps_deferred": 0,
+            "overlap_grads_dropped": 0,
             "commit_count": 0, "commit_ms_total": 0.0,
             "committed_steps": 0, "aborted_steps": 0,
             # Durable-checkpoint observability (cold-start resilience,
@@ -409,7 +452,20 @@ class Manager:
         Bumps the step counter when the previous step committed, re-opens the
         heal window, and kicks the quorum round off the critical path so it
         overlaps the forward pass.
+
+        In overlap mode the previous step's deferred allreduce MUST be
+        settled first (:class:`~torchft_tpu.optim.DelayedOptimizer`
+        ``settle``/``flush``): advancing over an unsettled step would
+        skip its commit vote entirely — its grads would neither apply
+        nor count as aborted, silently losing a step the protocol
+        thinks succeeded.
         """
+        if self._deferred is not None:
+            raise RuntimeError(
+                f"{self._replica_id}: step {self._step} has a deferred "
+                "allreduce still in flight; settle it "
+                "(DelayedOptimizer.settle()/flush()) before starting the "
+                "next step")
         with self._metrics_lock:  # written on the quorum thread
             streak = self._quorum_failure_streak
         if streak >= self._max_consecutive_failures:
@@ -842,7 +898,13 @@ class Manager:
                               overlaps the entire ring instead of the old
                               one-bucket lookahead; a per-bucket batched
                               ``device_get`` is the fallback when the
-                              runtime lacks the async-copy API;
+                              runtime lacks the async-copy API. Non-native
+                              wire dtypes (bf16) cross D2H bitcast to a
+                              canonical uint carrier fused into the same
+                              pack (:func:`_transfer_dtype` — custom-dtype
+                              buffers can fall off the runtime's raw-bytes
+                              transfer fast path) and are viewed back on
+                              host;
                            2. fetch-wait — per bucket, in order: block
                               until its wire buffers are on host, hand
                               them to the comm worker;
@@ -1096,6 +1158,11 @@ class Manager:
             if packed is not None:
                 fetched = np.asarray(next(got))
                 d2h += fetched.nbytes
+                if fetched.dtype != c.wire:
+                    # Non-native wire dtype crossed D2H as its canonical
+                    # uint carrier (_transfer_dtype); view the bits back
+                    # — zero-copy, bitwise identical.
+                    fetched = fetched.view(c.wire)
                 if len(dev) == len(c.idx):
                     # device_get returns a fresh host buffer this rank
                     # owns — handed to the ring as-is (it reduces in
@@ -1156,10 +1223,13 @@ class Manager:
     def wrap_future(self, fut: Future, default: Any) -> Future:
         """Error-swallow ``fut`` into ``default`` + latch via
         :meth:`report_error`; track it for the commit drain (reference
-        ``manager.py:271-299``)."""
+        ``manager.py:271-299``). Maintains the ``allreduce_inflight``
+        gauge: +1 while the wrapped work is outstanding."""
         out: Future = Future()
+        self._record(allreduce_inflight=1)
 
         def relay(f: Future) -> None:
+            self._record(allreduce_inflight=-1)
             e = f.exception()
             if e is None:
                 out.set_result(f.result())
@@ -1170,6 +1240,82 @@ class Manager:
         fut.add_done_callback(relay)
         self._pending_work.append(out)
         return out
+
+    # ------------------------------------------------- deferred commit
+    # Cross-step overlap engine (docs/design/overlap.md): with
+    # Manager(overlap_steps=1) the trainer stages step N's (already
+    # error-swallowed) averaged-grad future here instead of draining it,
+    # lets it run concurrently with step N+1's forward/backward, and
+    # settles — drain, should_commit vote, apply-or-drop — at the N+1
+    # boundary via DelayedOptimizer. The Manager tracks exactly one
+    # in-flight deferred step; step() refuses to advance over it and
+    # save_durable refuses to snapshot around it.
+
+    def stage_deferred(self, fut: Future) -> None:
+        """Track the current step's in-flight allreduce across the step
+        boundary. ``fut`` must be a future this Manager returned from
+        :meth:`allreduce` (error-swallowed; failures latch and surface in
+        the deferred vote, never raise here)."""
+        if self._deferred is not None:
+            # Same depth as step()'s guard (not an assert): silently
+            # overwriting the in-flight future would lose its step —
+            # never drained, never voted, never counted as dropped.
+            raise RuntimeError(
+                f"{self._replica_id}: previous deferred step "
+                f"{self._deferred[2]} not settled; drain it before "
+                "staging another")
+        box = {"dispatch": time.perf_counter(), "done": None}
+
+        def stamp(_f: Future, box=box) -> None:
+            box["done"] = time.perf_counter()
+
+        fut.add_done_callback(stamp)
+        self._deferred = (fut, box, self._step)
+        self._record(overlap_steps_deferred=1)
+
+    def deferred_pending(self) -> bool:
+        """True while a staged deferred allreduce awaits its settle."""
+        return self._deferred is not None
+
+    def deferred_step(self) -> Optional[int]:
+        """Step number of the staged deferred allreduce (None if none)."""
+        return self._deferred[2] if self._deferred is not None else None
+
+    def drain_deferred(self) -> Any:
+        """Block until the staged deferred allreduce resolves and return
+        the averaged grads; splits its comm wall into
+        ``allreduce_hidden_ms_total`` (ran concurrently with the
+        caller's compute since dispatch — the overlap win) vs
+        ``allreduce_drain_wait_ms_total`` (still blocked on here). The
+        caller then votes via :meth:`should_commit` and applies or drops
+        (:class:`~torchft_tpu.optim.DelayedOptimizer` wraps all three)."""
+        if self._deferred is None:
+            raise RuntimeError(
+                f"{self._replica_id}: no deferred step staged")
+        fut, box, _step = self._deferred
+        t_drain = time.perf_counter()
+        try:
+            res = fut.result()
+        finally:
+            self._deferred = None
+        t_done = box["done"]
+        if t_done is None:  # result() raced the done-callback
+            t_done = time.perf_counter()
+        hidden = max(0.0, min(t_done, t_drain) - box["dispatch"])
+        wait = max(0.0, t_done - t_drain)
+        self._record(allreduce_hidden_ms_total=hidden * 1e3,
+                     allreduce_drain_wait_ms_total=wait * 1e3)
+        return res
+
+    def note_deferred_dropped(self) -> None:
+        """Record that a settled deferred step's stale grads were DROPPED
+        (vote abort / latched error / heal restore): the
+        ``overlap_grads_dropped`` counter plus an event-log entry, so an
+        overlap job's lost steps are attributable from /metrics.json."""
+        self._record(overlap_grads_dropped=1)
+        self._log_event(event="overlap_drop", step=self._step,
+                        error=repr(self._errored) if self._errored
+                        else None)
 
     # ---------------------------------------------------------------- commit
 
@@ -1313,6 +1459,14 @@ class Manager:
         ring_bytes = getattr(self._comm, "ring_bytes_total", None)
         out["allreduce_ring_wire_bytes_total"] = (
             float(ring_bytes()) if ring_bytes is not None else 0.0)
+        # Fetch-path health (process-wide — the jit caches are too):
+        # pack-executable cache misses must stop growing after the first
+        # step of each grad signature, and async-D2H fallbacks explain a
+        # fetch-wait-bound profile (see _PACK_STATS).
+        out["allreduce_pack_cache_misses"] = float(
+            _PACK_STATS["pack_cache_misses"])
+        out["allreduce_d2h_async_fallbacks"] = float(
+            _PACK_STATS["d2h_async_fallbacks"])
         # Durable-writer counters (saves, fatal ENOSPC/EROFS class,
         # stalls, bytes) + its sticky last error, so /metrics.json shows
         # a dying checkpoint disk long before the next cold start needs
@@ -1352,16 +1506,26 @@ class Manager:
         with self._metrics_lock:
             healing = self._healing
         committed = self._should_step
-        if healing or self._errored is not None or not committed:
+        deferred = self.deferred_pending()
+        if healing or self._errored is not None or not committed or deferred:
+            # A deferred allreduce in flight means the manager metadata
+            # (step already advanced) and the params (update not yet
+            # applied) describe DIFFERENT steps: a snapshot now would
+            # cold-start at step N+1 with step-N weights. Callers flush
+            # the deferred step first (DelayedOptimizer.flush /
+            # FTTrainer.flush), then save.
             logger.warning(
                 "%s: skipping durable snapshot at step %d "
-                "(healing=%s errored=%s committed=%s) — state is not a "
-                "committed step's", self._replica_id, self._step, healing,
-                self._errored is not None, committed)
+                "(healing=%s errored=%s committed=%s deferred=%s) — state "
+                "is not a settled committed step's%s", self._replica_id,
+                self._step, healing, self._errored is not None, committed,
+                deferred,
+                " (flush() the deferred step first)" if deferred else "")
             self._record(ckpt_save_skipped=1)
             self._log_event(
                 event="ckpt_skip", step=self._step, healing=healing,
-                errored=self._errored is not None, committed=committed)
+                errored=self._errored is not None, committed=committed,
+                deferred=deferred)
             return None
         self._ckpt_writer = writer
         meta = {
@@ -1442,6 +1606,12 @@ class Manager:
 
     # ------------------------------------------------------------- accessors
 
+    def overlap_steps(self) -> int:
+        """Configured cross-step overlap depth: 0 = sync commit, 1 = the
+        one-step deferred-commit engine (docs/design/overlap.md). Read by
+        :class:`~torchft_tpu.parallel.step.FTTrainer` to pick the loop."""
+        return self._overlap_steps
+
     def num_participants(self) -> int:
         """Groups contributing real gradients this step (reference
         ``manager.py:508-518``)."""
@@ -1510,6 +1680,19 @@ class Manager:
         return getattr(self, "_store_addr", "")
 
     def shutdown(self) -> None:
+        if self._deferred is not None:
+            # Dropping here loses at most the one in-flight step — the
+            # same bound as a vote abort — but a clean exit should flush
+            # (FTTrainer.shutdown does) so the final step isn't lost.
+            # Counted: every drop path must show in
+            # overlap_grads_dropped / the event log.
+            self.note_deferred_dropped()
+            logger.warning(
+                "%s: shutdown with a deferred step still in flight; its "
+                "grads are dropped (call DelayedOptimizer.flush() / "
+                "FTTrainer.flush() before shutdown to apply them)",
+                self._replica_id)
+            self._deferred = None
         self._ckpt_server.shutdown()
         self._executor.shutdown(wait=False, cancel_futures=True)
         # No cancel_futures here: a queued finish_bucket must still run (it
@@ -1525,6 +1708,47 @@ class Manager:
 
 _PACK_FNS: Dict[str, Any] = {}
 
+# Process-wide fetch-path health counters, surfaced per-Manager in
+# metrics() (the jit caches they instrument are process-wide too):
+#   pack_cache_misses — TRACES of the cached jitted pack fns. Counted by
+#     a trace-time side effect inside the traced body, so it increments
+#     exactly when jit compiles (first step per grad signature) and
+#     never on a steady-state cache hit. A growing value after step 1 is
+#     the per-step-retrace failure mode BENCH_r05's bf16 fetch collapse
+#     was first suspected to be (ruled out by
+#     tests/test_overlap.py::TestPackFetchPath, which pins it at zero).
+#   d2h_async_fallbacks — buckets whose copy_to_host_async did NOT run
+#     (API absent or transient failure): their D2H serializes into the
+#     fetch-wait stage instead of overlapping the ring.
+_PACK_STATS: Dict[str, int] = {"pack_cache_misses": 0,
+                               "d2h_async_fallbacks": 0}
+# Incremented from concurrent Manager worker threads (and jit tracing);
+# a bare `+= 1` is a non-atomic read-modify-write that can undercount —
+# and these exist as regression tripwires, where an undercount masks
+# exactly what they guard.
+_PACK_STATS_LOCK = threading.Lock()
+
+
+def _pack_stat_bump(key: str) -> None:
+    with _PACK_STATS_LOCK:
+        _PACK_STATS[key] += 1
+
+
+def _transfer_dtype(wire: Any) -> Optional[np.dtype]:
+    """Canonical same-width unsigned-int carrier for a NON-native wire
+    dtype (ml_dtypes bfloat16/float8: ``np.dtype(...).isbuiltin != 1``),
+    or ``None`` for dtypes numpy owns. The D2H fetch moves the carrier's
+    raw bits: PJRT's device->host fast path is only guaranteed for
+    canonical dtypes, and custom-dtype buffers have been observed to
+    fall onto a per-element conversion path 10x+ slower per byte (the
+    BENCH_r05 bf16 fetch regression: 12.9s vs 2.9s for the SAME payload
+    at half the bytes). Bitcasting inside the jitted pack is free on
+    device and bitwise-invertible on host (``.view``)."""
+    d = np.dtype(wire)
+    if d.isbuiltin == 1:
+        return None
+    return np.dtype(f"u{d.itemsize}")
+
 
 def _pack_leaves(leaves: list, wire_dtype_str: str) -> Any:
     """Pack device leaves into ONE contiguous 1-D device array in the
@@ -1532,14 +1756,26 @@ def _pack_leaves(leaves: list, wire_dtype_str: str) -> Any:
     ``device_get`` pays a single transfer round trip for the whole chunk
     instead of one per leaf (the dominant host-allreduce cost on
     latency-bound links), and wire compression is fused into the same
-    dispatch."""
+    dispatch. Non-native wire dtypes (bf16) are bitcast to a canonical
+    uint carrier in the same fused dispatch so the transfer itself never
+    leaves the runtime's raw-bytes fast path (:func:`_transfer_dtype`);
+    :meth:`Manager._wait_bucket` views the bits back, a zero-copy
+    bitwise identity."""
     fn = _PACK_FNS.get(wire_dtype_str)
     if fn is None:
         wire = jnp.dtype(wire_dtype_str)
+        carrier = _transfer_dtype(wire)
 
         def pack(ls):
-            return jnp.concatenate(
+            # Trace-time side effect: runs when jit COMPILES this
+            # signature, never on steady-state dispatch — i.e. it counts
+            # pack-executable cache misses.
+            _pack_stat_bump("pack_cache_misses")
+            buf = jnp.concatenate(
                 [jnp.ravel(x).astype(wire) for x in ls])
+            if carrier is not None:
+                buf = jax.lax.bitcast_convert_type(buf, carrier)
+            return buf
 
         fn = _PACK_FNS[wire_dtype_str] = jax.jit(pack)
     return fn(leaves)
@@ -1575,15 +1811,21 @@ def _start_copy_to_host(arr: Any) -> None:
     off — falling back to the plain batched device_get — only when the
     runtime's Array type lacks ``copy_to_host_async``; a transient
     runtime error skips this one copy (device_get stays correct) without
-    permanently disabling the overlap for the whole process."""
+    permanently disabling the overlap for the whole process. Every
+    skipped copy counts into ``allreduce_d2h_async_fallbacks``: a
+    nonzero steady-state rate means the fetch stage lost its
+    ring-overlap and a fetch-bound profile is explained."""
     global _COPY_TO_HOST_ASYNC
     if not _COPY_TO_HOST_ASYNC:
+        _pack_stat_bump("d2h_async_fallbacks")
         return
     try:
         arr.copy_to_host_async()
     except (AttributeError, NotImplementedError, TypeError):
         _COPY_TO_HOST_ASYNC = False  # API absent on this runtime
+        _pack_stat_bump("d2h_async_fallbacks")
     except Exception:  # noqa: BLE001 — transient; this copy just waits
+        _pack_stat_bump("d2h_async_fallbacks")
         logger.debug("copy_to_host_async failed; falling back to "
                      "device_get for this buffer", exc_info=True)
 

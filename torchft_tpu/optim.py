@@ -30,10 +30,22 @@ idioms are offered:
     Imperative adapter with the reference's exact method names
     (``zero_grad``/``step``/``state_dict``/``load_state_dict``) for porting
     torch-shaped training loops; holds ``(params, opt_state)`` internally.
+
+:class:`DelayedOptimizer`
+    The cross-step overlap engine's commit side (``Manager(
+    overlap_steps=1)``, docs/design/overlap.md): step N's in-flight
+    averaged-grad future is *staged* instead of drained, runs
+    concurrently with step N+1's forward/backward, and is *settled* —
+    drained, voted, applied-or-dropped — at the N+1 boundary. Gradients
+    are one step stale; every failure path (vote abort, latched comm
+    error) drops the stale grads, and a heal restore composes exactly
+    like the sync path (the received average applies to the restored
+    state, landing bitwise on the donor).
 """
 
 from __future__ import annotations
 
+import time
 from typing import Any, Callable, Optional, Tuple
 
 import jax
@@ -106,6 +118,128 @@ class FTOptimizer:
                ) -> Tuple[Any, Any]:
         """The bare (jitted) optimizer update, no vote."""
         return self._update(params, opt_state, grads)
+
+
+class DelayedOptimizer:
+    """Deferred-commit optax wrapper: the commit half of the cross-step
+    overlap engine (``Manager(overlap_steps=1)``,
+    docs/design/overlap.md).
+
+    The canonical overlap loop (what
+    :class:`~torchft_tpu.parallel.step.FTTrainer` runs when its
+    manager has ``overlap_steps() == 1``)::
+
+        opt = DelayedOptimizer(manager, optax.adamw(3e-4))
+        for batch in data:
+            grads = grad_fn(holder.params, batch)   # async dispatch —
+                                                    # overlaps the
+                                                    # in-flight ring
+            committed_prev = opt.settle() if opt.pending() else None
+            opt.begin_step()                        # gated on the vote
+            fut = manager.allreduce(grads)          # in flight across
+                                                    # the boundary
+            opt.stage(holder, fut)
+        opt.flush()                                 # final step applies
+
+    Semantics vs :class:`FTOptimizer` (the sync engine):
+
+    * **One-step staleness.** Step k's gradients are computed at the
+      params *before* step k-1's update applied (the speculative
+      dispatch precedes the settle). Params remain in lockstep across
+      groups — the applied update is always the agreed average — only
+      the point each gradient is evaluated at shifts by one step.
+    * **Deferred vote.** Step N's ``should_commit`` is cast at the N+1
+      boundary, BEFORE ``step()`` advances the counter, so
+      abort-doesn't-advance semantics are preserved unchanged.
+    * **Drop on failure.** A vote abort (latched comm error, quorum
+      change killing the transfer, too-few participants) leaves the
+      holder untouched — the stale in-flight grads are dropped, never
+      applied (``overlap_grads_dropped`` counts them).
+    * **Heals converge bitwise.** When this replica healed during the
+      staged step, ``settle`` restores the donor's state (inside the
+      vote, exactly like sync mode) and then applies the *received*
+      average to it — landing bitwise on the donor's post-step state.
+
+    ``pending()``/``flush()`` exist for clean shutdown and checkpoint
+    coupling: ``Manager.save_durable`` refuses to snapshot while a
+    deferred step is in flight (its metadata and params would describe
+    different steps) — flush first, then save.
+    """
+
+    def __init__(self, manager: Manager, tx: optax.GradientTransformation,
+                 jit: bool = True) -> None:
+        self._ft = FTOptimizer(manager, tx, jit=jit)
+        self.manager = manager
+        self._staged: Optional[Tuple[Any, Optional[Callable[[], None]]]] \
+            = None
+        # Main-thread wall split of the most recent settle (seconds):
+        # "drain" = blocked on the in-flight allreduce, "vote_apply" =
+        # commit vote + optimizer update. Read by FTTrainer's step
+        # timings.
+        self.last_settle_timings: dict = {}
+
+    def init(self, params: Any) -> Any:
+        return self._ft.init(params)
+
+    def begin_step(self) -> None:
+        """Start the next FT step. Raises if a deferred step is still
+        staged (``Manager.step`` enforces settle-before-advance)."""
+        self.manager.step()
+
+    def stage(self, holder: Any, fut: Any,
+              on_commit: Optional[Callable[[], None]] = None) -> None:
+        """Stage the current step's in-flight averaged-grad future for
+        application at the next boundary.
+
+        ``holder`` follows :meth:`FTOptimizer.apply`'s contract
+        (``.params`` / ``.opt_state`` attributes, read *after* the
+        vote). ``on_commit`` runs only when the settled step commits —
+        the hook non-param per-step state (e.g. BN stats adoption)
+        rides on."""
+        if self._staged is not None:
+            # RuntimeError, not assert (must survive python -O):
+            # overwriting the staged step would silently lose it.
+            raise RuntimeError("settle the pending step first")
+        self.manager.stage_deferred(fut)
+        self._staged = (holder, on_commit)
+
+    def pending(self) -> bool:
+        """True while a staged step awaits its settle."""
+        return self._staged is not None
+
+    def settle(self) -> bool:
+        """Drain the staged step's allreduce, cast its commit vote, and
+        apply its update to the holder (or drop the stale grads on
+        abort). Returns ``committed``. Must be called before the next
+        :meth:`begin_step`."""
+        if self._staged is None:
+            raise RuntimeError("no staged step to settle")
+        holder, on_commit = self._staged
+        self._staged = None
+        t0 = time.perf_counter()
+        avg = self.manager.drain_deferred()
+        t1 = time.perf_counter()
+        # The vote drains remaining pending work, applies a staged heal
+        # restore into the holder, then (on True) applies the update to
+        # the — possibly just-restored — holder state. Identical
+        # ordering to the sync path; only the boundary moved.
+        committed = self._ft.apply(holder, avg)
+        self.last_settle_timings = {
+            "drain": t1 - t0,
+            "vote_apply": time.perf_counter() - t1,
+        }
+        if committed:
+            if on_commit is not None:
+                on_commit()
+        else:
+            self.manager.note_deferred_dropped()
+        return committed
+
+    def flush(self) -> Optional[bool]:
+        """Settle the staged step if any (clean shutdown / pre-checkpoint
+        coupling). Returns the vote, or ``None`` when nothing was
+        pending."""
+        return self.settle() if self.pending() else None
 
 
 class OptimizerWrapper:
