@@ -238,7 +238,8 @@ def bench_multigroup(n_groups: int = 2, steps: int = 20,
                      backend: str = "host",
                      bucket_bytes: int = 4 << 20,
                      wire_dtype: Optional[Any] = None,
-                     overlap_steps: int = 0) -> Dict[str, float]:
+                     overlap_steps: int = 0,
+                     shard_update: bool = False) -> Dict[str, float]:
     """N replica groups as threads, real cross-group gradient traffic.
 
     backend="host": device_get -> HostCommunicator ring allreduce over
@@ -260,7 +261,16 @@ def bench_multigroup(n_groups: int = 2, steps: int = 20,
     compute; the result then also carries ``hidden_ms_avg`` /
     ``drain_wait_ms_avg`` (comm wall hidden behind compute vs still
     blocked on at the settle), the attribution the sync-vs-overlap A/B
-    needs."""
+    needs.
+
+    ``shard_update=True`` runs the ZeRO-style sharded weight update
+    (docs/design/sharded_update.md): reduce-scatter instead of
+    allreduce, stripe-local optimizer update, allgather of updated
+    params. The result then carries ``update_ms_avg`` (the stripe
+    update+allgather+reassembly wall from Manager.metrics()) and
+    ``opt_state_mbytes`` shrinks to ~1/n_groups; ``commit_ms_avg``
+    (the trainer's commit bucket, covering the optimizer apply + vote
+    in BOTH modes) is the comparable update-stage wall for the A/B."""
     from torchft_tpu import (HostCommunicator, Lighthouse, Manager,
                              MeshCommunicator, MeshWorld)
     from torchft_tpu.models import MLP
@@ -300,6 +310,7 @@ def bench_multigroup(n_groups: int = 2, steps: int = 20,
                 allreduce_bucket_bytes=bucket_bytes,
                 allreduce_wire_dtype=wire_dtype,
                 overlap_steps=overlap_steps,
+                shard_update=shard_update,
             ),
         )
         b = {"x": x, "y": y}
@@ -307,8 +318,10 @@ def bench_multigroup(n_groups: int = 2, steps: int = 20,
         m0 = trainer.manager.metrics()
         t0 = time.perf_counter()
         done = 0
+        commit_s = 0.0
         while done < steps:
             _, committed = trainer.train_step(b)
+            commit_s += trainer.last_step_timings.get("commit", 0.0)
             if committed:
                 done += 1
         # Overlap mode: settle the final in-flight step inside the timed
@@ -347,6 +360,20 @@ def bench_multigroup(n_groups: int = 2, steps: int = 20,
             # settle boundary.
             "hidden_ms_avg": avg_ms("allreduce_hidden_ms_total"),
             "drain_wait_ms_avg": avg_ms("allreduce_drain_wait_ms_total"),
+            # Update-stage attribution for the rs A/B: the trainer's
+            # commit bucket (optimizer apply + vote, comparable across
+            # modes), the sharded update's own busy wall (0 in sync
+            # mode), and the live optimizer-state footprint — stripe
+            # state in shard mode (~1/n_groups), full tree otherwise.
+            "commit_ms_avg": commit_s / max(steps, 1) * 1e3,
+            "update_ms_avg": (
+                (mx["update_ms_total"] - m0["update_ms_total"])
+                / max(mx["update_count"] - m0["update_count"], 1)),
+            "opt_state_mbytes": (
+                mx["shard_state_bytes"] / 1e6 if shard_update
+                else sum(
+                    np.asarray(l).nbytes for l in
+                    jax.tree_util.tree_leaves(trainer.opt_state)) / 1e6),
         }
         trainer.shutdown()
 
@@ -378,6 +405,9 @@ def bench_multigroup(n_groups: int = 2, steps: int = 20,
         "ring_wire_mbytes_per_step": med["ring_wire_mbytes_per_step"],
         "hidden_ms_avg": med["hidden_ms_avg"],
         "drain_wait_ms_avg": med["drain_wait_ms_avg"],
+        "commit_ms_avg": med["commit_ms_avg"],
+        "update_ms_avg": med["update_ms_avg"],
+        "opt_state_mbytes": med["opt_state_mbytes"],
     }
 
 
@@ -790,6 +820,125 @@ def bench_recovery(kill_at: int = 6, total_steps: int = 16,
     return out
 
 
+# --------------------------------------------------------------- scenario 5
+
+class _RateCapProxy:
+    """TCP proxy that caps each donor->healer stream at ``mb_s`` — the
+    per-donor uplink model the striped-heal A/B needs. On a loopback rig
+    the raw transfer is CPU/crc-bound, so 1-vs-N donors would measure
+    core count, not the protocol; capping every donor's egress the same
+    way makes the A/B answer the question the design asks: with
+    donor-bounded bandwidth, does striping cut heal wall to ~1/N?"""
+
+    def __init__(self, target_addr: str, mb_s: float) -> None:
+        import socket as _socket
+        import urllib.parse as _up
+
+        u = _up.urlparse(target_addr)
+        self._thost, self._tport = u.hostname, u.port
+        self._path = u.path
+        self._per_tick = max(int(mb_s * 1e6 * 0.005), 1)  # 5ms ticks
+        self._srv = _socket.create_server(("127.0.0.1", 0))
+        self._alive = True
+        self._threads: list = []
+        t = threading.Thread(target=self._accept, daemon=True)
+        t.start()
+        self._threads.append(t)
+
+    def address(self) -> str:
+        host, port = self._srv.getsockname()[:2]
+        return f"http://{host}:{port}{self._path}"
+
+    def _accept(self) -> None:
+        import socket as _socket
+
+        while self._alive:
+            try:
+                cli, _ = self._srv.accept()
+            except OSError:
+                return
+            up = _socket.create_connection((self._thost, self._tport))
+            for src, dst, capped in ((cli, up, False), (up, cli, True)):
+                t = threading.Thread(target=self._pump,
+                                     args=(src, dst, capped), daemon=True)
+                t.start()
+                self._threads.append(t)
+
+    def _pump(self, src, dst, capped: bool) -> None:
+        try:
+            while True:
+                data = src.recv(self._per_tick if capped else 65536)
+                if not data:
+                    break
+                dst.sendall(data)
+                if capped:
+                    time.sleep(0.005)
+        except OSError:
+            pass
+        finally:
+            for s in (src, dst):
+                try:
+                    s.shutdown(2)
+                except OSError:
+                    pass
+
+    def shutdown(self) -> None:
+        self._alive = False
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+
+
+def bench_heal_striped(payload_mb: float = 48.0, donors: int = 3,
+                       donor_mb_s: float = 64.0) -> Dict[str, float]:
+    """Torrent-striped heal A/B (docs/design/sharded_update.md): one
+    healer fetches a ``payload_mb`` snapshot from 1 donor vs striped
+    across ``donors`` donors, every donor's egress capped at
+    ``donor_mb_s`` (see :class:`_RateCapProxy` — the donor-uplink-bound
+    regime striping exists for). Pure-python transport (CheckpointServer
+    + HTTP Range), no native library needed. Reports wall/MB/s for both
+    legs plus the striped leg's donor accounting."""
+    from torchft_tpu.checkpointing import CheckpointServer
+
+    rng = np.random.default_rng(11)
+    n_leaves = 12
+    per = max(int(payload_mb * 1e6 / 4 / n_leaves), 1)
+    state = {f"l{i}": rng.normal(size=per).astype(np.float32)
+             for i in range(n_leaves)}
+    servers = [CheckpointServer(lambda: state, bind_host="127.0.0.1")
+               for _ in range(donors)]
+    proxies = []
+    out: Dict[str, float] = {"payload_mbytes": per * 4 * n_leaves / 1e6,
+                             "donors": donors,
+                             "donor_cap_mb_s": donor_mb_s}
+    try:
+        for s in servers:
+            s.allow_checkpoint(1)
+        proxies = [_RateCapProxy(s.address(), donor_mb_s)
+                   for s in servers]
+        addrs = [p.address() for p in proxies]
+        for label, donor_addrs in (("single", None), ("striped", addrs)):
+            stats: Dict[str, float] = {}
+            t0 = time.perf_counter()
+            CheckpointServer.load_from_address(
+                addrs[0], state, device_put=False, stats=stats,
+                donor_addrs=donor_addrs, stripe_seed=0)
+            dt = time.perf_counter() - t0
+            out[f"{label}_wall_s"] = dt
+            out[f"{label}_mb_s"] = stats["bytes"] / 1e6 / max(dt, 1e-9)
+            if label == "striped":
+                out["donors_used"] = stats["donors_used"]
+        out["striped_speedup"] = (out["single_wall_s"]
+                                  / max(out["striped_wall_s"], 1e-9))
+    finally:
+        for p in proxies:
+            p.shutdown()
+        for s in servers:
+            s.shutdown()
+    return out
+
+
 # --------------------------------------------------------------------- main
 
 def main() -> None:
@@ -890,6 +1039,47 @@ def main() -> None:
            "drain_wait_ms_avg": round(mov["drain_wait_ms_avg"], 1),
            "sync_stage_busy_frac": busy_frac(mb),
            "overlap_stage_busy_frac": busy_frac(mov)})
+
+    # Allreduce vs ZeRO-style reduce-scatter+allgather A/B on the same
+    # 8MB scenario (docs/design/sharded_update.md): the rs leg receives
+    # only its stripe of the averaged gradient, updates that stripe, and
+    # allgathers updated params — per-group update wall + optimizer-state
+    # memory should scale ~1/n_groups while steps/s holds or climbs
+    # (less fold compute; comparable ring bytes at world 2).
+    mrs = bench_multigroup(bucket_bytes=2 << 20, shard_update=True, **big)
+    _emit({"metric": "multigroup_8mb_rs_ab",
+           "grad_mbytes": round(mrs["grad_mbytes"], 2),
+           "allreduce_steps_per_s": round(mb["steps_per_s"], 3),
+           "rs_steps_per_s": round(mrs["steps_per_s"], 3),
+           "rs_speedup": round(
+               mrs["steps_per_s"] / max(mb["steps_per_s"], 1e-9), 2),
+           "allreduce_ring_wire_mbytes_per_step":
+               round(mb["ring_wire_mbytes_per_step"], 2),
+           "rs_ring_wire_mbytes_per_step":
+               round(mrs["ring_wire_mbytes_per_step"], 2),
+           # Update stage: commit bucket (optimizer apply + vote) is the
+           # cross-mode comparable; update_ms_avg is the rs leg's own
+           # stripe-update busy wall; opt_state_mbytes ~1/n_groups.
+           "allreduce_commit_ms_avg": round(mb["commit_ms_avg"], 1),
+           "rs_commit_ms_avg": round(mrs["commit_ms_avg"], 1),
+           "rs_update_ms_avg": round(mrs["update_ms_avg"], 1),
+           "allreduce_opt_state_mbytes":
+               round(mb["opt_state_mbytes"], 2),
+           "rs_opt_state_mbytes": round(mrs["opt_state_mbytes"], 2)})
+
+    # Striped-heal A/B: 1 vs 3 donors at a fixed per-donor egress cap
+    # (the donor-uplink-bound regime); wall should drop toward 1/3.
+    hs = bench_heal_striped()
+    _emit({"metric": "heal_striped_ab",
+           "payload_mbytes": round(hs["payload_mbytes"], 1),
+           "donors": hs["donors"],
+           "donor_cap_mb_s": hs["donor_cap_mb_s"],
+           "single_wall_s": round(hs["single_wall_s"], 2),
+           "striped_wall_s": round(hs["striped_wall_s"], 2),
+           "single_mb_s": round(hs["single_mb_s"], 1),
+           "striped_mb_s": round(hs["striped_mb_s"], 1),
+           "striped_speedup": round(hs["striped_speedup"], 2),
+           "donors_used": hs.get("donors_used")})
 
     mm = bench_multigroup(backend="mesh")
     _emit({"metric": "multigroup_mesh_steps_per_s",

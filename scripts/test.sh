@@ -64,6 +64,23 @@ if [[ "${1:-}" == "overlap" ]]; then
     exit 0
 fi
 
+# Shard tier: the cross-replica sharding layer's focused gate
+# (docs/design/sharded_update.md) — reduce-scatter-vs-allreduce bitwise
+# identity at worlds 2/3/5 (exact + bf16 wire), the sharded optimizer's
+# stripe update + allgather E2E equivalence, healer-flow and latched-
+# error drop semantics, the torrent-striped multi-donor heal (donor
+# death mid-stripe, seed-shuffled load spread, shared serve-window
+# plan), and the sharded durable checkpoint format (set condemnation,
+# fallback, pruning). Tier-1 too (not marked slow); this tier reruns
+# just them on communicator/optim/heal/checkpoint changes. The striped
+# round of the heal soak (tests/test_chaos.py) is nightly.
+if [[ "${1:-}" == "shard" ]]; then
+    stage shard env JAX_PLATFORMS=cpu \
+        python -m pytest tests/test_shard.py -q -m shard
+    echo "== total: ${SECONDS}s"
+    exit 0
+fi
+
 # Cold-start tier: seeded kill-all → cold-restart soak — every round a
 # 2-group job checkpoints under disk chaos (torn writes, silent
 # bit-flips, ENOSPC), the whole fleet "dies", and recovery must come

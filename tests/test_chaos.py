@@ -773,3 +773,70 @@ class TestHealSoak:
         # margin: donors die mid-transfer every round, yet the re-sent
         # traffic stays under one payload's worth per round on average.
         assert total_resent < total_payload, (total_resent, total_payload)
+
+    def test_striped_heal_rounds_with_donor_death(self):
+        """Striped rounds (docs/design/sharded_update.md): every round
+        the healer stripes one heal across 3 live donors and chaos kills
+        one NON-manifest donor at a deterministic mid-stripe byte
+        offset. The dead donor's remaining stripe must reassign to the
+        survivors — committed leaves stay committed (bytes_resumed <
+        payload), final state bitwise identical."""
+        import random as _random
+        import urllib.parse
+
+        from torchft_tpu.checkpointing import CheckpointServer
+        from torchft_tpu.serialization import plan_pytree
+
+        total_resent = 0.0
+        total_payload = 0.0
+        for seed in range(self.ROUNDS):
+            rng = np.random.RandomState(100 + seed)
+            state = {f"w{i}": rng.rand(4096).astype(np.float32)
+                     for i in range(9)}
+            donors_srv = [
+                CheckpointServer(lambda s=state: s, bind_host="127.0.0.1")
+                for _ in range(3)
+            ]
+            for srv in donors_srv:
+                srv.allow_checkpoint(1)
+            addrs = [srv.address() for srv in donors_srv]
+            payload = plan_pytree(state).total_len
+            # Replicate the healer's seed-shuffle so the chaos kill lands
+            # on a donor that is NOT serving the manifest (stripe[0]) —
+            # the manifest donor dying is the failover path the legacy
+            # soak above already covers.
+            shuffled = list(dict.fromkeys(addrs))
+            _random.Random(seed).shuffle(shuffled)
+            victim = urllib.parse.urlparse(shuffled[1]).netloc
+            kill_at = int((payload / 3) * (0.2 + 0.5 * rng.rand()))
+            sched = ChaosSchedule(seed=seed, endpoints={
+                f"heal:{victim}": EndpointChaos(
+                    kill_after_bytes=kill_at),
+            })
+            chaos.install(sched)
+            try:
+                stats = {}
+                out = CheckpointServer.load_from_address(
+                    addrs[0], state, device_put=False, stats=stats,
+                    retry_policy=RetryPolicy(max_attempts=8,
+                                             base_delay_ms=1.0,
+                                             jitter=0.0),
+                    stall_timeout_sec=10,
+                    donor_addrs=addrs, stripe_seed=seed)
+                for key, arr in state.items():
+                    assert out[key].tobytes() == arr.tobytes(), (
+                        f"round {seed}: leaf {key} not bitwise identical")
+                assert stats["stripe_donor_deaths"] >= 1, (seed, stats)
+                assert stats["bytes_resumed"] < stats["payload_bytes"], (
+                    seed, stats)
+                total_resent += stats["bytes_resumed"]
+                total_payload += stats["payload_bytes"]
+            finally:
+                chaos.uninstall()
+                for srv in donors_srv:
+                    srv.shutdown()
+        # Only the dead donor's remaining stripe re-fetches: across the
+        # soak the re-sent traffic must stay well under one full payload
+        # per round (restart-from-zero would be >= ROUNDS * payload).
+        assert total_resent < total_payload / 2, (
+            total_resent, total_payload)

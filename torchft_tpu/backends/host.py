@@ -35,7 +35,8 @@ import jax
 
 from torchft_tpu import chaos
 from torchft_tpu._native import StoreClient
-from torchft_tpu.communicator import Communicator, CommunicatorError
+from torchft_tpu.communicator import (Communicator, CommunicatorError,
+                                      shard_bounds)
 from torchft_tpu.retry import RetryPolicy, RetryStats, call_with_retry
 from torchft_tpu.serialization import load_pytree, save_pytree
 from torchft_tpu.utils import advertise_host
@@ -461,6 +462,9 @@ class HostCommunicator(Communicator):
                     fut.set_result(self._do_allreduce(ring, *args))
                 elif kind == "allreduce_wire":
                     fut.set_result(self._do_allreduce_wire(ring, *args))
+                elif kind == "reduce_scatter_wire":
+                    fut.set_result(
+                        self._do_reduce_scatter_wire(ring, *args))
                 elif kind == "broadcast":
                     fut.set_result(self._do_broadcast(ring, *args))
                 elif kind == "allgather":
@@ -488,6 +492,17 @@ class HostCommunicator(Communicator):
                 np.ravel(np.asarray(b)).astype(d, copy=False)
                 for b, d in zip(buffers, origs)])
         return self._submit("allreduce_wire", list(buffers), origs, op)
+
+    def reduce_scatter_wire(self, buffers: Sequence[Any],
+                            orig_dtypes: Sequence[Any],
+                            op: str = "sum") -> Future:
+        origs = [np.dtype(d) for d in orig_dtypes]
+        if self._world == 1:
+            # World-1 stripe is the whole buffer.
+            return self._immediate([
+                np.ravel(np.asarray(b)).astype(d, copy=False)
+                for b, d in zip(buffers, origs)])
+        return self._submit("reduce_scatter_wire", list(buffers), origs, op)
 
     def broadcast(self, tree: Any, root: int = 0) -> Future:
         if self._world == 1:
@@ -555,12 +570,31 @@ class HostCommunicator(Communicator):
         """
         n = self._world
         rank = self._rank
+        acc, chunk_bytes = self._ring_reduce_scatter_phase(ring, flat)
+        for step in range(n - 1):
+            send_view = chunk_bytes(rank + 1 - step)
+            self._ring_bytes += len(send_view)
+            fut = ring.send_async(send_view)
+            _recv_exact_into(ring.prev_sock, chunk_bytes(rank - step))
+            fut.result()
+        return acc
+
+    def _ring_reduce_scatter_phase(self, ring: _Ring, flat: np.ndarray):
+        """The reduce-scatter half of the exact ring, factored out so the
+        reduce-scatter collective can reuse it UNCHANGED — identical fold
+        order is what makes the reduce-scatter path's stripes bitwise
+        equal to the allreduce path's. After the phase, this rank's chunk
+        ``(rank + 1) % world`` of ``acc`` holds its fully-reduced values.
+        Returns ``(acc, chunk_bytes)`` where ``chunk_bytes(i)`` is the
+        byte view of canonical chunk ``i % world``."""
+        n = self._world
+        rank = self._rank
         # Reduces in place: `flat` is either a fresh per-dtype concat or
         # a caller-owned packed chunk (consumed per the allreduce
         # ownership contract), so no defensive copy on the hot path.
         acc = flat if flat.flags.c_contiguous else np.ascontiguousarray(flat)
         acc_bytes = _as_bytes(acc)
-        bounds = np.linspace(0, acc.size, n + 1, dtype=np.int64)
+        bounds = shard_bounds(acc.size, n)
         itemsize = acc.itemsize
 
         def chunk(i: int) -> np.ndarray:
@@ -594,13 +628,33 @@ class HostCommunicator(Communicator):
                     seg, dtype=acc.dtype)
                 off += k
             fut.result()
-        for step in range(n - 1):
-            send_view = chunk_bytes(rank + 1 - step)
-            self._ring_bytes += len(send_view)
-            fut = ring.send_async(send_view)
-            _recv_exact_into(ring.prev_sock, chunk_bytes(rank - step))
-            fut.result()
-        return acc
+        return acc, chunk_bytes
+
+    def _ring_reduce_scatter_buffer(self, ring: _Ring,
+                                    flat: np.ndarray) -> np.ndarray:
+        """Exact reduce-scatter: the ring's reduce-scatter phase plus ONE
+        ownership-shift hop, so rank ``r`` returns canonical stripe ``r``
+        (the :func:`~torchft_tpu.communicator.shard_bounds` segment) —
+        bitwise identical to that stripe of the full allreduce. The
+        shift hop is the price of that identity: ending the phase on the
+        canonical chunk directly would permute each chunk's fold order
+        away from the allreduce's. Ring bytes: 1.0·payload per rank
+        ((n-1)/n phase + 1/n shift) vs the allreduce's 2(n-1)/n — equal
+        at world 2, →half as n grows; the real 1/n win here is fold
+        compute and the optimizer stage that follows."""
+        n, rank = self._world, self._rank
+        acc, chunk_bytes = self._ring_reduce_scatter_phase(ring, flat)
+        # After the phase rank r owns chunk (r+1); one hop moves each
+        # owned chunk to its canonical rank: prev owns exactly chunk
+        # `rank`, so receive it straight into place while streaming our
+        # owned chunk to next.
+        send_view = chunk_bytes(rank + 1)
+        self._ring_bytes += len(send_view)
+        fut = ring.send_async(send_view)
+        _recv_exact_into(ring.prev_sock, chunk_bytes(rank))
+        fut.result()
+        bounds = shard_bounds(acc.size, n)
+        return np.array(acc[bounds[rank]:bounds[rank + 1]])
 
     def _do_allreduce_wire(self, ring: Optional[_Ring],
                            buffers: List[Any], origs: List[np.dtype],
@@ -701,6 +755,102 @@ class HostCommunicator(Communicator):
         acc = np.zeros(size, orig)
         for b in bufs:
             acc += b.astype(orig)
+        return acc
+
+    def _do_reduce_scatter_wire(self, ring: Optional[_Ring],
+                                buffers: List[Any], origs: List[np.dtype],
+                                op: str) -> List[np.ndarray]:
+        if ring is None:
+            raise CommunicatorError("communicator not configured")
+        out: List[np.ndarray] = []
+        for buf, orig in zip(buffers, origs):
+            a = np.ravel(np.asarray(buf))
+            if not a.flags.c_contiguous:
+                a = np.ascontiguousarray(a)
+            if a.dtype == orig:
+                if not a.flags.writeable:
+                    a = np.array(a)  # exact phase reduces in place
+                shard = self._ring_reduce_scatter_buffer(ring, a)
+            else:
+                shard = self._ring_reduce_scatter_wire(ring, a, orig)
+            if op == "mean":
+                if np.issubdtype(shard.dtype, np.inexact):
+                    shard /= self._world
+                else:
+                    shard //= self._world
+            out.append(shard)
+        return out
+
+    def _ring_reduce_scatter_wire(self, ring: _Ring, wire_buf: np.ndarray,
+                                  orig: np.dtype) -> np.ndarray:
+        """Wire-dtype reduce-scatter: same numerics contract as
+        :meth:`_ring_allreduce_wire` (raw contributions, one quantization
+        per contribution, canonical-rank-order f32 fold) restricted to
+        this rank's canonical stripe — so the stripe is BITWISE identical
+        to the same slice of the allreduce_wire result.
+
+        World 2 exchanges only the peer-needed raw segment (half the
+        wire ring bytes of allreduce_wire). World 3+ within the byte
+        crossover ring-allgathers the raw buffers exactly like
+        allreduce_wire (same ring bytes — raw forwarding cannot be
+        segmented without breaking the canonical fold order) but folds
+        only the local stripe, cutting fold compute to ~1/world. Past
+        the crossover the buffer upcasts and takes the exact
+        reduce-scatter (half the exact allreduce's ring bytes)."""
+        n, rank = self._world, self._rank
+        wdt = wire_buf.dtype
+        if n * wdt.itemsize > 2 * orig.itemsize:
+            return self._ring_reduce_scatter_buffer(
+                ring, wire_buf.astype(orig))
+        size = wire_buf.size
+        bounds = shard_bounds(size, n)
+        lo, hi = int(bounds[rank]), int(bounds[rank + 1])
+        if n == 2:
+            # Send the PEER's stripe of my raw contribution; receive my
+            # stripe of theirs and fold it segment by segment into the
+            # upcast of my own stripe (two-term f32 sums are
+            # order-insensitive, so this is bitwise the allreduce_wire
+            # fold restricted to the stripe).
+            peer = 1 - rank
+            plo, phi = int(bounds[peer]), int(bounds[peer + 1])
+            send_view = _as_bytes(
+                np.ascontiguousarray(wire_buf[plo:phi]))
+            self._ring_bytes += len(send_view)
+            fut = ring.send_async(send_view)
+            acc = wire_buf[lo:hi].astype(orig)
+            nbytes = (hi - lo) * wdt.itemsize
+            scratch = bytearray(min(_SEG_BYTES, max(nbytes, 1)))
+            sv = memoryview(scratch)
+            off = 0
+            while off < nbytes:
+                k = min(_SEG_BYTES, nbytes - off)
+                seg = sv[:k]
+                _recv_exact_into(ring.prev_sock, seg)
+                s = off // wdt.itemsize
+                acc[s:s + k // wdt.itemsize] += np.frombuffer(
+                    seg, dtype=wdt).astype(orig)
+                off += k
+            fut.result()
+            return acc
+        # world 3+ within the crossover: ring-allgather the raw wire
+        # buffers (identical transport to _ring_allreduce_wire — each
+        # step forwards the previously received buffer), then fold ONLY
+        # this rank's stripe in canonical rank order.
+        nbytes = size * wdt.itemsize
+        send_view = _as_bytes(np.ascontiguousarray(wire_buf))
+        bufs: List[Optional[np.ndarray]] = [None] * n
+        bufs[rank] = wire_buf
+        for step in range(n - 1):
+            self._ring_bytes += nbytes
+            fut = ring.send_async(send_view)
+            recv = np.empty(size, wdt)
+            _recv_exact_into(ring.prev_sock, _as_bytes(recv))
+            fut.result()
+            bufs[(rank - step - 1) % n] = recv
+            send_view = _as_bytes(recv)
+        acc = np.zeros(hi - lo, orig)
+        for b in bufs:
+            acc += b[lo:hi].astype(orig)
         return acc
 
     def _do_broadcast(self, ring: Optional[_Ring], tree: Any,

@@ -779,6 +779,16 @@ class ChaosCommunicator(Communicator):
             "allreduce_wire",
             lambda: self._comm.allreduce_wire(buffers, orig_dtypes, op))
 
+    def reduce_scatter_wire(self, buffers: Any, orig_dtypes: Any,
+                            op: str = "sum") -> Future:
+        # Own op stream, like allreduce_wire: the sharded-update path's
+        # decision sequence stays reproducible regardless of how many
+        # other collectives ran.
+        return self._inject(
+            "reduce_scatter_wire",
+            lambda: self._comm.reduce_scatter_wire(
+                buffers, orig_dtypes, op))
+
     def broadcast(self, tree: Any, root: int = 0) -> Future:
         return self._inject("broadcast",
                             lambda: self._comm.broadcast(tree, root))

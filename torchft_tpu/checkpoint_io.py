@@ -63,6 +63,7 @@ from torchft_tpu.serialization import (
     LeafDigestMismatch,
     _MAGIC as _TREE_MAGIC,
     _iter_leaf_views,
+    balanced_ranges,
     device_put_like,
     iter_pytree_chunks,  # noqa: F401  (re-exported; legacy test seam)
     load_pytree_from,
@@ -74,7 +75,9 @@ logger: logging.Logger = logging.getLogger(__name__)
 
 _CKPT_MAGIC = b"TFTCKPT2"
 _END_MAGIC = b"TFTCKEND"
+_SET_MAGIC = b"TFTCKST1"
 FORMAT = "tft-durable-2"
+SET_FORMAT = "tft-shardset-1"
 # Upper bound on the json head/manifest we will allocate for — both are
 # ~100B per leaf; 256MiB covers millions of leaves while a corrupt
 # length field cannot trigger a multi-GiB allocation.
@@ -138,6 +141,31 @@ def _fsync_dir(directory: str) -> None:
         os.close(fd)
 
 
+def _atomic_publish(path: str, write_body: Callable[[Any], None]) -> None:
+    """The ONE crash-durable publish sequence — temp file in the target
+    directory, ``write_body(f)``, fsync, ``os.replace``, directory
+    fsync, temp cleanup on failure — shared by the v2 single-file writer
+    and the shard-set head (the head is the sharded save's commit point,
+    so it must never carry weaker durability than the shards)."""
+    d = os.path.dirname(os.path.abspath(path))
+    fd, tmp = tempfile.mkstemp(dir=d, prefix=".ckpt_tmp_")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            write_body(f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)  # atomic on POSIX
+        # The rename itself must survive power loss: fsync the directory
+        # (satellite: rename without dir fsync is not crash-durable).
+        _fsync_dir(d)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
 def _build_head(plan: Any, manager_state: Optional[dict],
                 meta: Optional[dict]) -> dict:
     mgr = manager_state or {}
@@ -177,6 +205,16 @@ def save(path: str, user_state: Any, manager_state: Optional[dict] = None,
         "user": user_state,
         "torchft": manager_state or {"step": 0, "batches_committed": 0},
     }
+    _write_v2(path, tree, manager_state, meta, _progress)
+
+
+def _write_v2(path: str, tree: Any, manager_state: Optional[dict],
+              meta: Optional[dict],
+              _progress: Optional[Callable[[int], None]] = None) -> int:
+    """The atomic single-file v2 write (shared by :func:`save` and the
+    per-shard writes of :func:`save_sharded`): head + TFTPTREE payload +
+    trailing digest manifest, via temp + ``os.replace`` + directory
+    fsync. Returns the file's total byte size."""
     d = os.path.dirname(os.path.abspath(path))
     os.makedirs(d, exist_ok=True)
 
@@ -195,51 +233,235 @@ def save(path: str, user_state: Any, manager_state: Optional[dict] = None,
             f"[chaos] disk:{os.path.basename(path)}: torn write "
             "(crashed before rename was durable)")
 
-    fd, tmp = tempfile.mkstemp(dir=d, prefix=".ckpt_tmp_")
-    try:
-        with os.fdopen(fd, "wb") as f:
-            written = 0
+    written = 0
 
-            def w(buf) -> None:
-                nonlocal written
-                f.write(buf)
-                written += len(buf)
-                if _progress is not None:
-                    _progress(written)
+    def body(f) -> None:
+        nonlocal written
 
-            w(_CKPT_MAGIC)
-            w(len(head_bytes).to_bytes(4, "little"))
-            w(head_bytes)
-            w(plan.preamble)
-            digests = []
-            for _, mv in _iter_leaf_views(plan.array_leaves,
-                                          DEFAULT_BATCH_BYTES):
-                digests.append(zlib.crc32(mv))
-                w(mv)
-            mf = manifest_from(plan, digests)
-            mf["head_crc32"] = zlib.crc32(head_bytes)
-            mf["preamble_crc32"] = zlib.crc32(plan.preamble)
-            mf_bytes = json.dumps(mf).encode()
-            w(mf_bytes)
-            w(len(mf_bytes).to_bytes(4, "little"))
-            w(_END_MAGIC)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, path)  # atomic on POSIX
-        # The rename itself must survive power loss: fsync the directory
-        # (satellite: rename without dir fsync is not crash-durable).
-        _fsync_dir(d)
-    except BaseException:
-        try:
-            os.unlink(tmp)
-        except OSError:
-            pass
-        raise
+        def w(buf) -> None:
+            nonlocal written
+            f.write(buf)
+            written += len(buf)
+            if _progress is not None:
+                _progress(written)
+
+        w(_CKPT_MAGIC)
+        w(len(head_bytes).to_bytes(4, "little"))
+        w(head_bytes)
+        w(plan.preamble)
+        digests = []
+        for _, mv in _iter_leaf_views(plan.array_leaves,
+                                      DEFAULT_BATCH_BYTES):
+            digests.append(zlib.crc32(mv))
+            w(mv)
+        mf = manifest_from(plan, digests)
+        mf["head_crc32"] = zlib.crc32(head_bytes)
+        mf["preamble_crc32"] = zlib.crc32(plan.preamble)
+        mf_bytes = json.dumps(mf).encode()
+        w(mf_bytes)
+        w(len(mf_bytes).to_bytes(4, "little"))
+        w(_END_MAGIC)
+
+    _atomic_publish(path, body)
 
     if fault is not None and fault.fault == "flip":
         # Post-rename silent bit-flip: the save "succeeded", the bytes
         # rotted afterwards. Only digest verification can catch it.
         _flip_byte(path, fault.frac)
+    return written
+
+
+def save_sharded(path: str, user_state: Any,
+                 manager_state: Optional[dict] = None,
+                 meta: Optional[dict] = None, shards: int = 2,
+                 _progress: Optional[Callable[[int], None]] = None) -> None:
+    """Sharded durable save (docs/design/sharded_update.md): the
+    ``{user, torchft}`` pytree's leaves are partitioned into ``shards``
+    contiguous byte-balanced stripes, each written IN PARALLEL as its
+    own self-verifying v2 file ``{path}.shard{k}``, then a small
+    shard-set head lands at ``path`` stamping the stripe geometry, a
+    per-save ``set_id`` binding the shards to this generation, and the
+    usual commit/quorum provenance. The head write is the commit point:
+    shards without a head are invisible orphans (their names never parse
+    as step candidates), so a crash mid-save can never present a partial
+    set as a checkpoint. :func:`recover`/:func:`verify` accept a set
+    only when EVERY shard verifies and carries the head's ``set_id``;
+    :func:`load` reassembles the stripes transparently.
+
+    Splitting takes the monolithic single-file write off the commit
+    critical path twice over: the shard writes overlap each other (and,
+    under :class:`AsyncCheckpointer`, training), and each file is
+    ~1/shards the size, so fsync/rename latency stops scaling with model
+    size. ``shards=1`` degenerates to a one-shard set (still valid)."""
+    import uuid as _uuid
+
+    import jax
+
+    shards = max(int(shards), 1)
+    tree = {
+        "user": user_state,
+        "torchft": manager_state or {"step": 0, "batches_committed": 0},
+    }
+    leaves, _treedef = jax.tree_util.tree_flatten(tree)
+    from torchft_tpu.serialization import _is_array_leaf, _leaf_nbytes
+
+    sizes = [(_leaf_nbytes(leaf) if _is_array_leaf(leaf) else 0)
+             for leaf in leaves]
+    ranges = balanced_ranges(sizes, shards)
+    set_id = _uuid.uuid4().hex
+    base = os.path.basename(path)
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+
+    # Aggregate per-shard progress for the stall watchdog: any shard's
+    # bytes advancing counts as progress.
+    plock = threading.Lock()
+    per_shard = [0] * shards
+
+    def progress_for(k: int) -> Callable[[int], None]:
+        def note(n: int) -> None:
+            if _progress is None:
+                return
+            with plock:
+                per_shard[k] = n
+                total = sum(per_shard)
+            _progress(total)
+        return note
+
+    infos: list = [None] * shards
+    errors: list = []
+
+    def write_shard(k: int, start: int, stop: int) -> None:
+        try:
+            sub = {_leaf_key(i): leaves[i] for i in range(start, stop)}
+            m2 = dict(meta or {})
+            m2.update(shard_index=k, shard_count=shards, set_id=set_id)
+            size = _write_v2(_shard_path(path, k), sub, manager_state,
+                             m2, progress_for(k))
+            infos[k] = {"name": f"{base}.shard{k}",
+                        "leaves": [start, stop], "size": size}
+        except BaseException as e:  # noqa: BLE001 — re-raised below
+            errors.append(e)
+
+    if shards == 1:
+        write_shard(0, *ranges[0])
+    else:
+        ts = [threading.Thread(target=write_shard, args=(k, a, b),
+                               name=f"ckpt-shard-{k}", daemon=True)
+              for k, (a, b) in enumerate(ranges)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+    if errors:
+        raise errors[0]
+
+    head = _build_head(plan_pytree(tree), manager_state, meta)
+    head.update(format=SET_FORMAT, set_id=set_id, shard_count=shards,
+                leaf_count=len(leaves), shards=infos)
+    head.pop("payload_len", None)  # no single payload; sizes per shard
+    body = json.dumps(head).encode()
+    if len(body) > _MAX_JSON:
+        raise ValueError("shard-set head implausibly large")
+    payload = (_SET_MAGIC + len(body).to_bytes(4, "little") + body
+               + zlib.crc32(body).to_bytes(4, "little"))
+
+    fault = chaos.disk_fault(f"disk:{base}")
+    if fault is not None and fault.fault == "torn":
+        with open(path, "wb") as f:
+            f.write(payload[:max(1, int(len(payload) * fault.frac))])
+        raise OSError(
+            f"[chaos] disk:{base}: torn write (crashed before rename "
+            "was durable)")
+    _atomic_publish(path, lambda f: f.write(payload))
+    if fault is not None and fault.fault == "flip":
+        _flip_byte(path, fault.frac)
+
+
+def _leaf_key(i: int) -> str:
+    """Zero-padded flat-leaf key inside a shard file: both the writer
+    and the loader derive it from the leaf's flatten index, so the
+    shard's ``_match_entries`` name cross-check stays meaningful."""
+    return f"{i:08d}"
+
+
+def _shard_path(path: str, k: int) -> str:
+    return f"{path}.shard{k}"
+
+
+def _read_set_head(path: str) -> Optional[dict]:
+    """Parse a shard-set head file; None when ``path`` is not one
+    (callers fall through to the v2 single-file path). Raises
+    :class:`CheckpointCorruptError` for a torn/corrupt head."""
+    with open(path, "rb") as f:
+        magic = f.read(len(_SET_MAGIC))
+        if magic != _SET_MAGIC:
+            return None
+        ln = int.from_bytes(_read_exact(f, 4, "set head length"), "little")
+        if ln > _MAX_JSON:
+            raise CheckpointCorruptError(
+                f"shard-set head implausibly large ({ln}B)")
+        body = _read_exact(f, ln, "set head")
+        crc = int.from_bytes(_read_exact(f, 4, "set head crc"), "little")
+    if zlib.crc32(body) != crc:
+        raise CheckpointCorruptError(
+            "shard-set head failed digest verification")
+    try:
+        head = json.loads(body)
+    except ValueError as e:
+        raise CheckpointCorruptError(f"unparsable shard-set head: {e}")
+    if not isinstance(head, dict) or head.get("format") != SET_FORMAT:
+        raise CheckpointCorruptError("invalid shard-set head")
+    return head
+
+
+def _verify_set(path: str, head: dict) -> dict:
+    """Verify every member shard of a set: present, internally
+    digest-clean (full v2 :func:`verify`), stamped with the head's
+    ``set_id`` (a stale same-name shard from an older save generation
+    must not pass), and jointly covering ``[0, leaf_count)``. Any
+    failure condemns the WHOLE set."""
+    d = os.path.dirname(os.path.abspath(path))
+    n_leaves = int(head.get("leaf_count", -1))
+    infos = head.get("shards")
+    if n_leaves < 0 or not isinstance(infos, list) or not infos:
+        raise CheckpointCorruptError("shard-set head missing geometry")
+    expect = 0
+    for s in infos:
+        a, b = int(s["leaves"][0]), int(s["leaves"][1])
+        if a != expect or b < a:
+            raise CheckpointCorruptError(
+                f"shard-set stripe geometry torn at leaf {a} "
+                f"(expected {expect})")
+        expect = b
+        sp = os.path.join(d, s["name"])
+        if not os.path.isfile(sp):
+            raise CheckpointCorruptError(f"missing shard {s['name']}")
+        sh = verify(sp)
+        if sh.get("set_id") != head.get("set_id"):
+            raise CheckpointCorruptError(
+                f"shard {s['name']} belongs to a different save "
+                "generation (set_id mismatch)")
+    if expect != n_leaves:
+        raise CheckpointCorruptError(
+            f"shard-set covers {expect} leaves, head claims {n_leaves}")
+    head["path"] = path
+    return head
+
+
+def _quarantine_set_members(path: str) -> float:
+    """Move a condemned set's shard files aside with its head (best
+    effort, by name pattern — the head may be unreadable). Returns how
+    many were quarantined."""
+    import glob as _glob
+
+    moved = 0.0
+    for sp in _glob.glob(_glob.escape(path) + ".shard*"):
+        if sp.endswith(_QUARANTINE_SUFFIX):
+            continue
+        if _quarantine(sp) is not None:
+            moved += 1
+    return moved
 
 
 def _write_torn(path: str, head_bytes: bytes, plan: Any,
@@ -374,9 +596,14 @@ def _open_verified(f) -> Tuple[dict, dict, int]:
 
 
 def read_meta(path: str) -> dict:
-    """Head-only peek at a durable checkpoint: format, step,
-    batches_committed, commit marker, quorum metadata. Cheap (no payload
-    scan — use :func:`verify` to prove integrity)."""
+    """Head-only peek at a durable checkpoint (single-file v2 OR a
+    shard-set head): format, step, batches_committed, commit marker,
+    quorum metadata — sets additionally carry the stripe geometry. Cheap
+    (no payload scan — use :func:`verify` to prove integrity)."""
+    head = _read_set_head(path)
+    if head is not None:
+        head["path"] = path
+        return head
     with open(path, "rb") as f:
         head, _ = _read_head(f)
     head["path"] = path
@@ -387,9 +614,15 @@ def verify(path: str) -> dict:
     """Validate a durable checkpoint WITHOUT loading it: structural
     (magic, head, trailer geometry) plus a full digest scan — head,
     payload preamble, and every array leaf's crc32 against the manifest.
-    No ``device_put`` is involved. Returns the head metadata on success;
-    raises :class:`CheckpointCorruptError` (torn/bit-flipped/truncated)
-    or :class:`CheckpointUnverifiableError` (legacy format)."""
+    A shard-set head verifies every member shard (presence, digests,
+    same-generation ``set_id``, stripe coverage) and fails the WHOLE set
+    on any defect. No ``device_put`` is involved. Returns the head
+    metadata on success; raises :class:`CheckpointCorruptError`
+    (torn/bit-flipped/truncated/missing-shard) or
+    :class:`CheckpointUnverifiableError` (legacy format)."""
+    head = _read_set_head(path)
+    if head is not None:
+        return _verify_set(path, head)
     with open(path, "rb") as f:
         head, mf, _ = _open_verified(f)
         preamble = _read_exact(f, int(mf["preamble_len"]), "preamble")
@@ -421,25 +654,46 @@ def load(path: str, target: Any, device_put: bool = True,
          ) -> Tuple[Any, dict]:
     """Read a checkpoint back into ``target``'s structure (and shardings
     when ``device_put``). Returns ``(user_state, manager_state)``.
+    Accepts all three on-disk spellings: a shard-set head (stripes
+    reassembled transparently), a single-file v2, or a legacy
+    bare-pytree file.
 
-    v2 files are digest-verified DURING the load: each leaf's crc32 is
-    checked against the manifest after the read and before
-    ``device_put`` — corrupt bytes never reach the device (the same
-    discipline as the heal path). Legacy bare-pytree files still load,
-    unverified, with a warning."""
+    v2 files (shards included) are digest-verified DURING the load: each
+    leaf's crc32 is checked against the manifest after the read and
+    before ``device_put`` — corrupt bytes never reach the device (the
+    same discipline as the heal path). Legacy bare-pytree files still
+    load, unverified, with a warning."""
+    head = _read_set_head(path)
+    if head is not None:
+        return _load_set(path, head, target, device_put)
     wrapped = {"user": target,
                "torchft": {"step": 0, "batches_committed": 0}}
     dput = device_put_like if device_put else None
-    with open(path, "rb") as f:
-        try:
-            _, mf, payload_start = _open_verified(f)
-        except CheckpointUnverifiableError:
-            logger.warning(
-                "loading legacy unverified checkpoint %s (no digest "
-                "manifest; re-save to upgrade)", path)
-            f.seek(0)
+    try:
+        tree = _load_v2_tree(path, wrapped, dput)
+    except CheckpointUnverifiableError:
+        logger.warning(
+            "loading legacy unverified checkpoint %s (no digest "
+            "manifest; re-save to upgrade)", path)
+        with open(path, "rb") as f:
             tree = load_pytree_from(f, wrapped, device_put_fn=dput)
-            return tree["user"], tree["torchft"]
+    return tree["user"], tree["torchft"]
+
+
+def _load_v2_tree(path: str, target_tree: Any,
+                  dput: Optional[Callable],
+                  expect_set_id: Optional[str] = None) -> Any:
+    """Digest-verified v2 load into an arbitrary target tree (shared by
+    :func:`load` and the per-shard reads of :func:`_load_set`, which
+    passes ``expect_set_id`` so a stale same-name shard from an older
+    save generation fails the load instead of splicing in silently)."""
+    with open(path, "rb") as f:
+        head, mf, payload_start = _open_verified(f)
+        if expect_set_id is not None and head.get("set_id") != \
+                expect_set_id:
+            raise CheckpointCorruptError(
+                f"shard {os.path.basename(path)} belongs to a different "
+                "save generation (set_id mismatch)")
         # The payload preamble json carries 'py'-kind leaf VALUES inline
         # (step counters, scalars): verify its digest too, or a bit flip
         # there would load silently while every array leaf checks out.
@@ -452,11 +706,41 @@ def load(path: str, target: Any, device_put: bool = True,
         digests = [int(e["crc32"]) for e in mf["leaves"]
                    if e.get("kind") == "array"]
         try:
-            tree = load_pytree_from(f, wrapped, device_put_fn=dput,
+            return load_pytree_from(f, target_tree, device_put_fn=dput,
                                     digests=digests)
         except LeafDigestMismatch as e:
             raise CheckpointCorruptError(str(e)) from e
-    return tree["user"], tree["torchft"]
+
+
+def _load_set(path: str, head: dict, target: Any,
+              device_put: bool) -> Tuple[Any, dict]:
+    """Reassemble a sharded checkpoint: load each stripe file into its
+    flat-leaf slots and unflatten once. The head's ``leaf_count`` must
+    match the target's flatten (the untrusted-header discipline —
+    a geometry/structure mismatch fails loudly, never permutes)."""
+    import jax
+
+    wrapped = {"user": target,
+               "torchft": {"step": 0, "batches_committed": 0}}
+    leaves, treedef = jax.tree_util.tree_flatten(wrapped)
+    if int(head.get("leaf_count", -1)) != len(leaves):
+        raise ValueError(
+            f"sharded checkpoint has {head.get('leaf_count')} leaves, "
+            f"target has {len(leaves)}")
+    dput = device_put_like if device_put else None
+    out = list(leaves)
+    d = os.path.dirname(os.path.abspath(path))
+    for s in head.get("shards", []):
+        a, b = int(s["leaves"][0]), int(s["leaves"][1])
+        if b <= a:
+            continue
+        sub_target = {_leaf_key(i): leaves[i] for i in range(a, b)}
+        sub = _load_v2_tree(os.path.join(d, s["name"]), sub_target, dput,
+                            expect_set_id=head.get("set_id"))
+        for i in range(a, b):
+            out[i] = sub[_leaf_key(i)]
+    full = jax.tree_util.tree_unflatten(treedef, out)
+    return full["user"], full["torchft"]
 
 
 def _legacy_intact(path: str) -> bool:
@@ -548,8 +832,13 @@ def recover(directory: str, prefix: str = "ckpt_",
                 logger.warning(
                     "recover: quarantining corrupt checkpoint %s (%s)",
                     path, e)
-                if quarantine and _quarantine(path) is not None:
-                    quarantined += 1
+                if quarantine:
+                    if _quarantine(path) is not None:
+                        quarantined += 1
+                    # A condemned shard set takes its member files with
+                    # it — one bad shard fails the WHOLE set, and its
+                    # survivors must not shadow anything later.
+                    quarantined += _quarantine_set_members(path)
                 fallbacks += 1
                 continue
             if not head.get("committed", True):
@@ -632,15 +921,23 @@ class AsyncCheckpointer:
         retry_stats: optional shared :class:`~torchft_tpu.retry.RetryStats`
             the retries are counted into.
         stall_timeout_sec: no-progress watchdog, see above.
+        shards: when > 1, every save is written via
+            :func:`save_sharded` — per-stripe files in parallel plus a
+            shard-set head (env ``TORCHFT_CKPT_SHARDS`` overrides the
+            default). Recovery handles both formats transparently.
     """
 
     def __init__(self, keep: int = 0, prefix: str = "ckpt_",
                  retry_policy: Optional[RetryPolicy] = None,
                  retry_stats: Optional[RetryStats] = None,
-                 stall_timeout_sec: Optional[float] = None) -> None:
+                 stall_timeout_sec: Optional[float] = None,
+                 shards: Optional[int] = None) -> None:
         if stall_timeout_sec is None:
             stall_timeout_sec = float(
                 os.environ.get("TORCHFT_CKPT_STALL_SEC", 60.0))
+        if shards is None:
+            shards = int(os.environ.get("TORCHFT_CKPT_SHARDS", 0) or 0)
+        self._shards = max(int(shards), 0)
         self._stall_sec = float(stall_timeout_sec)
         self._job: Optional[_SaveJob] = None
         self._error: Optional[BaseException] = None
@@ -706,7 +1003,11 @@ class AsyncCheckpointer:
         t0 = time.perf_counter()
 
         def op() -> None:
-            save(job.path, user, mgr, meta=meta, _progress=job.note)
+            if self._shards > 1:
+                save_sharded(job.path, user, mgr, meta=meta,
+                             shards=self._shards, _progress=job.note)
+            else:
+                save(job.path, user, mgr, meta=meta, _progress=job.note)
 
         try:
             if self._retry_policy is not None:
@@ -758,13 +1059,24 @@ class AsyncCheckpointer:
                 continue
             protected.add(name)
             break
+        import glob as _glob
+
         for _, name in steps:
             if name in protected:
                 continue
+            p = os.path.join(directory, name)
             try:
-                os.unlink(os.path.join(directory, name))
+                os.unlink(p)
             except OSError:
                 pass
+            # A pruned shard-set head takes its stripe files with it —
+            # headless shards are invisible orphans that would otherwise
+            # leak disk forever.
+            for sp in _glob.glob(_glob.escape(p) + ".shard*"):
+                try:
+                    os.unlink(sp)
+                except OSError:
+                    pass
 
     def wait(self) -> None:
         """Block until the in-flight save (if any) is durable — or until

@@ -63,6 +63,7 @@ from torchft_tpu.serialization import (
     _match_entries,
     _read_exact_into,
     _resolve_dtype,
+    balanced_ranges,
     device_put_like,
     iter_pytree_chunks,
     load_pytree_from,
@@ -219,6 +220,12 @@ class _HealSession:
         self.rounds = 0                     # data fetch rounds (attempts)
         self.failovers = 0
         self.digest_mismatches = 0
+        # Striped mode: donors that actually landed committed leaves,
+        # and the lock making commit/byte accounting safe under the
+        # per-donor fetch threads (single-donor fetches never contend).
+        self.donors_used: set = set()
+        self.stripe_deaths = 0              # striped donors dropped dead
+        self.lock = threading.Lock()
 
     def adopt_manifest(self, mf: dict) -> None:
         """Validate a donor's manifest against the target (structure,
@@ -271,32 +278,38 @@ class _HealSession:
                                _resolve_dtype(entry["dtype"]))
                 self.commit(i, arr, zlib.crc32(b""))
 
-    def commit(self, i: int, arr: np.ndarray, crc: int) -> None:
+    def commit(self, i: int, arr: np.ndarray, crc: int,
+               donor: Optional[str] = None) -> None:
         tleaf = self.pairs[i][1]
-        self.committed[i] = (self.device_put_fn(arr, tleaf)
-                             if self.device_put_fn is not None else arr)
-        self.crcs[i] = crc
-        self.committed_bytes += int(self.pairs[i][0]["nbytes"])
+        placed = (self.device_put_fn(arr, tleaf)
+                  if self.device_put_fn is not None else arr)
+        with self.lock:
+            self.committed[i] = placed
+            self.crcs[i] = crc
+            self.committed_bytes += int(self.pairs[i][0]["nbytes"])
+            if donor is not None:
+                self.donors_used.add(donor)
 
     def note_bytes(self, n: int) -> None:
-        self.bytes_read += n
-        if self.rounds > 1:
-            self.bytes_resumed += n
+        with self.lock:
+            self.bytes_read += n
+            if self.rounds > 1:
+                self.bytes_resumed += n
 
     def missing(self) -> List[int]:
-        return [i for i in self.arr_order if i not in self.committed]
+        with self.lock:
+            return [i for i in self.arr_order if i not in self.committed]
 
     def complete(self) -> bool:
         return (self.pairs is not None
                 and len(self.committed) == len(self.pairs))
 
-    def spans(self) -> List[list]:
-        """Missing leaves coalesced into contiguous ``[start, end,
-        [pair indices]]`` byte spans (absolute stream offsets), one
-        Range request each — the first attempt is a single span covering
-        the whole body; later attempts cover only what's left."""
+    def spans_for(self, idxs: List[int]) -> List[list]:
+        """Coalesce leaf indices (body order) into contiguous ``[start,
+        end, [pair indices]]`` byte spans (absolute stream offsets), one
+        Range request each."""
         out: List[list] = []
-        for i in self.missing():
+        for i in idxs:
             entry = self.pairs[i][0]
             a = self.preamble_len + int(entry["offset"])
             b = a + int(entry["nbytes"])
@@ -306,6 +319,22 @@ class _HealSession:
             else:
                 out.append([a, b, [i]])
         return out
+
+    def spans(self) -> List[list]:
+        """Missing leaves as coalesced spans — the first attempt is a
+        single span covering the whole body; later attempts cover only
+        what's left."""
+        return self.spans_for(self.missing())
+
+    def stripes(self, n: int) -> List[List[int]]:
+        """Partition the missing leaves into ``n`` contiguous,
+        byte-balanced groups (group ``k`` for donor ``k``; may be empty
+        when little is left). Contiguity keeps each donor's fetch a
+        handful of coalesced Range requests instead of a shotgun of
+        per-leaf ones."""
+        missing = self.missing()
+        sizes = [int(self.pairs[i][0]["nbytes"]) for i in missing]
+        return [missing[a:b] for a, b in balanced_ranges(sizes, n)]
 
     def assemble(self) -> Any:
         leaves = [self.committed[i] for i in range(len(self.pairs))]
@@ -546,14 +575,19 @@ class CheckpointServer:
     def _capture_locked(self) -> Tuple[Any, Any]:
         """State + plan to stream for the current step. Requires _cond held.
 
-        Snapshot mode: first GET of the step copies the state (see module
-        docstring); later GETs share it. Lock-streaming mode: the live
-        refs (disallow_checkpoint then waits for the stream to drain)."""
-        if self._lock_streaming:
-            state = self._state_fn()
-            return state, plan_pytree(state)
+        ONE ``(state, plan)`` pair is cached per serve window in BOTH
+        modes and shared by every concurrent manifest/Range request of
+        the step — so striped healers fanning N Range fetches at one
+        donor share a single :class:`~torchft_tpu.serialization.
+        PytreePlan` and its once-computed digest cache instead of
+        re-planning (and re-digesting) per request. Snapshot mode: the
+        first GET of the step copies the state (see module docstring).
+        Lock-streaming mode: the cache holds LIVE refs — safe because
+        ``disallow_checkpoint`` drains in-flight streams and clears the
+        cache before the caller mutates state."""
         if self._snap is None or self._snap[0] != self._step:
-            state = _snapshot_tree(self._state_fn())
+            state = (self._state_fn() if self._lock_streaming
+                     else _snapshot_tree(self._state_fn()))
             self._snap = (self._step, state, plan_pytree(state))
         return self._snap[1], self._snap[2]
 
@@ -616,6 +650,8 @@ class CheckpointServer:
                           donors: Optional[Callable[[int], Optional[str]]]
                           = None,
                           max_donor_failovers: int = 3,
+                          donor_addrs: Optional[List[str]] = None,
+                          stripe_seed: Optional[int] = None,
                           progress_cb: Optional[Callable[[int, int], None]]
                           = None) -> T:
         """Fetch a peer's live checkpoint and restore it into ``target``'s
@@ -652,6 +688,21 @@ class CheckpointServer:
         was already verified, which is the runtime check of the
         same-step-snapshots-are-bitwise-identical invariant.
 
+        ``donor_addrs``, when it names two or more live donors serving
+        the SAME step, enables the TORRENT-STRIPED fetch
+        (docs/design/sharded_update.md): the missing leaves are
+        partitioned into contiguous byte-balanced stripes, one per
+        donor, fetched CONCURRENTLY (wall-clock target ~1/N_donors);
+        every leaf still digest-verifies against the one adopted
+        manifest, which is what makes mixing donors sound. A donor that
+        dies mid-stripe is dropped and only its REMAINING stripe is
+        reassigned to the survivors on the next round
+        (``bytes_resumed`` counts exactly that traffic); when the whole
+        set dies the ``donors`` failover resolver above is the last
+        resort. ``stripe_seed`` deterministically shuffles the donor
+        order so concurrent healers spread their load instead of all
+        opening their first stream against the same donor.
+
         ``stats``, when given, is filled with truthful counters:
         ``bytes`` (payload bytes actually read off the wire, across all
         attempts — NOT the donor's Content-Length claim),
@@ -673,10 +724,24 @@ class CheckpointServer:
                     if pol.overall_deadline_ms > 0 else None)
         dput = device_put_like if device_put else None
         session = _HealSession(target, dput)
+        # Striped donor set: seed-shuffled so concurrent healers spread
+        # their first streams; the quorum's primary rides along
+        # (deduped) as one donor among equals.
+        stripe: List[str] = []
+        if donor_addrs:
+            stripe = list(dict.fromkeys(list(donor_addrs) + [address]))
+            if len(stripe) >= 2:
+                import random as _random
+
+                _random.Random(stripe_seed).shuffle(stripe)
+                address = stripe[0]
+            else:
+                stripe = []
         try:
             out = cls._run_heal_loop(
                 session, address, stall, auth_token, pol, deadline,
-                donors, max_donor_failovers, progress_cb, retry_stats)
+                donors, max_donor_failovers, progress_cb, retry_stats,
+                stripe=stripe)
         finally:
             # Fill stats on BOTH outcomes: a failed heal's wire cost,
             # attempts, and failovers are exactly what the runbook's
@@ -689,13 +754,18 @@ class CheckpointServer:
                 stats["digest_mismatches"] = float(
                     session.digest_mismatches)
                 stats["attempts"] = float(session.rounds)
+                stats["donors_used"] = float(
+                    max(len(session.donors_used), 1))
+                stats["stripe_donor_deaths"] = float(
+                    session.stripe_deaths)
         dt = time.perf_counter() - t0
         logger.info(
             "checkpoint transfer: %.1f MB in %.2fs (%.0f MB/s; "
-            "%d attempt(s), %.1f MB resumed, %d failover(s), "
-            "%d digest mismatch(es))",
+            "%d attempt(s), %d donor(s), %.1f MB resumed, "
+            "%d failover(s), %d digest mismatch(es))",
             session.bytes_read / 1e6, dt,
             session.bytes_read / 1e6 / max(dt, 1e-9), session.rounds,
+            max(len(session.donors_used), 1),
             session.bytes_resumed / 1e6, session.failovers,
             session.digest_mismatches)
         return out
@@ -707,13 +777,20 @@ class CheckpointServer:
                        donors: Optional[Callable[[int], Optional[str]]],
                        max_donor_failovers: int,
                        progress_cb: Optional[Callable[[int, int], None]],
-                       retry_stats: Optional[RetryStats]) -> Any:
+                       retry_stats: Optional[RetryStats],
+                       stripe: Optional[List[str]] = None) -> Any:
+        stripe = stripe or []
         endpoint = _heal_endpoint(addr)
         attempts = max(int(pol.max_attempts), 1)
         no_progress = 0
         legacy: Optional[bool] = None
         need_manifest = True
         while True:
+            if stripe and addr not in stripe:
+                # The striped wave dropped the manifest donor as dead;
+                # the SAME transfer continues against the survivors.
+                addr = stripe[0]
+                endpoint = _heal_endpoint(addr)
             marker = len(session.committed)
             try:
                 if legacy is not True and need_manifest:
@@ -735,17 +812,25 @@ class CheckpointServer:
                         session.device_put_fn, session, endpoint)
                 if not session.complete():
                     session.rounds += 1
-                    for span in session.spans():
-                        cls._fetch_span(addr, session, span, stall,
-                                        auth_token, endpoint, progress_cb)
+                    if len(stripe) > 1:
+                        cls._fetch_striped(session, stripe, stall,
+                                           auth_token, progress_cb)
+                    else:
+                        for span in session.spans():
+                            cls._fetch_span(addr, session, span, stall,
+                                            auth_token, endpoint,
+                                            progress_cb)
                 if session.complete():
                     return session.assemble()
-                # Every remaining leaf mismatched its digest this round:
-                # corruption in transit — transient, re-fetch (bounded
-                # per leaf by MAX_LEAF_REFETCHES inside _fetch_span).
+                # Remaining leaves either mismatched their digest
+                # (corruption in transit — bounded per leaf by
+                # MAX_LEAF_REFETCHES inside _fetch_span) or rode a
+                # striped donor that died mid-wave: transient either
+                # way, the next round re-spans only what's left.
                 raise LeafDigestError(
-                    f"{len(session.missing())} leaves failed digest "
-                    "verification; re-fetching")
+                    f"{len(session.missing())} leaves still missing "
+                    "after this round (digest mismatch or dropped "
+                    "striped donor); re-fetching")
             except Exception as e:  # noqa: BLE001 — classified below
                 transient = _heal_transient(e)
                 dead = (isinstance(e, HealCorruptError)
@@ -756,6 +841,28 @@ class CheckpointServer:
                     no_progress = 0
                 else:
                     no_progress += 1
+                if dead and getattr(e, "_heal_striped_handled", False) \
+                        and stripe:
+                    # A striped wave already evicted the donor(s) that
+                    # actually died — `addr` may well be a healthy
+                    # survivor (the exception belongs to ANOTHER
+                    # donor's thread). Re-stripe over the survivors;
+                    # the loop head re-targets if addr was the victim.
+                    no_progress = 0
+                    continue
+                if dead and addr in stripe and len(stripe) > 1:
+                    # A striped peer remains: drop the dead donor and
+                    # reassign its stripe instead of burning a failover
+                    # (the failover resolver stays the LAST resort, for
+                    # when the whole advertised set is gone).
+                    stripe.remove(addr)
+                    with session.lock:
+                        session.stripe_deaths += 1
+                    logger.warning(
+                        "heal: striped donor %s dead (%s); continuing "
+                        "with %d survivor(s)", addr, e, len(stripe))
+                    no_progress = 0
+                    continue
                 if ((dead or no_progress >= attempts)
                         and donors is not None
                         and session.failovers < max_donor_failovers):
@@ -773,6 +880,11 @@ class CheckpointServer:
                         session.failovers += 1
                         addr = nxt
                         endpoint = _heal_endpoint(addr)
+                        # The advertised stripe set is spent — the
+                        # resolver's donor is authoritative now, and a
+                        # stale stripe entry must not re-capture addr at
+                        # the top of the loop.
+                        stripe.clear()
                         need_manifest = True
                         legacy = None
                         no_progress = 0
@@ -881,9 +993,10 @@ class CheckpointServer:
                 _read_exact_into(reader, mv)
                 crc = zlib.crc32(mv)
                 if "crc32" in entry and crc != int(entry["crc32"]):
-                    session.digest_mismatches += 1
-                    n = session.refetches[i] = \
-                        session.refetches.get(i, 0) + 1
+                    with session.lock:
+                        session.digest_mismatches += 1
+                        n = session.refetches[i] = \
+                            session.refetches.get(i, 0) + 1
                     logger.warning(
                         "heal: leaf %r digest mismatch "
                         "(got %08x, manifest %08x; refetch %d/%d)",
@@ -895,13 +1008,77 @@ class CheckpointServer:
                             f"verification {n} times; the donor's copy "
                             "is corrupt")
                     continue  # stays missing; next round re-spans it
-                session.commit(i, arr, crc)
+                session.commit(i, arr, crc, donor=addr)
                 if progress_cb is not None:
                     progress_cb(session.committed_bytes, session.total_len)
         finally:
             resp.close()
             session.note_bytes(counter[0])
         chaos.end(tok)
+
+    @classmethod
+    def _fetch_striped(cls, session: "_HealSession", stripe: List[str],
+                       stall: float, auth_token: Optional[str],
+                       progress_cb: Optional[Callable[[int, int], None]]
+                       ) -> None:
+        """One torrent-striped wave: partition the missing leaves into
+        contiguous byte-balanced stripes, one per live donor, and fetch
+        them CONCURRENTLY (one thread per donor; each stripe collapses
+        to a handful of coalesced Range requests). Every leaf verifies
+        against the one adopted manifest regardless of which donor
+        served it — the same-step bitwise-identity invariant, checked
+        per leaf.
+
+        Donors whose thread fails DEAD (refused dial, persistently
+        corrupt copy) are removed from ``stripe`` in place, so the next
+        wave re-partitions only the remaining bytes over the survivors.
+        Raises only when NO leaf landed this wave (all donors failed) —
+        a partial wave returns so the caller's progress accounting
+        resets the retry budget and re-stripes the remainder."""
+        groups = session.stripes(len(stripe))
+        before = len(session.committed)
+        failures: List[Tuple[str, BaseException]] = []
+        flock = threading.Lock()
+
+        def fetch(donor: str, idxs: List[int]) -> None:
+            try:
+                for span in session.spans_for(idxs):
+                    cls._fetch_span(donor, session, span, stall,
+                                    auth_token, _heal_endpoint(donor),
+                                    progress_cb)
+            except BaseException as e:  # noqa: BLE001 — classified below
+                with flock:
+                    failures.append((donor, e))
+
+        threads = [
+            threading.Thread(target=fetch, args=(donor, idxs),
+                             name=f"heal-stripe-{k}", daemon=True)
+            for k, (donor, idxs) in enumerate(zip(stripe, groups))
+            if idxs
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        primary_exc: Optional[BaseException] = None
+        for donor, e in failures:
+            if (isinstance(e, HealCorruptError) or _looks_donor_dead(e)) \
+                    and donor in stripe and len(stripe) > 1:
+                stripe.remove(donor)
+                with session.lock:
+                    session.stripe_deaths += 1
+                logger.warning(
+                    "heal: striped donor %s died mid-stripe (%s); its "
+                    "remaining leaves reassign to %d survivor(s)",
+                    donor, e, len(stripe))
+            if primary_exc is None or donor == stripe[0]:
+                primary_exc = e
+        if failures and len(session.committed) == before:
+            # Dead donors were already evicted above — flag that so the
+            # caller's own eviction branch doesn't blame the CURRENT
+            # manifest donor for a different donor's death.
+            primary_exc._heal_striped_handled = True  # noqa: SLF001
+            raise primary_exc  # zero-progress wave: let the caller classify
 
     @staticmethod
     def _legacy_fetch(addr: str, target: T, stall: float,

@@ -51,6 +51,29 @@ def _upcast_buffers(buffers: Sequence[Any],
     ]
 
 
+def shard_bounds(size: int, world: int) -> np.ndarray:
+    """Canonical shard boundaries of a ``size``-element buffer across
+    ``world`` ranks: rank ``r`` owns ``[bounds[r], bounds[r+1])``. The ONE
+    spelling shared by the reduce-scatter transport, the sharded optimizer
+    update, and the param allgather reassembly — every layer must derive
+    byte-identical stripes from (size, world) alone, or the reassembled
+    params tear at stripe seams. Deliberately the same ``np.linspace``
+    geometry as the exact ring's chunking (``backends/host.py``), so the
+    exact-mode reduce-scatter IS the ring's reduce-scatter phase."""
+    return np.linspace(0, size, world + 1, dtype=np.int64)
+
+
+def _slice_shards(buffers: Sequence[np.ndarray], rank: int,
+                  world: int) -> List[np.ndarray]:
+    """Rank-``rank``'s canonical stripe of each buffer (copies — callers
+    own the shards outright; the full buffers may be backend scratch)."""
+    out = []
+    for arr in buffers:
+        b = shard_bounds(arr.size, world)
+        out.append(np.array(arr[b[rank]:b[rank + 1]]))
+    return out
+
+
 class CommunicatorError(RuntimeError):
     """A collective failed (peer death, timeout, reconfiguration abort)."""
 
@@ -96,6 +119,43 @@ class Communicator(ABC):
         MUST forward — a wrapper falling back to the default silently
         doubles the ring bytes."""
         return self.allreduce(_upcast_buffers(buffers, orig_dtypes), op=op)
+
+    def reduce_scatter_wire(self, buffers: Sequence[Any],
+                            orig_dtypes: Sequence[Any],
+                            op: str = "sum") -> Future:
+        """Reduce-scatter sibling of :meth:`allreduce_wire`: reduce the
+        flat wire buffers across the world but resolve to only THIS
+        rank's canonical stripe of each reduced buffer
+        (:func:`shard_bounds` over the buffer's element count), in the
+        accumulator dtype. The contract that makes ZeRO-style sharded
+        updates sound: ``concat(shards over ranks)`` must be BITWISE
+        identical to the corresponding :meth:`allreduce_wire` result —
+        byte-counted backends implement it as the ring's own
+        reduce-scatter phase plus an ownership-shift hop (exact mode:
+        1.0·payload ring bytes per rank vs the allreduce's 2(n-1)/n) or
+        the canonical-rank-order wire fold restricted to the local
+        stripe (:class:`~torchft_tpu.backends.host.HostCommunicator`;
+        half the wire bytes at world 2), cutting fold compute — and the
+        optimizer stage that follows — to ~1/world.
+        Buffers are consumed, like :meth:`allreduce_wire`. Wrappers MUST
+        forward — falling back to the default silently restores
+        full-allreduce ring traffic."""
+        fut = self.allreduce_wire(buffers, orig_dtypes, op)
+        rank, world = self.rank(), max(self.size(), 1)
+        out: Future = Future()
+
+        def relay(f: Future) -> None:
+            e = f.exception()
+            if e is not None:
+                out.set_exception(e)
+                return
+            try:
+                out.set_result(_slice_shards(f.result(), rank, world))
+            except Exception as e2:  # noqa: BLE001
+                out.set_exception(e2)
+
+        fut.add_done_callback(relay)
+        return out
 
     def ring_bytes_total(self) -> float:
         """Cumulative allreduce payload bytes this rank has *sent* over
@@ -286,6 +346,27 @@ class ErrorSwallowingCommunicator(Communicator):
             self.report_error(e)
             return _done_future(fallback())
 
+    def reduce_scatter_wire(self, buffers: Sequence[Any],
+                            orig_dtypes: Sequence[Any],
+                            op: str = "sum") -> Future:
+        # Same lazy structure-only fallback discipline as allreduce_wire,
+        # sliced to this rank's stripe (the shapes callers expect); the
+        # latched error means the values are discarded at the vote.
+        def fallback() -> Any:
+            return _slice_shards(
+                _upcast_buffers(buffers, orig_dtypes),
+                self._comm.rank(), max(self._comm.size(), 1))
+
+        if self._error is not None:
+            return _done_future(fallback())
+        try:
+            return self._wrap_lazy(
+                self._comm.reduce_scatter_wire(buffers, orig_dtypes, op),
+                fallback)
+        except Exception as e:
+            self.report_error(e)
+            return _done_future(fallback())
+
     def broadcast(self, tree: Any, root: int = 0) -> Future:
         if self._error is not None:
             return _done_future(tree)
@@ -385,6 +466,27 @@ class ManagedCommunicator(Communicator):
         try:
             return self._guard_lazy(
                 self._comm.allreduce_wire(buffers, orig_dtypes, op),
+                fallback)
+        except Exception as e:
+            self._manager.report_error(e)
+            return _done_future(fallback())
+
+    def reduce_scatter_wire(self, buffers: Sequence[Any],
+                            orig_dtypes: Sequence[Any],
+                            op: str = "sum") -> Future:
+        # Lazy structure-only fallback sliced by the INNER comm's
+        # (rank, world): this wrapper's size() is the participant count,
+        # but stripe geometry belongs to the ring world.
+        def fallback() -> Any:
+            return _slice_shards(
+                _upcast_buffers(buffers, orig_dtypes),
+                self._comm.rank(), max(self._comm.size(), 1))
+
+        if self._manager.errored() is not None:
+            return _done_future(fallback())
+        try:
+            return self._guard_lazy(
+                self._comm.reduce_scatter_wire(buffers, orig_dtypes, op),
                 fallback)
         except Exception as e:
             self._manager.report_error(e)

@@ -53,7 +53,8 @@ import jax.numpy as jnp
 
 from torchft_tpu._native import ManagerClient, ManagerServer, Store, StoreClient
 from torchft_tpu.checkpointing import CheckpointServer
-from torchft_tpu.communicator import Communicator, CommunicatorError
+from torchft_tpu.communicator import (Communicator, CommunicatorError,
+                                      shard_bounds)
 from torchft_tpu.retry import RetryPolicy, RetryStats
 from torchft_tpu.utils import advertise_host, div_by_count
 
@@ -170,6 +171,36 @@ class Manager:
             advance over an unsettled deferred step, ``save_durable``
             refuses mid-flight snapshots) whenever a deferred step is
             staged.
+        shard_update: opt-in ZeRO-style cross-replica sharding of the
+            weight update (docs/design/sharded_update.md). When True,
+            trainers call :meth:`reduce_scatter` instead of
+            :meth:`allreduce`: the host pipeline reduce-scatters each
+            wire chunk so this group receives only its canonical stripe
+            of the averaged gradient
+            (:func:`~torchft_tpu.communicator.shard_bounds` over the
+            ring world), the optimizer
+            (:class:`~torchft_tpu.optim.FTOptimizer` /
+            :class:`~torchft_tpu.optim.DelayedOptimizer`) applies the
+            update only on that stripe — per-group update compute and
+            optimizer-state memory ~1/world — and the updated param
+            stripes allgather back into full params. Bitwise identical
+            to the allreduce path for elementwise optimizers (the
+            canonical-order f32 fold is shared). The flag is the opt-in
+            contract read by the trainer wiring; the collective calls
+            themselves work on any Manager.
+        heal_striped: stripe a heal transfer across ALL live donors
+            concurrently (docs/design/sharded_update.md; env
+            ``TORCHFT_HEAL_STRIPED``, default on). Participants publish
+            their checkpoint address under a per-``max_step`` store
+            prefix each quorum round; a healer resolves the donor set
+            from it and partitions leaf ranges across the donors
+            (torrent-style — per-leaf digests already guarantee
+            same-step bitwise identity across donors), targeting heal
+            wall-clock ~1/N_donors. A dead donor only reassigns its
+            remaining stripe; donor order is seed-shuffled per healer so
+            concurrent healers spread their load. Falls back to the
+            single-donor resumable fetch when the donor set cannot be
+            resolved (no native store, lone donor).
     """
 
     def __init__(
@@ -194,6 +225,8 @@ class Manager:
         allreduce_bucket_bytes: int = 4 << 20,
         allreduce_wire_dtype: Optional[Any] = None,
         overlap_steps: int = 0,
+        shard_update: bool = False,
+        heal_striped: Optional[bool] = None,
         auth_token: Optional[str] = None,
         checkpoint_bind_host: Optional[str] = None,
         retry_policy: Optional[RetryPolicy] = None,
@@ -212,6 +245,15 @@ class Manager:
                 "overlap_steps must be 0 (sync commit) or 1 (one-step "
                 f"deferred commit), got {overlap_steps!r}")
         self._overlap_steps = int(overlap_steps)
+        self._shard_update = bool(shard_update)
+        if heal_striped is None:
+            heal_striped = os.environ.get(
+                "TORCHFT_HEAL_STRIPED", "1").strip() not in ("0", "false")
+        self._heal_striped = bool(heal_striped)
+        # Cached StoreClient for the quorum's shared store (healset donor
+        # publication/listing), keyed by host:port so a lighthouse
+        # failover re-dials.
+        self._healset_store: Optional[tuple] = None
         # Cross-step overlap engine state: the ONE in-flight deferred
         # allreduce (future + dispatch/done timestamps) whose grads apply
         # at the next step boundary. None outside overlap mode or when
@@ -265,6 +307,9 @@ class Manager:
             "heal_attempts_total": 0.0,
             "heal_last_bytes_committed": 0.0,
             "heal_last_payload_bytes": 0.0,
+            # Striped-heal observability: donors the last heal actually
+            # fetched from (1 = single-donor path).
+            "heal_striped_donors": 0.0,
             "allreduce_count": 0, "allreduce_ms_total": 0.0,
             # Stage breakdown of the pipelined host allreduce (cumulative
             # BUSY ms per stage; stages overlap across buckets, so sums
@@ -293,6 +338,18 @@ class Manager:
             "allreduce_inflight": 0,
             "overlap_steps_deferred": 0,
             "overlap_grads_dropped": 0,
+            # ZeRO-style sharded update (docs/design/sharded_update.md):
+            # reduce-scatter rounds, the optimizer's stripe-update wall
+            # (pack + tx.update + allgather + reassembly, recorded by
+            # FTOptimizer via record_update), the live stripe
+            # optimizer-state footprint (gauge — ~1/world of the full
+            # state), and stripe-state resets forced by geometry changes
+            # (membership change ⇒ every rank resets together, keeping
+            # params lockstep).
+            "reduce_scatter_count": 0,
+            "update_count": 0, "update_ms_total": 0.0,
+            "shard_state_bytes": 0.0,
+            "shard_state_resets": 0,
             "commit_count": 0, "commit_ms_total": 0.0,
             "committed_steps": 0, "aborted_steps": 0,
             # Durable-checkpoint observability (cold-start resilience,
@@ -650,7 +707,15 @@ class Manager:
                 world=q.replica_world_size, recovery=recovery,
             )
 
-        if q.heal:
+        if not q.heal:
+            # Advertise this participant's checkpoint server under the
+            # quorum store's per-rank healset key so healers can
+            # stripe a fetch across EVERY live donor, not just the
+            # quorum's designated primary. Best-effort: a store without
+            # the native client (tests) or a flaky set must never fail a
+            # training step.
+            self._publish_healset(q)
+        else:
             # We are lagging (or a fresh step-1 non-primary): fetch the
             # primary's live weights (reference manager.py:380-396).
             with self._metrics_lock:
@@ -676,6 +741,8 @@ class Manager:
                 with self._metrics_lock:  # fresh gauges for this transfer
                     self._metrics["heal_last_bytes_committed"] = 0.0
                     self._metrics["heal_last_payload_bytes"] = 0.0
+                donor_addrs = (self._healset_donors(q, ckpt_addr)
+                               if self._heal_striped else None)
                 state = cast(
                     Dict[str, Any],
                     CheckpointServer.load_from_address(
@@ -687,6 +754,8 @@ class Manager:
                         donors=lambda i: self._resolve_next_donor(i, q),
                         max_donor_failovers=(
                             self._heal_max_donor_failovers),
+                        donor_addrs=donor_addrs,
+                        stripe_seed=_stripe_seed(self._replica_id),
                         progress_cb=self._heal_progress),
                 )
             finally:
@@ -706,6 +775,9 @@ class Manager:
                         "digest_mismatches", 0.0),
                     heal_attempts_total=heal_stats.get("attempts", 0.0),
                 )
+                with self._metrics_lock:  # gauge, not a counter
+                    self._metrics["heal_striped_donors"] = heal_stats.get(
+                        "donors_used", 1.0)
                 self._log_event(
                     event="heal", step=self._step,
                     source=q.recover_manager_address,
@@ -714,6 +786,7 @@ class Manager:
                     resumed=heal_stats.get("bytes_resumed", 0.0),
                     attempts=heal_stats.get("attempts", 0.0),
                     failovers=heal_stats.get("donor_failovers", 0.0),
+                    donors_used=heal_stats.get("donors_used", 1.0),
                     digest_mismatches=heal_stats.get(
                         "digest_mismatches", 0.0),
                 )
@@ -793,6 +866,82 @@ class Manager:
             logger.exception("%s: donor re-resolution failed",
                              self._replica_id)
             return None
+
+    # ------------------------------------------------- striped-heal donors
+
+    def _healset_client(self, q: Any) -> Optional[Any]:
+        """StoreClient for the quorum's shared store (the same store the
+        ring rendezvous rides), cached per address. None when the native
+        client is unavailable (mocked control planes)."""
+        addr = q.store_address
+        if not addr:
+            return None
+        if self._healset_store is not None \
+                and self._healset_store[0] == addr:
+            return self._healset_store[1]
+        client = StoreClient(addr, connect_timeout_ms=self._timeout_ms,
+                             retry_policy=self._retry_policy,
+                             retry_stats=self._retry_stats)
+        self._healset_store = (addr, client)
+        return client
+
+    def _publish_healset(self, q: Any) -> None:
+        """Advertise this participant's checkpoint address under the
+        FIXED per-rank key ``torchft/healset/{replica_rank}`` on the
+        quorum store, value ``"{max_step}:{addr}"``. Healers discard
+        advertisements whose step prefix is not the max_step they are
+        healing to — same-step bitwise identity is what makes donors
+        interchangeable. The key must stay fixed per rank: the store has
+        no delete/TTL, so a per-step key would leak one entry per
+        participant per step for the life of the job."""
+        if not self._heal_striped or q.replica_world_size <= 1:
+            return
+        try:
+            store = self._healset_client(q)
+            if store is None:
+                return
+            store.set(
+                f"torchft/healset/{q.replica_rank}",
+                f"{q.max_step}:{self._ckpt_server.address()}".encode())
+        except Exception:  # noqa: BLE001 — advertisement is best-effort
+            logger.debug("healset publication failed", exc_info=True)
+
+    def _healset_donors(self, q: Any,
+                        primary_addr: str) -> Optional[list]:
+        """Resolve the live donor set for a striped heal: the quorum's
+        designated primary plus every peer whose advertisement carries
+        this heal's ``max_step``. Live ranks re-publish every step, so
+        their keys exist and the gets return immediately; only
+        never-joined ranks (and none of this is on the happy path — the
+        probe runs once per heal) burn the short absent-key timeout.
+        Returns None (single-donor fallback) when fewer than two
+        distinct donors emerge."""
+        addrs = [primary_addr]
+        try:
+            store = self._healset_client(q)
+            if store is None:
+                return None
+            for r in range(q.max_world_size):
+                if r == q.replica_rank:
+                    continue  # the healer itself never published
+                try:
+                    v = store.get(f"torchft/healset/{r}",
+                                  timeout_ms=200).decode()
+                except Exception:  # noqa: BLE001 — absent rank key
+                    continue
+                step_s, _, a = v.partition(":")
+                if step_s != str(q.max_step):
+                    continue  # stale advertisement from an older step
+                if a and a not in addrs:
+                    addrs.append(a)
+        except Exception:  # noqa: BLE001 — resolution is best-effort
+            logger.debug("healset donor listing failed", exc_info=True)
+            return None
+        if len(addrs) < 2:
+            return None
+        logger.info("%s: striping heal across %d donors",
+                    self._replica_id, len(addrs))
+        return addrs
 
     # ------------------------------------------------------------- allreduce
 
@@ -1197,6 +1346,203 @@ class Manager:
     # alias matching the reference's gradient-specific spelling
     allreduce_grad = allreduce
 
+    # -------------------------------------------------- sharded update
+
+    def shard_update(self) -> bool:
+        """True when this Manager was built with ``shard_update=True``
+        (ZeRO-style sharded weight update,
+        docs/design/sharded_update.md). Read by
+        :class:`~torchft_tpu.parallel.step.FTTrainer` to pick the
+        reduce-scatter loop."""
+        return self._shard_update
+
+    def reduce_scatter(self, tree: Any) -> Future:
+        """Reduce-scatter sibling of :meth:`allreduce`: average a
+        gradient pytree across participating groups but resolve to only
+        this rank's canonical stripe of it, as a :class:`ShardedGrads`
+        (per-chunk 1-D host arrays +the geometry the sharded optimizer
+        needs to extract matching param stripes and reassemble after the
+        update's allgather).
+
+        Same protocol discipline as :meth:`allreduce`: joins the quorum,
+        healers/spares contribute zeros, 1/n tracks membership, errors
+        swallow into a zero-stripe default and latch for the commit
+        vote. Concat of every rank's stripes is bitwise identical to the
+        :meth:`allreduce` result (the transport reuses the ring's own
+        fold — ``Communicator.reduce_scatter_wire``). Fast paths that
+        need no stripe geometry (single-group step, on-device backends,
+        empty trees) resolve to the PLAIN averaged tree instead —
+        :meth:`FTOptimizer.apply <torchft_tpu.optim.FTOptimizer.apply>`
+        dispatches on the result type."""
+        if self._errored is not None:
+            return _instant(tree)
+        try:
+            assert self._quorum_future is not None, "call step() first"
+            self._quorum_future.result()
+            if self.single_group_step():
+                return _instant(tree)
+            leaves, treedef = jax.tree_util.tree_flatten(tree)
+            if not leaves:
+                return _instant(tree)
+            if self._comm.wants_device_arrays:
+                # On-device backends keep the full allreduce (no host
+                # stripe geometry to share); the optimizer's plain-tree
+                # path handles the result.
+                return self.allreduce(tree)
+            return self._host_reduce_scatter_pipelined(
+                tree, leaves, treedef)
+        except Exception as e:  # noqa: BLE001
+            logger.exception("reduce_scatter failed")
+            self.report_error(e)
+            return _instant(tree)
+
+    def _host_reduce_scatter_pipelined(self, tree: Any, leaves: list,
+                                       treedef: Any) -> Future:
+        """The host allreduce pipeline with the ring leg swapped for
+        ``Communicator.reduce_scatter_wire``: stages 1-2 (pack dispatch +
+        async D2H, fetch-wait) are shared verbatim, the comm worker
+        reduce-scatters each chunk, and the put stage shrinks to a host
+        1/n of the local stripe (~1/world of the allreduce's put bytes —
+        there is no full-tree result to place; the updated params come
+        back via the optimizer's allgather instead)."""
+        n = max(self.num_participants(), 1)
+        participating = self.is_participating()
+        world = max(self._comm.size(), 1)
+        rank = self._comm.rank()
+        ar_t0 = time.perf_counter()
+        sched = self._get_schedule(treedef, leaves)
+        all_chunks = [c for cs in sched.chunks for c in cs]
+        agg: Future = Future()
+        out_shards: list = [None] * len(all_chunks)
+        lock = threading.Lock()
+        pending = [len(sched.chunks)]
+
+        def settle_exception(e: BaseException) -> None:
+            try:
+                agg.set_exception(e)
+            except BaseException:  # already settled by another thread
+                pass
+
+        def on_bucket(base: int, chunks: list, submit_t: float
+                      ) -> Callable[[Future], None]:
+            def cb(f: Future) -> None:
+                self._record(allreduce_ring_ms_total=(
+                    time.perf_counter() - submit_t) * 1e3)
+                e = f.exception()
+                if e is not None:
+                    settle_exception(e)
+                    return
+                try:
+                    put_t0 = time.perf_counter()
+                    shards = [div_by_count(np.asarray(s), n)
+                              for s in f.result()]
+                    self._record(allreduce_put_ms_total=(
+                        time.perf_counter() - put_t0) * 1e3)
+                    with lock:
+                        for j, s in enumerate(shards):
+                            out_shards[base + j] = s
+                        pending[0] -= 1
+                        done = pending[0] == 0
+                    if done:
+                        self._record(
+                            allreduce_count=1, reduce_scatter_count=1,
+                            allreduce_ms_total=(
+                                time.perf_counter() - ar_t0) * 1e3)
+                        sg = ShardedGrads(all_chunks, out_shards, rank,
+                                          world, leaves, treedef)
+                        try:
+                            agg.set_result(sg)
+                        except BaseException:  # an error settled it first
+                            pass
+                except Exception as e2:  # noqa: BLE001
+                    settle_exception(e2)
+            return cb
+
+        n_buckets = len(sched.chunks)
+        window = _stage_ahead_window()
+        staged: list = [None] * n_buckets
+        next_to_stage = 0
+
+        def stage_through(hi: int) -> None:
+            nonlocal next_to_stage
+            while next_to_stage < min(hi, n_buckets):
+                staged[next_to_stage] = self._stage_bucket(
+                    sched.chunks[next_to_stage], leaves)
+                next_to_stage += 1
+
+        base = 0
+        for b, chunks in enumerate(sched.chunks):
+            if participating:
+                stage_through(n_buckets if window is None
+                              else b + 1 + window)
+                bufs = self._wait_bucket(staged[b], leaves)
+                staged[b] = None
+            else:
+                bufs = [np.zeros(c.total, c.wire) for c in chunks]
+            self._comm.reduce_scatter_wire(
+                bufs, [str(c.orig) for c in chunks], op="sum"
+            ).add_done_callback(
+                on_bucket(base, chunks, time.perf_counter()))
+            base += len(chunks)
+
+        # Error default: zero stripes with the real geometry — the
+        # latched error means the values are never applied (the vote
+        # aborts), but the STRUCTURE must survive so every rank keeps an
+        # identical step shape.
+        def zero_default() -> "ShardedGrads":
+            zs = []
+            for c in all_chunks:
+                bd = shard_bounds(c.total, world)
+                zs.append(np.zeros(int(bd[rank + 1] - bd[rank]), c.orig))
+            return ShardedGrads(all_chunks, zs, rank, world, leaves,
+                                treedef)
+
+        # Lazy: the zero stripes (~payload/world of fresh allocation)
+        # are only materialized if the reduce-scatter actually fails.
+        return self.wrap_future(agg, default_fn=zero_default)
+
+    def allgather_shards(self, shards: list) -> Future:
+        """Error-swallowed allgather of this rank's updated param
+        stripes (the sharded update's reassembly leg): resolves to a
+        list of every ring rank's stripe list, in rank order. On failure
+        the error latches (the vote aborts) and the fallback replicates
+        the local stripes — structure only, values discarded."""
+        world = max(self._comm.size(), 1)
+        try:
+            fut = self._comm.allgather(shards)
+        except Exception as e:  # noqa: BLE001
+            self.report_error(e)
+            return _instant([shards] * world)
+        return self.wrap_future(fut, default=[shards] * world)
+
+    def prepare_commit(self) -> None:
+        """Drain this step's in-flight work and apply a staged heal
+        restore — the pre-vote half of :meth:`should_commit`, exposed so
+        the sharded update can compute its stripe AFTER a heal restore
+        lands but BEFORE the vote (the published stripe must come from
+        restored params; the vote must still cover the allgather that
+        follows). Idempotent; :meth:`should_commit` re-runs it as a
+        no-op."""
+        if self._quorum_future is not None:
+            self.wait_quorum()
+        for work in self._pending_work:
+            work.result()  # errors already swallowed into defaults
+        self._pending_work = []
+        if self._healing and self._pending_state_dict is not None:
+            self._apply_pending_state_dict()
+
+    def record_update(self, ms: float, shard_state_bytes: float,
+                      resets: int = 0) -> None:
+        """Optimizer-side stripe-update accounting
+        (:class:`~torchft_tpu.optim.FTOptimizer`): wall ms of the
+        pack+update+allgather+reassemble stage, the live stripe
+        optimizer-state footprint (gauge), and geometry-forced state
+        resets."""
+        self._record(update_count=1, update_ms_total=ms,
+                     shard_state_resets=resets)
+        with self._metrics_lock:
+            self._metrics["shard_state_bytes"] = float(shard_state_bytes)
+
     def wait_quorum(self) -> None:
         """Join this step's quorum round; a quorum failure latches via
         :meth:`report_error` instead of raising (same swallow-into-the-vote
@@ -1220,11 +1566,16 @@ class Manager:
             and self.is_participating()
         )
 
-    def wrap_future(self, fut: Future, default: Any) -> Future:
+    def wrap_future(self, fut: Future, default: Any = None,
+                    default_fn: Optional[Callable[[], Any]] = None
+                    ) -> Future:
         """Error-swallow ``fut`` into ``default`` + latch via
         :meth:`report_error`; track it for the commit drain (reference
         ``manager.py:271-299``). Maintains the ``allreduce_inflight``
-        gauge: +1 while the wrapped work is outstanding."""
+        gauge: +1 while the wrapped work is outstanding. Pass
+        ``default_fn`` instead of ``default`` when building the fallback
+        is expensive (e.g. zero stripes sized like the payload): it runs
+        only on the error path, never per successful step."""
         out: Future = Future()
         self._record(allreduce_inflight=1)
 
@@ -1235,7 +1586,8 @@ class Manager:
                 out.set_result(f.result())
             else:
                 self.report_error(e)
-                out.set_result(default)
+                out.set_result(default_fn() if default_fn is not None
+                               else default)
 
         fut.add_done_callback(relay)
         self._pending_work.append(out)
@@ -1326,17 +1678,12 @@ class Manager:
         thread, then votes: the step commits iff *every* rank of *every*
         participating group succeeded and the quorum was large enough.
         """
-        # The quorum must have resolved before we can vote (or heal): join it
-        # here even if the caller never issued a collective this step.
-        if self._quorum_future is not None:
-            self.wait_quorum()
-
-        for work in self._pending_work:
-            work.result()  # errors already swallowed into defaults
-        self._pending_work = []
-
-        if self._healing and self._pending_state_dict is not None:
-            self._apply_pending_state_dict()
+        # The quorum must have resolved before we can vote (or heal): join
+        # it here even if the caller never issued a collective this step.
+        # (prepare_commit: drain + staged-heal apply; a sharded update
+        # already ran it before its allgather, in which case this re-run
+        # only drains the allgather it tracked.)
+        self.prepare_commit()
 
         enough = self._participating_world_size >= self._min_replica_size
         local_ok = self._errored is None and enough
@@ -1942,6 +2289,122 @@ def _unpack_scale(chunk: _ChunkPlan) -> Any:
 
         fn = _UNPACK_FNS[key] = jax.jit(unpack)
     return fn
+
+
+class ShardedGrads:
+    """This rank's canonical stripe of an averaged gradient pytree, plus
+    the geometry the sharded optimizer needs (docs/design/
+    sharded_update.md): ``chunks`` are the schedule's :class:`_ChunkPlan`
+    objects in deterministic order, ``shards[k]`` the 1/n-scaled 1-D
+    host array of chunk k's stripe ``[bounds[rank], bounds[rank+1])``
+    (:func:`~torchft_tpu.communicator.shard_bounds` over the ring
+    world). ``leaves`` are the ORIGINAL grad leaves — placement
+    templates for reassembled params (sharding/device), never read for
+    values. Produced by :meth:`Manager.reduce_scatter`, consumed by
+    :meth:`FTOptimizer.apply <torchft_tpu.optim.FTOptimizer.apply>`."""
+
+    __slots__ = ("chunks", "shards", "rank", "world", "leaves", "treedef")
+
+    def __init__(self, chunks: list, shards: list, rank: int, world: int,
+                 leaves: list, treedef: Any) -> None:
+        self.chunks = chunks
+        self.shards = shards
+        self.rank = rank
+        self.world = world
+        self.leaves = leaves
+        self.treedef = treedef
+
+    def geometry_key(self) -> tuple:
+        """Stripe-geometry fingerprint: the sharded optimizer's state is
+        valid only while this is unchanged (a membership change moves
+        every rank's stripe, so every rank resets together — params stay
+        lockstep, only momentum restarts)."""
+        return (self.world, self.rank,
+                tuple(int(np.size(s)) for s in self.shards),
+                tuple(str(c.orig) for c in self.chunks))
+
+    def param_shards(self, params: Any) -> list:
+        """Extract this rank's stripe of ``params``, chunk-aligned with
+        :attr:`shards` (same flat order + bounds), as 1-D host arrays."""
+        pleaves = jax.tree_util.tree_leaves(params)
+        if len(pleaves) != len(self.leaves):
+            raise ValueError(
+                f"params have {len(pleaves)} leaves, grads had "
+                f"{len(self.leaves)} — sharded update needs matching "
+                "structures")
+        out = []
+        for c in self.chunks:
+            bd = shard_bounds(c.total, self.world)
+            lo, hi = int(bd[self.rank]), int(bd[self.rank + 1])
+            pieces = []
+            off = 0
+            for i, size in zip(c.idx, c.sizes):
+                a, b = max(lo, off), min(hi, off + size)
+                if a < b:
+                    leaf = pleaves[i]
+                    if isinstance(leaf, jax.Array):
+                        # Slice on device: only this rank's 1/world of
+                        # the leaf's bytes crosses D2H, not the whole
+                        # leaf — the sharded update's memory/transfer
+                        # win must hold on the params side too.
+                        pieces.append(np.asarray(
+                            jnp.ravel(leaf)[a - off:b - off]))
+                    else:
+                        flat = np.ravel(np.asarray(leaf))
+                        pieces.append(flat[a - off:b - off])
+                off += size
+            out.append(
+                np.concatenate(pieces).astype(c.orig, copy=False)
+                if pieces else np.empty(0, c.orig))
+        return out
+
+    def assemble_params(self, gathered: list, params: Any) -> Any:
+        """Reassemble full params from every rank's updated stripes
+        (``gathered[r][k]`` = rank r's stripe of chunk k, from
+        :meth:`Manager.allgather_shards`), placing device leaves back on
+        their original shardings. Every rank runs this on identical
+        gathered bytes, so params stay bitwise lockstep."""
+        pleaves, treedef = jax.tree_util.tree_flatten(params)
+        out_leaves = list(pleaves)
+        put_idx: list = []
+        put_vals: list = []
+        for k, c in enumerate(self.chunks):
+            full = np.empty(c.total, c.orig)
+            bd = shard_bounds(c.total, self.world)
+            for r in range(self.world):
+                seg = np.ravel(np.asarray(gathered[r][k])).astype(
+                    c.orig, copy=False)
+                want = int(bd[r + 1] - bd[r])
+                if seg.size != want:
+                    raise ValueError(
+                        f"rank {r} published a {seg.size}-elem stripe "
+                        f"for chunk {k}; geometry expects {want} — "
+                        "mismatched shard_update config across groups?")
+                full[bd[r]:bd[r + 1]] = seg
+            parts = np.split(full, np.cumsum(c.sizes)[:-1])
+            for i, shape, part in zip(c.idx, c.shapes, parts):
+                val = part.reshape(shape)
+                if isinstance(pleaves[i], jax.Array):
+                    put_idx.append(i)
+                    put_vals.append(val)
+                else:
+                    out_leaves[i] = val
+        if put_idx:
+            placed = jax.device_put(
+                put_vals, [pleaves[i].sharding for i in put_idx])
+            for i, a in zip(put_idx, placed):
+                out_leaves[i] = a
+        return jax.tree_util.tree_unflatten(treedef, out_leaves)
+
+
+def _stripe_seed(replica_id: str) -> int:
+    """Deterministic per-healer stripe-shuffle seed: replica ids carry a
+    per-process uuid suffix, so concurrent healers derive different donor
+    orders and spread their first-stream load across the donor set
+    instead of all hammering donors[0]."""
+    import zlib as _zlib
+
+    return _zlib.crc32(replica_id.encode())
 
 
 def _zero_like(leaf: Any) -> np.ndarray:

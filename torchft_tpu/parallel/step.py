@@ -112,20 +112,13 @@ class FTTrainer:
         self.params = jax.tree_util.tree_map(jnp.copy, params)
         self.model_state = model_state
         self._has_state = model_state is not None
-        self.opt_state = tx.init(params)
-        if param_shardings is not None:
-            # Zeros-like moments inherit the params' shardings, but leaves
-            # optax creates from scratch (adam's step counter) land
-            # uncommitted on the default device. jit tolerates the mix only
-            # while they stay uncommitted; healing commits restored leaves
-            # onto the CURRENT placement (serialization.device_put_like),
-            # which would pin them to one device and crash the next update
-            # with a mixed device set. Keep every leaf on the params' mesh
-            # from the start.
-            self.opt_state = _on_mesh(self.opt_state, param_shardings)
-            if self._has_state:
-                self.model_state = _on_mesh(self.model_state,
-                                            param_shardings)
+        # Placeholder until the manager exists: in ZeRO shard mode
+        # (Manager(shard_update=True)) the FULL optimizer state is never
+        # materialized — FTOptimizer owns only this rank's stripe — so
+        # tx.init must wait for the mode to be known.
+        self.opt_state: Any = None
+        if param_shardings is not None and self._has_state:
+            self.model_state = _on_mesh(self.model_state, param_shardings)
         self._batch_sharding = batch_sharding
         self._strict_commit = strict_commit
 
@@ -170,6 +163,25 @@ class FTTrainer:
         # the Manager's own getattr-guarded comm hooks.
         ov = getattr(self.manager, "overlap_steps", None)
         self._overlap = callable(ov) and ov() == 1
+        # ZeRO sharded-update opt-in (docs/design/sharded_update.md),
+        # same duck-typing tolerance as overlap_steps: the trainer swaps
+        # manager.allreduce for manager.reduce_scatter and leaves
+        # opt_state unmaterialized (FTOptimizer holds the stripe state).
+        sh = getattr(self.manager, "shard_update", None)
+        self._shard = callable(sh) and sh() is True
+        if not self._shard:
+            self.opt_state = tx.init(params)
+            if param_shardings is not None:
+                # Zeros-like moments inherit the params' shardings, but
+                # leaves optax creates from scratch (adam's step counter)
+                # land uncommitted on the default device. jit tolerates
+                # the mix only while they stay uncommitted; healing
+                # commits restored leaves onto the CURRENT placement
+                # (serialization.device_put_like), which would pin them
+                # to one device and crash the next update with a mixed
+                # device set. Keep every leaf on the params' mesh from
+                # the start.
+                self.opt_state = _on_mesh(self.opt_state, param_shardings)
         self._opt = (DelayedOptimizer(self.manager, tx, jit=jit_fwd)
                      if self._overlap
                      else FTOptimizer(self.manager, tx, jit=jit_fwd))
@@ -241,10 +253,14 @@ class FTTrainer:
         pre_dispatch = 0.0  # discarded speculative (fused) dispatch wall
         if self._predict_single is None:
             # First step: learn the shape before compiling anything.
+            # Shard mode never takes the fused path — its optimizer
+            # state lives stripe-wise in FTOptimizer, not in
+            # self.opt_state, which the fused program would read.
             wq_t0 = time.perf_counter()
             self.manager.wait_quorum()
             pre_wait = time.perf_counter() - wq_t0
-            self._predict_single = self.manager.single_group_step()
+            self._predict_single = (not self._shard
+                                    and self.manager.single_group_step())
 
         if self._predict_single:
             # Fused speculative step dispatched immediately (overlaps the
@@ -285,10 +301,12 @@ class FTTrainer:
         loss, new_state, grads = self._fwd_bwd(
             self.params, self.model_state, batch)
         t2 = time.perf_counter()
-        avg = self.manager.allreduce(grads).result()
+        avg = (self.manager.reduce_scatter(grads) if self._shard
+               else self.manager.allreduce(grads)).result()
         t3 = time.perf_counter()
         loss = self._strict_sync(loss)
-        self._predict_single = self.manager.single_group_step()
+        self._predict_single = (not self._shard
+                                and self.manager.single_group_step())
         # The vote inside apply() may restore healed state into this trainer
         # before the update reads it — hence the holder indirection.
         committed = self._opt.apply(self, avg)
@@ -381,7 +399,8 @@ class FTTrainer:
         t3 = time.perf_counter()
 
         loss = self._strict_sync(loss)
-        fut = self.manager.allreduce(grads)
+        fut = (self.manager.reduce_scatter(grads) if self._shard
+               else self.manager.allreduce(grads))
         on_commit = None
         if self._has_state:
             ns = new_state

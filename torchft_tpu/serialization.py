@@ -200,6 +200,30 @@ def plan_pytree(tree: Any) -> PytreePlan:
 DEFAULT_BATCH_BYTES = 64 * 1024 * 1024
 
 
+def balanced_ranges(sizes: list, n: int) -> list:
+    """Contiguous byte-balanced ``[start, stop)`` index ranges, one per
+    group (possibly empty), partitioning ``range(len(sizes))``. The one
+    stripe partitioner shared by the sharded checkpoint writer
+    (``checkpoint_io.save_sharded``) and the striped-heal fetch planner
+    (``checkpointing._HealSession.stripes``) — their geometries must not
+    drift apart."""
+    total = float(sum(sizes)) or 1.0
+    ranges = []
+    start = 0
+    acc = 0.0
+    g = 0
+    for i, sz in enumerate(sizes):
+        acc += sz
+        while g < n - 1 and acc >= total * (g + 1) / n:
+            ranges.append((start, i + 1))
+            start = i + 1
+            g += 1
+    while len(ranges) < n:
+        ranges.append((start, len(sizes)))
+        start = len(sizes)
+    return ranges
+
+
 def _leaf_nbytes(leaf: Any) -> int:
     return int(np.prod(leaf.shape, dtype=np.int64)
                ) * np.dtype(leaf.dtype).itemsize
