@@ -374,6 +374,15 @@ def bench_multigroup(n_groups: int = 2, steps: int = 20,
                 else sum(
                     np.asarray(l).nbytes for l in
                     jax.tree_util.tree_leaves(trainer.opt_state)) / 1e6),
+            # Control-plane attribution (docs/design/control_plane.md):
+            # quorum latency distribution + the fraction of rounds served
+            # from the lighthouse's membership-unchanged cache.
+            "quorum_ms_p50": mx["quorum_ms_p50"],
+            "quorum_ms_p95": mx["quorum_ms_p95"],
+            "quorum_fast_frac": (
+                mx["quorum_fast_path_hits"]
+                / max(mx["quorum_fast_path_hits"]
+                      + mx["quorum_slow_path_rounds"], 1)),
         }
         trainer.shutdown()
 
@@ -408,6 +417,9 @@ def bench_multigroup(n_groups: int = 2, steps: int = 20,
         "commit_ms_avg": med["commit_ms_avg"],
         "update_ms_avg": med["update_ms_avg"],
         "opt_state_mbytes": med["opt_state_mbytes"],
+        "quorum_ms_p50": med["quorum_ms_p50"],
+        "quorum_ms_p95": med["quorum_ms_p95"],
+        "quorum_fast_frac": med["quorum_fast_frac"],
     }
 
 
@@ -939,6 +951,199 @@ def bench_heal_striped(payload_mb: float = 48.0, donors: int = 3,
     return out
 
 
+# --------------------------------------------------------------- scenario 6
+
+def _native_control_plane_available() -> bool:
+    """Probe for the C++ control-plane library (mirrors tests/conftest.py's
+    native_available): the quorum benches are thin ctypes loops and skip
+    cleanly when the toolchain is absent."""
+    try:
+        from torchft_tpu import _native
+
+        _native.lib()
+        return True
+    except Exception:  # noqa: BLE001
+        return False
+
+
+def bench_quorum_latency_vs_n(n: int = 64, steps: int = 30,
+                              fast_path: bool = True,
+                              arrival_jitter_ms: float = 2.0,
+                              seed: int = 7) -> Dict[str, Any]:
+    """Quorum latency at N simulated replica groups on ONE host
+    (docs/design/control_plane.md): each group is a world-size-1 C++
+    ManagerServer plus a thin ctypes ManagerClient thread (no JAX, no
+    collectives) doing one quorum round per step behind a barrier, with a
+    seeded per-step arrival jitter modeling compute imbalance — the thing
+    that makes a fan-in rendezvous slow, because every group waits for the
+    last arrival. The membership-unchanged fast path serves each request
+    from the cached decision instead, so its latency is one RTT regardless
+    of the stragglers. Reports steady-state p50/p95/max per-request quorum
+    latency (first 2 warmup rounds dropped) plus the lighthouse's
+    fast/slow serve counters."""
+    from torchft_tpu import _native
+    from torchft_tpu.retry import RetryPolicy
+
+    lh = _native.Lighthouse(
+        bind="127.0.0.1:0", min_replicas=n, join_timeout_ms=60_000,
+        quorum_tick_ms=5, heartbeat_fresh_ms=500,
+        eviction_staleness_factor=6, fast_path=fast_path)
+    managers: list = []
+    try:
+        managers = [
+            _native.ManagerServer(f"g{i:03d}", lh.address(),
+                                  store_addr=f"store{i}",
+                                  bind="127.0.0.1:0", world_size=1,
+                                  heartbeat_ms=100)
+            for i in range(n)
+        ]
+        rng = np.random.default_rng(seed)
+        jitter = rng.uniform(0.0, arrival_jitter_ms * 1e-3, size=(steps, n))
+        barrier = threading.Barrier(n)
+        lat: list = [[] for _ in range(n)]
+        errs: list = []
+
+        def worker(i: int) -> None:
+            try:
+                c = _native.ManagerClient(
+                    managers[i].address(), connect_timeout_ms=10_000,
+                    retry_policy=RetryPolicy(max_attempts=1))
+                for s in range(1, steps + 1):
+                    barrier.wait()
+                    time.sleep(jitter[s - 1, i])
+                    t0 = time.perf_counter()
+                    c.quorum(rank=0, step=s,
+                             checkpoint_server_addr=f"ckpt{i}",
+                             timeout_ms=120_000)
+                    lat[i].append((time.perf_counter() - t0) * 1e3)
+            except Exception as e:  # noqa: BLE001 — surface in the result
+                errs.append(repr(e))
+                try:
+                    barrier.abort()
+                except Exception:  # noqa: BLE001
+                    pass
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=600)
+        if errs:
+            raise RuntimeError(f"quorum bench worker failed: {errs[0]}")
+        status = lh.status()
+        flat = sorted(ms for per in lat for ms in per[2:])
+        return {
+            "n": n, "steps": steps, "fast_path": fast_path,
+            "arrival_jitter_ms": arrival_jitter_ms,
+            "p50_ms": flat[len(flat) // 2],
+            "p95_ms": flat[min(len(flat) - 1, int(len(flat) * 0.95))],
+            "max_ms": flat[-1],
+            "fast_path_hits": status.get("fast_path_hits", 0),
+            "slow_path_served": status.get("slow_path_served", 0),
+        }
+    finally:
+        for m in managers:
+            m.shutdown()
+        lh.shutdown()
+
+
+def bench_quorum_failover(n: int = 8, steps: int = 40, kill_at: int = 20,
+                          arrival_jitter_ms: float = 1.0,
+                          seed: int = 13) -> Dict[str, Any]:
+    """Warm-standby failover timeline: N manager groups run quorum rounds
+    against a primary+standby lighthouse pair (managers configured with the
+    candidate list); the primary dies at step ``kill_at``. Emits the
+    per-step max quorum latency (the failover spike is the interesting
+    shape), total manager re-dials, and whether the quorum_id survived the
+    failover unchanged — the no-ring-rebuild contract."""
+    from torchft_tpu import _native
+    from torchft_tpu.retry import RetryPolicy
+
+    primary = _native.Lighthouse(
+        bind="127.0.0.1:0", min_replicas=n, join_timeout_ms=60_000,
+        quorum_tick_ms=5, heartbeat_fresh_ms=500,
+        eviction_staleness_factor=6)
+    standby = _native.Lighthouse(
+        bind="127.0.0.1:0", min_replicas=n, join_timeout_ms=60_000,
+        quorum_tick_ms=5, heartbeat_fresh_ms=500,
+        eviction_staleness_factor=6,
+        standby_of=primary.address(), replicate_ms=25)
+    managers: list = []
+    primary_dead = False
+    try:
+        addrs = f"{primary.address()},{standby.address()}"
+        managers = [
+            _native.ManagerServer(f"g{i:03d}", addrs,
+                                  store_addr=f"store{i}",
+                                  bind="127.0.0.1:0", world_size=1,
+                                  heartbeat_ms=100)
+            for i in range(n)
+        ]
+        rng = np.random.default_rng(seed)
+        jitter = rng.uniform(0.0, arrival_jitter_ms * 1e-3, size=(steps, n))
+        barrier = threading.Barrier(n + 1)  # workers + the kill controller
+        lat = np.zeros((steps, n))
+        qids = np.zeros((steps, n), dtype=np.int64)
+        errs: list = []
+
+        def worker(i: int) -> None:
+            try:
+                c = _native.ManagerClient(
+                    managers[i].address(), connect_timeout_ms=10_000,
+                    retry_policy=RetryPolicy(max_attempts=1))
+                for s in range(1, steps + 1):
+                    barrier.wait()
+                    time.sleep(jitter[s - 1, i])
+                    t0 = time.perf_counter()
+                    q = c.quorum(rank=0, step=s,
+                                 checkpoint_server_addr=f"ckpt{i}",
+                                 timeout_ms=120_000)
+                    lat[s - 1, i] = (time.perf_counter() - t0) * 1e3
+                    qids[s - 1, i] = q.quorum_id
+            except Exception as e:  # noqa: BLE001
+                errs.append(repr(e))
+                try:
+                    barrier.abort()
+                except Exception:  # noqa: BLE001
+                    pass
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(n)]
+        for t in threads:
+            t.start()
+        try:
+            for s in range(1, steps + 1):
+                barrier.wait()
+                if s == kill_at:
+                    primary.shutdown()  # in-process stand-in for SIGKILL
+                    primary_dead = True
+        except threading.BrokenBarrierError:
+            pass  # a worker aborted; its error is in errs
+        for t in threads:
+            t.join(timeout=600)
+        if errs:
+            raise RuntimeError(f"failover bench worker failed: {errs[0]}")
+        per_step_max = lat.max(axis=1)
+        redials = sum(m.lighthouse_redials() for m in managers)
+        return {
+            "n": n, "steps": steps, "kill_at": kill_at,
+            "pre_kill_p50_ms": float(np.median(per_step_max[2:kill_at - 1])),
+            "failover_spike_ms": float(per_step_max[kill_at - 1:].max()),
+            "post_kill_p50_ms": float(np.median(per_step_max[kill_at + 2:])),
+            "per_step_max_ms": [round(float(v), 2) for v in per_step_max],
+            "redials_total": int(redials),
+            "quorum_id_stable_across_failover":
+                bool((qids == qids[0, 0]).all()),
+        }
+    finally:
+        for m in managers:
+            m.shutdown()
+        if not primary_dead:
+            primary.shutdown()
+        standby.shutdown()
+
+
 # --------------------------------------------------------------------- main
 
 def main() -> None:
@@ -975,6 +1180,9 @@ def main() -> None:
            "n_groups": mg["n_groups"], "backend": "host",
            "allreduce_ms_avg": round(mg["allreduce_ms_avg"], 2),
            "grad_mbytes": round(mg["grad_mbytes"], 2),
+           "quorum_ms_p50": round(mg["quorum_ms_p50"], 2),
+           "quorum_ms_p95": round(mg["quorum_ms_p95"], 2),
+           "quorum_fast_frac": round(mg["quorum_fast_frac"], 3),
            "stages_ms": stages(mg)})
 
     mw = bench_multigroup(wire_dtype=jnp.bfloat16)
@@ -1080,6 +1288,41 @@ def main() -> None:
            "striped_mb_s": round(hs["striped_mb_s"], 1),
            "striped_speedup": round(hs["striped_speedup"], 2),
            "donors_used": hs.get("donors_used")})
+
+    # Control-plane scale (docs/design/control_plane.md): quorum latency
+    # vs N simulated manager groups with the membership-unchanged fast
+    # path on/off, and the warm-standby failover timeline. Thin ctypes
+    # loops against the C++ lighthouse — cleanly skipped when the native
+    # toolchain is absent.
+    if _native_control_plane_available():
+        for nq in (4, 16, 64):
+            legs = {}
+            for fp in (True, False):
+                legs[fp] = bench_quorum_latency_vs_n(n=nq, fast_path=fp)
+            _emit({"metric": "quorum_latency_vs_n", "n": nq,
+                   "fast_p50_ms": round(legs[True]["p50_ms"], 3),
+                   "fast_p95_ms": round(legs[True]["p95_ms"], 3),
+                   "slow_p50_ms": round(legs[False]["p50_ms"], 3),
+                   "slow_p95_ms": round(legs[False]["p95_ms"], 3),
+                   "fast_path_speedup_p50": round(
+                       legs[False]["p50_ms"]
+                       / max(legs[True]["p50_ms"], 1e-9), 2),
+                   "arrival_jitter_ms": legs[True]["arrival_jitter_ms"],
+                   "fast_path_hits": legs[True]["fast_path_hits"]})
+        fo = bench_quorum_failover()
+        _emit({"metric": "quorum_standby_failover", "n": fo["n"],
+               "kill_at": fo["kill_at"],
+               "pre_kill_p50_ms": round(fo["pre_kill_p50_ms"], 2),
+               "failover_spike_ms": round(fo["failover_spike_ms"], 1),
+               "post_kill_p50_ms": round(fo["post_kill_p50_ms"], 2),
+               "redials_total": fo["redials_total"],
+               "quorum_id_stable_across_failover":
+                   fo["quorum_id_stable_across_failover"],
+               "per_step_max_ms": fo["per_step_max_ms"]})
+    else:
+        _emit({"metric": "quorum_latency_vs_n",
+               "error": "native control plane unavailable "
+                        "(no C++ toolchain)"})
 
     mm = bench_multigroup(backend="mesh")
     _emit({"metric": "multigroup_mesh_steps_per_s",

@@ -81,6 +81,22 @@ if [[ "${1:-}" == "shard" ]]; then
     exit 0
 fi
 
+# Control-plane tier: the quorum fast path / coalesced heartbeats /
+# warm-standby failover gate (docs/design/control_plane.md) — manager-side
+# fast/slow round accounting + latency reservoir (no native needed), the
+# piggybacked-beat freshness and fast-path hit/epoch protocol tests, and
+# the standby SIGKILL failover acceptance (bitwise params, frozen
+# reconfigure_count, observable redials). The C++ invalidation matrix runs
+# in the `core` tier (core_test.cc). The SIGSTOP black-hole chaos round
+# and the 64-client latency A/B are nightly+slow and ride the nightly
+# tier; run this tier on lighthouse/manager/rpc changes.
+if [[ "${1:-}" == "control-plane" ]]; then
+    stage control-plane env JAX_PLATFORMS=cpu \
+        python -m pytest tests/test_control_plane.py -q -m control_plane
+    echo "== total: ${SECONDS}s"
+    exit 0
+fi
+
 # Cold-start tier: seeded kill-all → cold-restart soak — every round a
 # 2-group job checkpoints under disk chaos (torn writes, silent
 # bit-flips, ENOSPC), the whole fleet "dies", and recovery must come

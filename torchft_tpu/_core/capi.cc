@@ -47,7 +47,9 @@ void* tft_lighthouse_new(const char* bind, uint64_t min_replicas,
                          int64_t heartbeat_fresh_ms,
                          int64_t heartbeat_grace_factor,
                          int64_t eviction_staleness_factor,
-                         const char* auth_token, char** err) {
+                         const char* auth_token, int32_t fast_path,
+                         const char* standby_of, int64_t replicate_ms,
+                         char** err) {
   try {
     LighthouseOpt opt;
     opt.bind = bind;
@@ -58,6 +60,9 @@ void* tft_lighthouse_new(const char* bind, uint64_t min_replicas,
     opt.heartbeat_grace_factor = heartbeat_grace_factor;
     opt.eviction_staleness_factor = eviction_staleness_factor;
     opt.auth_token = auth_token ? auth_token : "";
+    opt.fast_path = fast_path != 0;
+    opt.standby_of = standby_of ? standby_of : "";
+    opt.replicate_ms = replicate_ms;
     return new Lighthouse(opt);
   } catch (const std::exception& e) {
     fail(err, e.what());
@@ -104,6 +109,14 @@ void tft_manager_set_status(void* h, const char* metrics_json,
                             int64_t aborted_steps) {
   ((ManagerServer*)h)->set_status(metrics_json, heal_count, committed_steps,
                                   aborted_steps);
+}
+
+int64_t tft_manager_lighthouse_redials(void* h) {
+  return ((ManagerServer*)h)->lighthouse_redials();
+}
+
+char* tft_manager_lighthouse_addr(void* h) {
+  return dup_str(((ManagerServer*)h)->lighthouse_addr());
 }
 
 void tft_manager_shutdown(void* h) { ((ManagerServer*)h)->shutdown(); }
@@ -177,6 +190,8 @@ struct TftQuorumResult {
   int64_t replica_rank;
   int64_t replica_world_size;
   int32_t heal;
+  int32_t fast_path;
+  int64_t epoch;
 };
 
 void* tft_manager_client_new(const char* addr, int64_t connect_timeout_ms,
@@ -215,6 +230,8 @@ int tft_manager_client_quorum(void* h, int64_t rank, int64_t step,
   out->replica_rank = r.replica_rank();
   out->replica_world_size = r.replica_world_size();
   out->heal = r.heal();
+  out->fast_path = r.fast_path();
+  out->epoch = r.epoch();
   return 0;
 }
 

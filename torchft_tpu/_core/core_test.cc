@@ -11,7 +11,11 @@
 
 #include <cstdio>
 #include <atomic>
+#include <memory>
+#include <set>
+#include <string>
 #include <thread>
+#include <utility>
 #include <vector>
 
 #include "lighthouse.h"
@@ -599,6 +603,472 @@ static void test_shutdown_while_parked() {
   printf("test_shutdown_while_parked ok (%lldms)\n", (long long)elapsed);
 }
 
+// ---------------------------------------------------------------------------
+// Membership-unchanged fast path + warm standby (docs/design/control_plane.md)
+// ---------------------------------------------------------------------------
+
+// A quorum join that piggybacks a beat, the way the manager server does
+// (raw beat-less joins above keep the reference grace/eviction timing and
+// never ride the fast path).
+static LighthouseQuorumResponse join_beat(const std::string& lh_addr,
+                                          const std::string& id,
+                                          int64_t step) {
+  RpcClient c(lh_addr, 2'000);
+  LighthouseQuorumRequest req;
+  *req.mutable_requester() = member(id, step);
+  auto* b = req.mutable_beat();
+  b->set_replica_id(id);
+  b->set_joining(true);
+  std::string resp, err;
+  assert(c.call(kLighthouseQuorum, req.SerializeAsString(), &resp, &err,
+                20'000));
+  LighthouseQuorumResponse r;
+  assert(r.ParseFromString(resp));
+  return r;
+}
+
+static void announce_beat(const std::string& lh_addr, const std::string& id,
+                          bool joining = true, bool leaving = false) {
+  RpcClient c(lh_addr, 2'000);
+  LighthouseHeartbeatRequest req;
+  req.set_replica_id(id);
+  req.set_joining(joining);
+  req.set_leaving(leaving);
+  std::string resp, err;
+  assert(c.call(kLighthouseHeartbeat, req.SerializeAsString(), &resp, &err,
+                2'000));
+}
+
+static std::set<std::string> ids_of(const Quorum& q) {
+  std::set<std::string> out;
+  for (const auto& m : q.participants()) out.insert(m.replica_id());
+  return out;
+}
+
+// Steady state: after one slow rendezvous, unchanged membership is served
+// from the cache — immediately (no tick park), same quorum_id, strictly
+// increasing epoch, fast_path flagged.
+static void test_fast_path_steady_state() {
+  LighthouseOpt lopt;
+  lopt.bind = "127.0.0.1:0";
+  lopt.min_replicas = 2;
+  lopt.join_timeout_ms = 200;
+  lopt.quorum_tick_ms = 10;
+  lopt.heartbeat_fresh_ms = 200;
+  lopt.eviction_staleness_factor = 3;  // fast-path staleness bound: 600ms
+  Lighthouse lh(lopt);
+
+  LighthouseQuorumResponse r1a, r1b;
+  std::thread t1([&] { r1a = join_beat(lh.address(), "a", 1); });
+  std::thread t2([&] { r1b = join_beat(lh.address(), "b", 1); });
+  t1.join();
+  t2.join();
+  assert(!r1a.fast_path() && !r1b.fast_path());
+  assert(r1a.quorum().quorum_id() == r1b.quorum().quorum_id());
+  assert(r1a.quorum().participants_size() == 2);
+
+  // Rounds 2..4: pure fast path, SEQUENTIAL requests (no fan-in barrier
+  // needed — that is the point), sub-join_timeout latency, stable id,
+  // monotonic epoch.
+  int64_t last_epoch_a = r1a.quorum().epoch();
+  int64_t last_epoch_b = r1b.quorum().epoch();
+  int64_t t0 = now_ms();
+  for (int64_t step = 2; step <= 4; step++) {
+    LighthouseQuorumResponse ra = join_beat(lh.address(), "a", step);
+    LighthouseQuorumResponse rb = join_beat(lh.address(), "b", step);
+    assert(ra.fast_path() && rb.fast_path());
+    assert(ra.quorum().quorum_id() == r1a.quorum().quorum_id());
+    assert(rb.quorum().quorum_id() == r1a.quorum().quorum_id());
+    assert(ra.quorum().participants_size() == 2);
+    assert(ra.quorum().epoch() > last_epoch_a);
+    assert(rb.quorum().epoch() > ra.quorum().epoch());
+    last_epoch_a = ra.quorum().epoch();
+    last_epoch_b = rb.quorum().epoch();
+    assert(ra.keepalive_ms() > 0);
+  }
+  (void)last_epoch_b;
+  // 6 serves, zero parks: far under one join_timeout.
+  assert(now_ms() - t0 < 150);
+  printf("test_fast_path_steady_state ok (%lldms for 3 fast rounds)\n",
+         (long long)(now_ms() - t0));
+}
+
+// Membership-delta class 1 (stale beat / crash): a member that stops
+// beating invalidates the cache once past the staleness bound; the next
+// request falls back to the slow path and evicts it (bumped id).
+static void test_fast_path_invalidation_stale_beat() {
+  LighthouseOpt lopt;
+  lopt.bind = "127.0.0.1:0";
+  lopt.min_replicas = 1;
+  lopt.join_timeout_ms = 200;
+  lopt.quorum_tick_ms = 10;
+  lopt.heartbeat_fresh_ms = 150;
+  lopt.eviction_staleness_factor = 2;  // bound: 300ms
+  Lighthouse lh(lopt);
+
+  LighthouseQuorumResponse r1a, r1b;
+  std::thread t1([&] { r1a = join_beat(lh.address(), "a", 1); });
+  std::thread t2([&] { r1b = join_beat(lh.address(), "b", 1); });
+  t1.join();
+  t2.join();
+  LighthouseQuorumResponse r2 = join_beat(lh.address(), "a", 2);
+  assert(r2.fast_path());  // b's beat still fresh
+
+  usleep(400'000);  // b crashed after round 2: beats now provably stale
+  LighthouseQuorumResponse r3 = join_beat(lh.address(), "a", 3);
+  assert(!r3.fast_path());  // cache invalidated, slow path ran
+  assert(r3.quorum().participants_size() == 1);
+  assert(r3.quorum().quorum_id() == r1a.quorum().quorum_id() + 1);
+  assert(r3.quorum().epoch() > r2.quorum().epoch());
+
+  // Solo membership re-arms the fast path.
+  LighthouseQuorumResponse r4 = join_beat(lh.address(), "a", 4);
+  assert(r4.fast_path());
+  assert(r4.quorum().quorum_id() == r3.quorum().quorum_id());
+  printf("test_fast_path_invalidation_stale_beat ok\n");
+}
+
+// Membership-delta class 2 (new joiner): a fresh joining announce from a
+// non-member pushes the NEXT step generation to the slow path, which admits
+// the joiner; the fast path then resumes over the grown membership.
+static void test_fast_path_invalidation_joiner() {
+  LighthouseOpt lopt;
+  lopt.bind = "127.0.0.1:0";
+  lopt.min_replicas = 1;
+  lopt.join_timeout_ms = 400;
+  lopt.quorum_tick_ms = 10;
+  lopt.heartbeat_fresh_ms = 300;
+  Lighthouse lh(lopt);
+
+  LighthouseQuorumResponse r1a, r1b;
+  std::thread t1([&] { r1a = join_beat(lh.address(), "a", 1); });
+  std::thread t2([&] { r1b = join_beat(lh.address(), "b", 1); });
+  t1.join();
+  t2.join();
+  assert(join_beat(lh.address(), "a", 2).fast_path());
+  assert(join_beat(lh.address(), "b", 2).fast_path());
+
+  announce_beat(lh.address(), "c");  // restarted/new group announces
+  LighthouseQuorumResponse r3a, r3b, r3c;
+  std::thread t3([&] { r3a = join_beat(lh.address(), "a", 3); });
+  std::thread t4([&] { r3b = join_beat(lh.address(), "b", 3); });
+  usleep(50'000);  // members parked on the slow path; now the joiner lands
+  r3c = join_beat(lh.address(), "c", 1);
+  t3.join();
+  t4.join();
+  assert(!r3a.fast_path() && !r3b.fast_path() && !r3c.fast_path());
+  assert(r3a.quorum().participants_size() == 3);
+  assert(r3c.quorum().participants_size() == 3);
+  assert(r3a.quorum().quorum_id() == r1a.quorum().quorum_id() + 1);
+
+  // Grown membership is the new cached decision.
+  LighthouseQuorumResponse r4 = join_beat(lh.address(), "c", 4);
+  assert(r4.fast_path());
+  assert(r4.quorum().participants_size() == 3);
+  printf("test_fast_path_invalidation_joiner ok\n");
+}
+
+// Membership-delta classes 3+4 (farewell/kill + min_replicas edge): a
+// leaving beat invalidates the cache instantly; with min_replicas=2 the
+// survivor PARKS (no solo quorum below the floor) until a replacement
+// announces and joins — then the round cuts with the new membership.
+static void test_fast_path_invalidation_farewell_min_replicas() {
+  LighthouseOpt lopt;
+  lopt.bind = "127.0.0.1:0";
+  lopt.min_replicas = 2;
+  lopt.join_timeout_ms = 10'000;  // must NOT gate: eviction/min-floor do
+  lopt.quorum_tick_ms = 10;
+  lopt.heartbeat_fresh_ms = 150;
+  lopt.eviction_staleness_factor = 2;
+  Lighthouse lh(lopt);
+
+  LighthouseQuorumResponse r1a, r1b;
+  std::thread t1([&] { r1a = join_beat(lh.address(), "a", 1); });
+  std::thread t2([&] { r1b = join_beat(lh.address(), "b", 1); });
+  t1.join();
+  t2.join();
+  assert(join_beat(lh.address(), "a", 2).fast_path());
+
+  announce_beat(lh.address(), "b", /*joining=*/false, /*leaving=*/true);
+  std::atomic<bool> a_done{false};
+  LighthouseQuorumResponse r3a;
+  int64_t t0 = now_ms();
+  std::thread t3([&] {
+    r3a = join_beat(lh.address(), "a", 3);
+    a_done = true;
+  });
+  usleep(300'000);
+  // Farewell'd b killed the cache, and min_replicas=2 blocks a solo cut:
+  // the survivor must still be parked.
+  assert(!a_done);
+  announce_beat(lh.address(), "b2");
+  LighthouseQuorumResponse r3b2 = join_beat(lh.address(), "b2", 1);
+  t3.join();
+  int64_t waited = now_ms() - t0;
+  assert(!r3a.fast_path());
+  assert(r3a.quorum().participants_size() == 2);
+  assert(ids_of(r3a.quorum()).count("b2") == 1);
+  assert(r3a.quorum().quorum_id() == r1a.quorum().quorum_id() + 1);
+  assert(r3b2.quorum().quorum_id() == r3a.quorum().quorum_id());
+  assert(waited >= 250 && waited < 5'000);  // parked on b2, not join_timeout
+  printf("test_fast_path_invalidation_farewell_min_replicas ok (%lldms)\n",
+         (long long)waited);
+}
+
+// Under membership churn the fast path must produce IDENTICAL quorum
+// decisions (membership sets, id-change pattern) to a fast-path-off
+// lighthouse, with epochs totally ordered per client.
+static void test_fast_vs_slow_identical_decisions() {
+  auto run_script = [](bool fast) {
+    LighthouseOpt lopt;
+    lopt.bind = "127.0.0.1:0";
+    lopt.min_replicas = 1;
+    lopt.join_timeout_ms = 300;
+    lopt.quorum_tick_ms = 10;
+    lopt.heartbeat_fresh_ms = 150;
+    lopt.eviction_staleness_factor = 2;
+    lopt.fast_path = fast;
+    Lighthouse lh(lopt);
+
+    std::vector<std::set<std::string>> members;
+    std::vector<bool> id_changed;
+    int64_t last_id = -1;
+    int64_t last_epoch_a = -1;
+    auto note = [&](const LighthouseQuorumResponse& r) {
+      members.push_back(ids_of(r.quorum()));
+      id_changed.push_back(last_id >= 0 &&
+                           r.quorum().quorum_id() != last_id);
+      last_id = r.quorum().quorum_id();
+      assert(r.quorum().epoch() >= last_epoch_a);  // per-client total order
+      last_epoch_a = r.quorum().epoch();
+    };
+
+    // r1: {a,b} form. r2: steady. r3: joiner c -> {a,b,c}. r4: steady.
+    // r5: b farewells -> {a,c}.
+    {
+      LighthouseQuorumResponse ra;
+      std::thread tb([&] { join_beat(lh.address(), "b", 1); });
+      ra = join_beat(lh.address(), "a", 1);
+      tb.join();
+      note(ra);
+    }
+    {
+      LighthouseQuorumResponse ra;
+      std::thread tb([&] { join_beat(lh.address(), "b", 2); });
+      ra = join_beat(lh.address(), "a", 2);
+      tb.join();
+      note(ra);
+    }
+    {
+      announce_beat(lh.address(), "c");
+      LighthouseQuorumResponse ra;
+      std::thread tb([&] { join_beat(lh.address(), "b", 3); });
+      std::thread tc([&] {
+        usleep(30'000);
+        join_beat(lh.address(), "c", 1);
+      });
+      ra = join_beat(lh.address(), "a", 3);
+      tb.join();
+      tc.join();
+      note(ra);
+    }
+    {
+      LighthouseQuorumResponse ra;
+      std::thread tb([&] { join_beat(lh.address(), "b", 4); });
+      std::thread tc([&] { join_beat(lh.address(), "c", 4); });
+      ra = join_beat(lh.address(), "a", 4);
+      tb.join();
+      tc.join();
+      note(ra);
+    }
+    {
+      announce_beat(lh.address(), "b", false, /*leaving=*/true);
+      LighthouseQuorumResponse ra;
+      std::thread tc([&] { join_beat(lh.address(), "c", 5); });
+      ra = join_beat(lh.address(), "a", 5);
+      tc.join();
+      note(ra);
+    }
+    return std::make_pair(members, id_changed);
+  };
+
+  auto fast_run = run_script(true);
+  auto slow_run = run_script(false);
+  assert(fast_run.first == slow_run.first);
+  assert(fast_run.second == slow_run.second);
+  assert(fast_run.first.back() == std::set<std::string>({"a", "c"}));
+  printf("test_fast_vs_slow_identical_decisions ok\n");
+}
+
+// Warm standby: follows the primary's quorum state, fences Quorum RPCs
+// while the primary lives, and after the primary dies promotes and serves
+// the SAME membership under the SAME quorum_id (jumped epoch) — the
+// no-ring-rebuild failover contract.
+static void test_standby_replication_and_promotion() {
+  LighthouseOpt popt;
+  popt.bind = "127.0.0.1:0";
+  popt.min_replicas = 2;
+  popt.join_timeout_ms = 300;
+  popt.quorum_tick_ms = 10;
+  popt.heartbeat_fresh_ms = 200;
+  auto primary = std::make_unique<Lighthouse>(popt);
+
+  LighthouseOpt sopt = popt;
+  sopt.standby_of = primary->address();
+  sopt.replicate_ms = 30;
+  Lighthouse standby(sopt);
+
+  LighthouseQuorumResponse r1a, r1b;
+  std::thread t1([&] { r1a = join_beat(primary->address(), "a", 1); });
+  std::thread t2([&] { r1b = join_beat(primary->address(), "b", 1); });
+  t1.join();
+  t2.join();
+  LighthouseQuorumResponse r2 = join_beat(primary->address(), "a", 2);
+  assert(r2.fast_path());
+  // The primary learned the standby's address from its Replicate polls and
+  // advertises it to managers (may take one poll interval).
+  for (int i = 0; i < 50 && r2.standby_address().empty(); i++) {
+    usleep(30'000);
+    r2 = join_beat(primary->address(), "a", 2);
+  }
+  assert(r2.standby_address() == standby.address());
+
+  // Split-brain fence: the standby refuses to arbitrate while the primary
+  // is alive.
+  {
+    RpcClient c(standby.address(), 2'000);
+    LighthouseQuorumRequest req;
+    *req.mutable_requester() = member("a", 3);
+    std::string resp, err;
+    assert(!c.call(kLighthouseQuorum, req.SerializeAsString(), &resp, &err,
+                   2'000));
+    assert(err.find("standby") != std::string::npos);
+  }
+
+  int64_t primary_id = r2.quorum().quorum_id();
+  int64_t primary_epoch = r2.quorum().epoch();
+  primary.reset();  // primary dies (listener gone -> refused polls)
+
+  // Promotion needs BOTH observers: the standby's failed polls AND a
+  // manager dialing the fence (these refused Quorum attempts are exactly
+  // what a rotating manager produces). Poll until it starts serving.
+  bool promoted = false;
+  for (int i = 0; i < 100; i++) {
+    RpcClient c(standby.address(), 2'000);
+    LighthouseQuorumRequest qreq;
+    *qreq.mutable_requester() = member("a", 3);
+    std::string resp, err;
+    if (c.call(kLighthouseQuorum, qreq.SerializeAsString(), &resp, &err,
+               5'000)) {
+      promoted = true;  // fence lifted; this serve answered
+      break;
+    }
+    // Refused ("standby: not serving") until promotion; a timeout can
+    // also appear if a post-promotion serve parks — just keep probing.
+    usleep(50'000);
+  }
+  assert(promoted);
+
+  LighthouseQuorumResponse r3a, r3b;
+  std::thread t3([&] { r3a = join_beat(standby.address(), "a", 3); });
+  std::thread t4([&] { r3b = join_beat(standby.address(), "b", 3); });
+  t3.join();
+  t4.join();
+  // Same membership, SAME quorum_id (no reconfigure/ring rebuild), epoch
+  // strictly above anything the primary ever served.
+  assert(ids_of(r3a.quorum()) == std::set<std::string>({"a", "b"}));
+  assert(r3a.quorum().quorum_id() == primary_id);
+  assert(r3a.quorum().epoch() > primary_epoch);
+  assert(r3b.quorum().quorum_id() == primary_id);
+  // Steady state resumes on the standby.
+  assert(join_beat(standby.address(), "a", 4).fast_path());
+  printf("test_standby_replication_and_promotion ok\n");
+}
+
+// Manager-level failover: a manager configured with "primary,standby"
+// candidates rotates on primary death mid-run and counts the redial; the
+// quorum id is unchanged across the failover.
+static void test_manager_lighthouse_failover() {
+  LighthouseOpt popt;
+  popt.bind = "127.0.0.1:0";
+  popt.min_replicas = 2;
+  popt.join_timeout_ms = 300;
+  popt.quorum_tick_ms = 10;
+  popt.heartbeat_fresh_ms = 200;
+  auto primary = std::make_unique<Lighthouse>(popt);
+
+  LighthouseOpt sopt = popt;
+  sopt.standby_of = primary->address();
+  sopt.replicate_ms = 30;
+  Lighthouse standby(sopt);
+
+  ManagerOpt ma;
+  ma.replica_id = "group_a";
+  ma.lighthouse_addr = primary->address() + "," + standby.address();
+  ma.bind = "127.0.0.1:0";
+  ma.store_addr = "store_a";
+  ma.world_size = 1;
+  ManagerServer m_a(ma);
+  ManagerOpt mb = ma;
+  mb.replica_id = "group_b";
+  mb.store_addr = "store_b";
+  ManagerServer m_b(mb);
+
+  auto quorum_call = [](ManagerServer* m, int64_t step,
+                        ManagerQuorumResponse* out, bool* ok) {
+    RpcClient c(m->address(), 2'000);
+    ManagerQuorumRequest req;
+    req.set_rank(0);
+    req.set_step(step);
+    req.set_checkpoint_server_addr("ckpt");
+    req.set_call_seq(step);
+    std::string resp, err;
+    if (c.call(kManagerQuorum, req.SerializeAsString(), &resp, &err, 30'000))
+      *ok = out->ParseFromString(resp);
+    else
+      fprintf(stderr, "manager quorum failed: %s\n", err.c_str());
+  };
+
+  ManagerQuorumResponse r1a, r1b;
+  bool ok1a = false, ok1b = false;
+  std::thread t1([&] { quorum_call(&m_a, 1, &r1a, &ok1a); });
+  std::thread t2([&] { quorum_call(&m_b, 1, &r1b, &ok1b); });
+  t1.join();
+  t2.join();
+  assert(ok1a && ok1b);
+  assert(r1a.quorum_id() == r1b.quorum_id());
+
+  // Step 2: managers piggyback beats, so this rides the fast path.
+  ManagerQuorumResponse r2a, r2b;
+  bool ok2a = false, ok2b = false;
+  std::thread t3([&] { quorum_call(&m_a, 2, &r2a, &ok2a); });
+  std::thread t4([&] { quorum_call(&m_b, 2, &r2b, &ok2b); });
+  t3.join();
+  t4.join();
+  assert(ok2a && ok2b);
+  assert(r2a.fast_path() && r2b.fast_path());
+  assert(r2a.epoch() > 0);
+
+  primary.reset();  // SIGKILL-equivalent for the in-process primary
+
+  ManagerQuorumResponse r3a, r3b;
+  bool ok3a = false, ok3b = false;
+  std::thread t5([&] { quorum_call(&m_a, 3, &r3a, &ok3a); });
+  std::thread t6([&] { quorum_call(&m_b, 3, &r3b, &ok3b); });
+  t5.join();
+  t6.join();
+  assert(ok3a && ok3b);
+  // Same membership, same quorum_id: the in-flight step needs no ring
+  // rebuild; the managers just re-dialed.
+  assert(r3a.quorum_id() == r2a.quorum_id());
+  assert(r3a.replica_world_size() == 2);
+  assert(m_a.lighthouse_redials() >= 1);
+  assert(m_a.lighthouse_addr() == standby.address());
+  printf("test_manager_lighthouse_failover ok (redials a=%lld b=%lld)\n",
+         (long long)m_a.lighthouse_redials(),
+         (long long)m_b.lighthouse_redials());
+}
+
 int main() {
   test_quorum_changed();
   test_store();
@@ -611,6 +1081,13 @@ int main() {
   test_farewell_clears_grace();
   test_kill_requires_token();
   test_shutdown_while_parked();
+  test_fast_path_steady_state();
+  test_fast_path_invalidation_stale_beat();
+  test_fast_path_invalidation_joiner();
+  test_fast_path_invalidation_farewell_min_replicas();
+  test_fast_vs_slow_identical_decisions();
+  test_standby_replication_and_promotion();
+  test_manager_lighthouse_failover();
   printf("ALL CORE TESTS PASSED\n");
   return 0;
 }

@@ -1,11 +1,127 @@
 #include "lighthouse.h"
 
 #include <algorithm>
+#include <cstdio>
 #include <cstring>
 #include <set>
 #include <sstream>
 
 namespace torchft_tpu {
+
+// ------------------------------------------------------------------ beats
+
+void BeatTable::record(const std::string& id, int64_t now, bool joining,
+                       int64_t heal_count, int64_t committed,
+                       int64_t aborted) {
+  Shard& s = shard_for(id);
+  std::lock_guard<std::mutex> lk(s.mu);
+  Beat& b = s.beats[id];
+  b.last_ms = now;
+  if (joining) b.last_joining_ms = now;
+  b.heal_count = heal_count;
+  b.committed_steps = committed;
+  b.aborted_steps = aborted;
+  s.departed.erase(id);  // back from the dead
+}
+
+void BeatTable::adopt(const std::string& id, int64_t last_ms,
+                      int64_t last_joining_ms) {
+  Shard& s = shard_for(id);
+  std::lock_guard<std::mutex> lk(s.mu);
+  Beat& b = s.beats[id];
+  // Replication must never make a record LOOK staler than a beat the
+  // standby already received directly (managers keepalive both ways during
+  // a failover window).
+  b.last_ms = std::max(b.last_ms, last_ms);
+  b.last_joining_ms = std::max(b.last_joining_ms, last_joining_ms);
+}
+
+void BeatTable::adopt_departed(const std::string& id, int64_t departed_ms) {
+  Shard& s = shard_for(id);
+  std::lock_guard<std::mutex> lk(s.mu);
+  // A beat the standby heard directly AFTER the farewell snapshot wins
+  // ("back from the dead"); only a record older than the farewell yields.
+  auto it = s.beats.find(id);
+  if (it != s.beats.end()) {
+    int64_t latest = std::max(it->second.last_ms, it->second.last_joining_ms);
+    if (latest >= departed_ms) return;
+    s.beats.erase(it);
+  }
+  int64_t& d = s.departed[id];
+  d = std::max(d, departed_ms);
+}
+
+void BeatTable::farewell(const std::string& id, int64_t now) {
+  Shard& s = shard_for(id);
+  std::lock_guard<std::mutex> lk(s.mu);
+  s.beats.erase(id);
+  s.departed[id] = now;
+}
+
+void BeatTable::revive(const std::string& id) {
+  Shard& s = shard_for(id);
+  std::lock_guard<std::mutex> lk(s.mu);
+  s.departed.erase(id);
+}
+
+bool BeatTable::lookup(const std::string& id, Beat* out) const {
+  const Shard& s = shard_for(id);
+  std::lock_guard<std::mutex> lk(s.mu);
+  auto it = s.beats.find(id);
+  if (it == s.beats.end()) return false;
+  *out = it->second;
+  return true;
+}
+
+int64_t BeatTable::latest_ms(const std::string& id) const {
+  Beat b;
+  if (!lookup(id, &b)) return -1;
+  return std::max(b.last_ms, b.last_joining_ms);
+}
+
+bool BeatTable::departed(const std::string& id) const {
+  const Shard& s = shard_for(id);
+  std::lock_guard<std::mutex> lk(s.mu);
+  return s.departed.count(id) != 0;
+}
+
+void BeatTable::for_each(
+    const std::function<void(const std::string&, const Beat&)>& fn) const {
+  for (const Shard& s : shards_) {
+    std::lock_guard<std::mutex> lk(s.mu);
+    for (const auto& [id, b] : s.beats) fn(id, b);
+  }
+}
+
+void BeatTable::for_each_departed(
+    const std::function<void(const std::string&, int64_t)>& fn) const {
+  for (const Shard& s : shards_) {
+    std::lock_guard<std::mutex> lk(s.mu);
+    for (const auto& [id, ms] : s.departed) fn(id, ms);
+  }
+}
+
+void BeatTable::prune(int64_t now, int64_t keep_ms,
+                      const std::set<std::string>& keep) {
+  for (Shard& s : shards_) {
+    std::lock_guard<std::mutex> lk(s.mu);
+    for (auto it = s.beats.begin(); it != s.beats.end();) {
+      int64_t latest = std::max(it->second.last_ms, it->second.last_joining_ms);
+      if (now - latest > keep_ms && !keep.count(it->first))
+        it = s.beats.erase(it);
+      else
+        ++it;
+    }
+    for (auto it = s.departed.begin(); it != s.departed.end();) {
+      if (now - it->second > keep_ms && !keep.count(it->first))
+        it = s.departed.erase(it);
+      else
+        ++it;
+    }
+  }
+}
+
+// ------------------------------------------------------------- lighthouse
 
 Lighthouse::Lighthouse(const LighthouseOpt& opt) : opt_(opt) {
   // Boot-time id seed: a replacement lighthouse must mint ids strictly
@@ -17,11 +133,14 @@ Lighthouse::Lighthouse(const LighthouseOpt& opt) : opt_(opt) {
   // MILLISECOND granularity: a supervisor (systemd Restart=always) can
   // respawn within the same second; ms<<8 still leaves 256 membership
   // changes per ms of incarnation overlap, far beyond any real churn.
+  // (A standby overwrites this with the primary's id on adoption.)
   quorum_id_ =
       std::chrono::duration_cast<std::chrono::milliseconds>(
           std::chrono::system_clock::now().time_since_epoch())
           .count()
       << 8;
+  boot_id_ = quorum_id_;  // frozen incarnation identity (see lighthouse.h)
+  promoted_.store(opt_.standby_of.empty());
   server_ = std::make_unique<RpcServer>(
       opt.bind,
       [this](uint8_t m, const std::string& req, std::string* resp,
@@ -34,6 +153,8 @@ Lighthouse::Lighthouse(const LighthouseOpt& opt) : opt_(opt) {
       if (!shutdown_) tick();
     }
   });
+  if (!opt_.standby_of.empty())
+    replicate_thread_ = std::thread([this] { replicate_loop(); });
 }
 
 Lighthouse::~Lighthouse() { shutdown(); }
@@ -46,6 +167,7 @@ void Lighthouse::shutdown() {
   }
   cv_.notify_all();
   if (tick_thread_.joinable()) tick_thread_.join();
+  if (replicate_thread_.joinable()) replicate_thread_.join();
   server_->shutdown();
 }
 
@@ -86,7 +208,18 @@ static std::string url_encode(const std::string& s) {
 std::string Lighthouse::status_json(const StatusResponse& r) {
   std::string out = "{\"quorum_id\":" + std::to_string(r.quorum_id()) +
                     ",\"quorum_age_ms\":" + std::to_string(r.quorum_age_ms()) +
-                    ",\"members\":[";
+                    ",\"epoch\":" + std::to_string(r.epoch()) +
+                    ",\"fast_path_hits\":" +
+                    std::to_string(r.fast_path_hits()) +
+                    ",\"slow_path_served\":" +
+                    std::to_string(r.slow_path_served()) +
+                    ",\"slow_path_rounds\":" +
+                    std::to_string(r.slow_path_rounds()) +
+                    ",\"fast_path_eligible\":" +
+                    (r.fast_path_eligible() ? "true" : "false") +
+                    ",\"is_standby\":" + (r.is_standby() ? "true" : "false") +
+                    ",\"standby_address\":\"" +
+                    json_escape(r.standby_address()) + "\",\"members\":[";
   for (int i = 0; i < r.members_size(); i++) {
     const auto& m = r.members(i);
     if (i) out += ",";
@@ -131,20 +264,18 @@ bool Lighthouse::quorum_valid_locked() const {
   // A dead group's beats go stale within heartbeat_fresh_ms, so
   // shrink-on-death latency is unchanged.
   bool pending_alive = false;
-  for (const auto& [id, b] : heartbeats_) {
-    if (participants_.count(id)) continue;
+  beats_.for_each([&](const std::string& id, const BeatTable::Beat& b) {
+    if (pending_alive || participants_.count(id)) return;
     if (b.last_joining_ms >= 0 &&
-        now - b.last_joining_ms < opt_.heartbeat_fresh_ms) {
+        now - b.last_joining_ms < opt_.heartbeat_fresh_ms)
       pending_alive = true;
-      break;
-    }
-  }
+  });
   if (!pending_alive && has_prev_quorum_) {
     for (const auto& m : prev_quorum_.participants()) {
       if (participants_.count(m.replica_id())) continue;
-      auto hb = heartbeats_.find(m.replica_id());
-      if (hb != heartbeats_.end() && hb->second.last_ms >= 0 &&
-          now - hb->second.last_ms < opt_.heartbeat_fresh_ms) {
+      BeatTable::Beat b;
+      if (beats_.lookup(m.replica_id(), &b) && b.last_ms >= 0 &&
+          now - b.last_ms < opt_.heartbeat_fresh_ms) {
         pending_alive = true;
         break;
       }
@@ -179,19 +310,18 @@ bool Lighthouse::quorum_valid_locked() const {
     for (const auto& m : prev_quorum_.participants()) {
       if (participants_.count(m.replica_id())) continue;
       any_missing = true;
-      auto hb = heartbeats_.find(m.replica_id());
-      if (hb == heartbeats_.end()) {
+      BeatTable::Beat b;
+      if (!beats_.lookup(m.replica_id(), &b)) {
         // Provably gone only via explicit farewell; a member that never
         // beat gets the join-timeout benefit of the doubt (it may be a
         // non-beating client whose re-join is racing this round).
-        if (!departed_.count(m.replica_id())) {
+        if (!beats_.departed(m.replica_id())) {
           all_missing_gone = false;
           break;
         }
         continue;
       }
-      int64_t latest =
-          std::max(hb->second.last_ms, hb->second.last_joining_ms);
+      int64_t latest = std::max(b.last_ms, b.last_joining_ms);
       if (latest >= 0 && now - latest < stale_ms) {
         all_missing_gone = false;
         break;
@@ -209,31 +339,65 @@ bool Lighthouse::quorum_valid_locked() const {
   return now - first_join_ms_ >= wait;
 }
 
+bool Lighthouse::fast_eligible_locked(const std::string& id,
+                                      int64_t step) const {
+  if (!opt_.fast_path || !has_prev_quorum_ || shutdown_) return false;
+  // Only previous-quorum members can ride the cache; a new replica_id is by
+  // definition a membership change and must rendezvous on the slow path.
+  if (!prev_ids_.count(id)) return false;
+  // A previous member parked in a forming slow round means the round MUST
+  // complete via the rendezvous for everyone: fast-serving the remaining
+  // members would let them run a collective the parked member can never
+  // join (it is blocked here) — a control/data-plane deadlock.
+  for (const auto& [pid, j] : participants_) {
+    (void)j;
+    if (prev_ids_.count(pid)) return false;
+  }
+  // Additive invalidation (joiner pending): defer NEW step generations to
+  // the slow path so the joiner is admitted, but let the CURRENT generation
+  // (steps at or below the fast-path high-water mark) finish fast — a
+  // generation split between fast-served and parked members deadlocks as
+  // above. The joiner waits at most one step.
+  if (step > fast_round_step_) {
+    if (!participants_.empty()) return false;  // joiner already parked
+    bool fresh_joiner = false;
+    int64_t now = now_ms();
+    beats_.for_each([&](const std::string& bid, const BeatTable::Beat& b) {
+      if (fresh_joiner || prev_ids_.count(bid)) return;
+      if (b.last_joining_ms >= 0 &&
+          now - b.last_joining_ms < opt_.heartbeat_fresh_ms)
+        fresh_joiner = true;
+    });
+    if (fresh_joiner) return false;
+  }
+  // Subtractive invalidation (stale beat / farewell / kill): every member
+  // must be provably alive within the same staleness bound fast eviction
+  // uses — "fast-path-eligible" and "would not be evicted" are deliberately
+  // the same predicate, so the cache can never outlive a membership the
+  // slow path would already have shrunk. (Factor 0 disables eviction but
+  // must not disable the fast path; fall back to the default bound.)
+  const int64_t factor = opt_.eviction_staleness_factor > 0
+                             ? opt_.eviction_staleness_factor
+                             : 3;
+  const int64_t bound = factor * opt_.heartbeat_fresh_ms;
+  int64_t now = now_ms();
+  for (const auto& m : prev_quorum_.participants()) {
+    if (beats_.departed(m.replica_id())) return false;
+    int64_t latest = beats_.latest_ms(m.replica_id());
+    if (latest < 0 || now - latest >= bound) return false;
+  }
+  return true;
+}
+
 bool Lighthouse::tick() {
   // Prune long-stale beat entries (each restart brings a fresh uuid-suffixed
-  // replica_id, so the map otherwise grows without bound across a long job).
-  // Previous-quorum members are kept so the dashboard can show their
+  // replica_id, so the table otherwise grows without bound across a long
+  // job). Previous-quorum members are kept so the dashboard can show their
   // staleness.
   {
     int64_t now = now_ms();
     int64_t keep_ms = std::max<int64_t>(10'000, 20 * opt_.heartbeat_fresh_ms);
-    std::set<std::string> prev_ids;
-    if (has_prev_quorum_)
-      for (const auto& m : prev_quorum_.participants())
-        prev_ids.insert(m.replica_id());
-    for (auto it = heartbeats_.begin(); it != heartbeats_.end();) {
-      int64_t latest = std::max(it->second.last_ms, it->second.last_joining_ms);
-      if (now - latest > keep_ms && !prev_ids.count(it->first))
-        it = heartbeats_.erase(it);
-      else
-        ++it;
-    }
-    for (auto it = departed_.begin(); it != departed_.end();) {
-      if (now - it->second > keep_ms && !prev_ids.count(it->first))
-        it = departed_.erase(it);
-      else
-        ++it;
-    }
+    beats_.prune(now, keep_ms, prev_ids_);
   }
   if (!quorum_valid_locked()) return false;
   Quorum q;
@@ -247,12 +411,113 @@ bool Lighthouse::tick() {
       std::chrono::duration_cast<std::chrono::milliseconds>(
           std::chrono::system_clock::now().time_since_epoch())
           .count());
+  epoch_++;
+  q.set_epoch(epoch_);
   prev_quorum_ = q;
   has_prev_quorum_ = true;
+  prev_ids_.clear();
+  fast_round_step_ = -1;
+  for (const auto& m : q.participants()) {
+    prev_ids_.insert(m.replica_id());
+    fast_round_step_ = std::max(fast_round_step_, m.step());
+  }
+  slow_path_rounds_++;
   participants_.clear();
   first_join_ms_ = 0;
   broadcast_seq_++;
   cv_.notify_all();
+  return true;
+}
+
+void Lighthouse::fill_response_locked(LighthouseQuorumResponse* out,
+                                      bool fast) const {
+  *out->mutable_quorum() = prev_quorum_;
+  out->set_fast_path(fast);
+  out->set_standby_address(standby_addr_);
+  // Standalone beats only need to keep the liveness record fresher than the
+  // fast-path staleness bound; half of heartbeat_fresh_ms leaves 3x slack
+  // against the default eviction bound (3 * fresh).
+  out->set_keepalive_ms(std::max<int64_t>(opt_.heartbeat_fresh_ms / 2, 1));
+}
+
+void Lighthouse::record_beat(const LighthouseHeartbeatRequest& r) {
+  if (r.replica_id().empty()) return;
+  if (r.leaving()) {
+    beats_.farewell(r.replica_id(), now_ms());
+  } else {
+    beats_.record(r.replica_id(), now_ms(), r.joining(), r.heal_count(),
+                  r.committed_steps(), r.aborted_steps());
+  }
+}
+
+bool Lighthouse::handle_quorum(const LighthouseQuorumRequest& r,
+                               LighthouseQuorumResponse* out,
+                               std::string* err) {
+  if (!promoted_.load()) {
+    // Split-brain fence: an unpromoted standby must never arbitrate
+    // membership while the primary may still be serving. Managers treat
+    // this as transient and retry (rotating back to the primary). The
+    // attempt itself is recorded as promotion CORROBORATION: a manager
+    // only dials us after ITS path to the primary failed — an observer
+    // independent of our own replication polls (see replicate_loop).
+    last_fenced_quorum_ms_.store(now_ms());
+    *err = "standby: not serving (primary " + opt_.standby_of +
+           " not known dead); retry";
+    return false;
+  }
+  const QuorumMember& me = r.requester();
+  // Coalesced heartbeat: managers piggyback their beat on the quorum RPC
+  // (joining flag + the operational counters the standalone beat sends),
+  // so in steady state the quorum round IS the liveness signal. Recorded
+  // BEFORE taking the quorum lock: beats only touch the sharded table.
+  // Deliberately no synthesis for beat-less requests: a client that never
+  // beats keeps the reference's exact grace/eviction timing (no liveness
+  // record -> plain join_timeout), and without beats it simply never
+  // qualifies for the fast path.
+  if (r.has_beat() && !r.beat().replica_id().empty()) record_beat(r.beat());
+
+  std::unique_lock<std::mutex> lk(mu_);
+  if (fast_eligible_locked(me.replica_id(), me.step())) {
+    // FAST PATH: membership is settled and everyone is provably alive —
+    // serve the cached decision with this member's registration refreshed
+    // and a bumped epoch. No tick-loop park, no fan-in barrier, and the
+    // quorum_id is untouched (membership unchanged by construction).
+    for (auto& m : *prev_quorum_.mutable_participants()) {
+      if (m.replica_id() == me.replica_id()) {
+        m.set_step(me.step());
+        m.set_address(me.address());
+        m.set_store_address(me.store_address());
+        m.set_world_size(me.world_size());
+        break;
+      }
+    }
+    epoch_++;
+    prev_quorum_.set_epoch(epoch_);
+    fast_path_hits_++;
+    fast_round_step_ = std::max(fast_round_step_, me.step());
+    fill_response_locked(out, /*fast=*/true);
+    return true;
+  }
+
+  // SLOW PATH: the reference rendezvous — park until the round cuts.
+  if (participants_.empty()) first_join_ms_ = now_ms();
+  participants_[me.replica_id()] = {me, now_ms()};
+  // A join is proof of life: clear any stale farewell from a previous
+  // incarnation of this id, or fast eviction would treat the live,
+  // re-joined (possibly never-beating) member as provably gone.
+  beats_.revive(me.replica_id());
+  int64_t entry_seq = broadcast_seq_;
+  tick();  // proactive: don't wait for the tick thread if already valid
+  while (broadcast_seq_ == entry_seq && !shutdown_) {
+    cv_.wait_for(lk, std::chrono::milliseconds(opt_.quorum_tick_ms));
+    if (broadcast_seq_ == entry_seq && !shutdown_) tick();
+  }
+  if (shutdown_) {
+    *err = "lighthouse shutting down";
+    return false;
+  }
+  slow_path_served_++;
+  fill_response_locked(out, /*fast=*/false);
   return true;
 }
 
@@ -265,25 +530,8 @@ bool Lighthouse::handle(uint8_t method, const std::string& req,
         *err = "bad LighthouseQuorumRequest";
         return false;
       }
-      std::unique_lock<std::mutex> lk(mu_);
-      if (participants_.empty()) first_join_ms_ = now_ms();
-      participants_[r.requester().replica_id()] = {r.requester(), now_ms()};
-      // A join is proof of life: clear any stale farewell from a previous
-      // incarnation of this id, or fast eviction would treat the live,
-      // re-joined (possibly never-beating) member as provably gone.
-      departed_.erase(r.requester().replica_id());
-      int64_t entry_seq = broadcast_seq_;
-      tick();  // proactive: don't wait for the tick thread if already valid
-      while (broadcast_seq_ == entry_seq && !shutdown_) {
-        cv_.wait_for(lk, std::chrono::milliseconds(opt_.quorum_tick_ms));
-        if (broadcast_seq_ == entry_seq && !shutdown_) tick();
-      }
-      if (shutdown_) {
-        *err = "lighthouse shutting down";
-        return false;
-      }
       LighthouseQuorumResponse out;
-      *out.mutable_quorum() = prev_quorum_;
+      if (!handle_quorum(r, &out, err)) return false;
       *resp = out.SerializeAsString();
       return true;
     }
@@ -293,25 +541,49 @@ bool Lighthouse::handle(uint8_t method, const std::string& req,
         *err = "bad LighthouseHeartbeatRequest";
         return false;
       }
-      {
-        std::lock_guard<std::mutex> lk(mu_);
-        if (r.leaving()) {
-          heartbeats_.erase(r.replica_id());
-          departed_[r.replica_id()] = now_ms();
-        } else {
-          auto& b = heartbeats_[r.replica_id()];
-          b.last_ms = now_ms();
-          if (r.joining()) b.last_joining_ms = b.last_ms;
-          b.heal_count = r.heal_count();
-          b.committed_steps = r.committed_steps();
-          b.aborted_steps = r.aborted_steps();
-          departed_.erase(r.replica_id());  // back from the dead
-        }
-      }
+      // Lock-striped: beats never touch the quorum mutex, so 64+ clients
+      // beating at keepalive cadence cannot convoy the control plane.
+      record_beat(r);
       // A joining beat can lift a fast-quorum deferral the moment the
       // announcer lands in participants_ via its Quorum RPC; no tick needed
       // here — beats alone never form quorums.
       *resp = LighthouseHeartbeatResponse().SerializeAsString();
+      return true;
+    }
+    case kLighthouseReplicate: {
+      ReplicateRequest r;
+      if (!r.ParseFromString(req)) {
+        *err = "bad ReplicateRequest";
+        return false;
+      }
+      if (!promoted_.load()) {
+        *err = "replicate: target is itself an unpromoted standby";
+        return false;
+      }
+      ReplicateResponse out;
+      {
+        std::lock_guard<std::mutex> lk(mu_);
+        if (!r.standby_address().empty())
+          standby_addr_ = r.standby_address();
+        if (has_prev_quorum_) *out.mutable_quorum() = prev_quorum_;
+        out.set_quorum_id(quorum_id_);
+        out.set_epoch(epoch_);
+        out.set_boot_id(boot_id_);
+      }
+      int64_t now = now_ms();
+      beats_.for_each([&](const std::string& id, const BeatTable::Beat& b) {
+        BeatAge* a = out.add_beats();
+        a->set_replica_id(id);
+        a->set_age_ms(b.last_ms >= 0 ? now - b.last_ms : -1);
+        a->set_joining_age_ms(
+            b.last_joining_ms >= 0 ? now - b.last_joining_ms : -1);
+      });
+      beats_.for_each_departed([&](const std::string& id, int64_t ms) {
+        BeatAge* a = out.add_departed();
+        a->set_replica_id(id);
+        a->set_age_ms(now - ms);
+      });
+      *resp = out.SerializeAsString();
       return true;
     }
     case kLighthouseStatus: {
@@ -329,8 +601,152 @@ bool Lighthouse::handle(uint8_t method, const std::string& req,
   }
 }
 
+void Lighthouse::adopt_replica_state(const ReplicateResponse& r) {
+  int64_t now = now_ms();
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    // Epoch is an in-memory counter that restarts when the primary
+    // restarts: a changed incarnation resets the monotonicity baseline,
+    // or adoption would freeze forever on `epoch < adopted` while the new
+    // primary's membership evolves. Local epoch_ never regresses (the
+    // max below) so the standby's own eventual serves stay ordered.
+    bool new_incarnation = r.boot_id() != primary_boot_id_;
+    if (new_incarnation) primary_boot_id_ = r.boot_id();
+    if (r.has_quorum() && (new_incarnation || r.epoch() >= epoch_)) {
+      prev_quorum_ = r.quorum();
+      has_prev_quorum_ = true;
+      // EXACT id adoption, not max with the boot seed: the standby
+      // continues the primary's live sequence, so its first post-failover
+      // quorum with unchanged membership reuses the id managers already
+      // hold — no spurious reconfigure/ring rebuild (see lighthouse.h
+      // quorum_id_; the boot seed exists for cold REPLACEMENTS, which
+      // have no state to continue).
+      quorum_id_ = r.quorum_id();
+      epoch_ = std::max(epoch_, r.epoch());
+      prev_ids_.clear();
+      fast_round_step_ = -1;
+      for (const auto& m : prev_quorum_.participants()) {
+        prev_ids_.insert(m.replica_id());
+        fast_round_step_ = std::max(fast_round_step_, m.step());
+      }
+    } else if (!r.has_quorum()) {
+      quorum_id_ = std::max(quorum_id_, r.quorum_id());
+      epoch_ = std::max(epoch_, r.epoch());
+    }
+  }
+  for (const auto& b : r.beats()) {
+    beats_.adopt(b.replica_id(),
+                 b.age_ms() >= 0 ? now - b.age_ms() : -1,
+                 b.joining_age_ms() >= 0 ? now - b.joining_age_ms() : -1);
+  }
+  for (const auto& d : r.departed()) {
+    if (d.age_ms() >= 0)
+      beats_.adopt_departed(d.replica_id(), now - d.age_ms());
+  }
+}
+
+void Lighthouse::replicate_loop() {
+  std::unique_ptr<RpcClient> client;
+  const int64_t poll_timeout =
+      std::max<int64_t>(2 * opt_.replicate_ms, 500);
+  while (true) {
+    {
+      std::unique_lock<std::mutex> lk(mu_);
+      cv_.wait_for(lk, std::chrono::milliseconds(opt_.replicate_ms));
+      if (shutdown_) return;
+    }
+    bool ok = false;
+    bool refused = false;
+    try {
+      if (!client)
+        client = std::make_unique<RpcClient>(opt_.standby_of, poll_timeout);
+      ReplicateRequest req;
+      req.set_standby_address(address());
+      {
+        std::lock_guard<std::mutex> lk(mu_);
+        req.set_have_epoch(epoch_);
+      }
+      std::string resp, err;
+      if (client->call(kLighthouseReplicate, req.SerializeAsString(), &resp,
+                       &err, poll_timeout)) {
+        ReplicateResponse rr;
+        if (rr.ParseFromString(resp)) {
+          adopt_replica_state(rr);
+          ok = true;
+        }
+      } else {
+        client.reset();
+        // "reconnect ... failed" = the listener is gone (connection
+        // refused): a much stronger death signal than a timeout, which a
+        // loaded-but-alive primary can also produce.
+        refused = err.find("reconnect") != std::string::npos;
+      }
+    } catch (...) {  // initial connect failed: listener gone
+      client.reset();
+      refused = true;
+    }
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      if (shutdown_) return;
+      int64_t now = now_ms();
+      if (ok) {
+        // A live primary instantly disarms any death suspicion.
+        last_primary_ok_ms_ = now;
+        primary_poll_failures_ = 0;
+        continue;
+      }
+      primary_poll_failures_++;
+      // ARMED: our own view says the primary is gone. The connect layer
+      // cannot distinguish a dead listener from a partition dropping our
+      // packets ("reconnect failed" covers both), so arming alone must
+      // never promote — that would fork the job into two arbiters the
+      // moment a standby-side network blip outlasts a few polls.
+      bool armed =
+          (refused && primary_poll_failures_ >= 2) ||
+          primary_poll_failures_ >= 5 ||
+          (last_primary_ok_ms_ > 0 &&
+           now - last_primary_ok_ms_ >
+               std::max<int64_t>(10 * opt_.replicate_ms, 2'000));
+      // CORROBORATED: a manager recently dialed our fence with a Quorum
+      // attempt — its own path to the primary failed too. Two independent
+      // observers of primary death are required to promote; managers that
+      // can still reach the primary never dial us, so a standby-only
+      // partition leaves the fence up forever (safe: nobody needs us).
+      int64_t fenced = last_fenced_quorum_ms_.load();
+      bool corroborated =
+          fenced >= 0 &&
+          now - fenced <= std::max<int64_t>(20 * opt_.replicate_ms, 5'000);
+      if (!armed || !corroborated) continue;  // keep polling either way
+      // PROMOTE: serve quorums from the adopted state. The epoch jump
+      // covers fast-path serves the final missed polls never replicated,
+      // keeping epoch monotonicity across the failover (bounded by serve
+      // rate x poll interval; 2^20 is orders of magnitude beyond it).
+      epoch_ += 1 << 20;
+      promoted_.store(true);
+      fprintf(stderr,
+              "torchft_tpu lighthouse standby: primary %s unreachable "
+              "(%lld failed polls%s) and managers are dialing the fence; "
+              "PROMOTED at quorum_id=%lld\n",
+              opt_.standby_of.c_str(), (long long)primary_poll_failures_,
+              refused ? ", connection refused" : "",
+              (long long)quorum_id_);
+      fflush(stderr);
+      return;
+    }
+  }
+}
+
 void Lighthouse::status_locked(StatusResponse* out) const {
   out->set_quorum_id(quorum_id_);
+  out->set_epoch(epoch_);
+  out->set_fast_path_hits(fast_path_hits_);
+  out->set_slow_path_served(slow_path_served_);
+  out->set_slow_path_rounds(slow_path_rounds_);
+  out->set_standby_address(standby_addr_);
+  out->set_is_standby(!promoted_.load());
+  out->set_fast_path_eligible(
+      has_prev_quorum_ && !prev_ids_.empty() &&
+      fast_eligible_locked(*prev_ids_.begin(), fast_round_step_));
   if (has_prev_quorum_) {
     int64_t created = prev_quorum_.created_unix_ms();
     int64_t now_wall = std::chrono::duration_cast<std::chrono::milliseconds>(
@@ -340,22 +756,27 @@ void Lighthouse::status_locked(StatusResponse* out) const {
     for (const auto& m : prev_quorum_.participants()) {
       auto* ms = out->add_members();
       *ms->mutable_member() = m;
-      auto it = heartbeats_.find(m.replica_id());
-      if (it == heartbeats_.end() || it->second.last_ms < 0) {
+      BeatTable::Beat b;
+      if (!beats_.lookup(m.replica_id(), &b) || b.last_ms < 0) {
         ms->set_heartbeat_age_ms(-1);
       } else {
-        ms->set_heartbeat_age_ms(now_ms() - it->second.last_ms);
-        ms->set_heal_count(it->second.heal_count);
-        ms->set_committed_steps(it->second.committed_steps);
-        ms->set_aborted_steps(it->second.aborted_steps);
+        ms->set_heartbeat_age_ms(now_ms() - b.last_ms);
+        ms->set_heal_count(b.heal_count);
+        ms->set_committed_steps(b.committed_steps);
+        ms->set_aborted_steps(b.aborted_steps);
       }
     }
   }
-  for (const auto& [id, _] : participants_) out->add_joining(id);
+  for (const auto& [id, j] : participants_) {
+    (void)j;
+    out->add_joining(id);
+  }
 }
 
 // Minimal HTML dashboard: quorum status, per-member step/heartbeat, kill
-// buttons (the reference's askama/htmx dashboard, templates/status.html).
+// buttons (the reference's askama/htmx dashboard, templates/status.html),
+// plus the control-plane scaling row: fast-path hit rate, cached-quorum
+// epoch/age, and the registered warm-standby address.
 std::string Lighthouse::handle_http(const std::string& request) {
   std::string body;
   std::string content_type = "text/html";
@@ -432,10 +853,29 @@ std::string Lighthouse::handle_http(const std::string& request) {
     std::ostringstream os;
     os << "<html><head><title>torchft_tpu lighthouse</title>"
        << "<meta http-equiv=refresh content=1></head><body>"
-       << "<h1>torchft_tpu lighthouse</h1>"
+       << "<h1>torchft_tpu lighthouse"
+       << (st.is_standby() ? " (STANDBY, not serving)" : "") << "</h1>"
        << "<p>quorum_id: " << st.quorum_id()
-       << " &middot; age: " << st.quorum_age_ms() << "ms</p>"
-       << "<table border=1 cellpadding=4><tr><th>replica</th><th>step</th>"
+       << " &middot; age: " << st.quorum_age_ms() << "ms"
+       << " &middot; epoch: " << st.epoch() << "</p>";
+    {
+      int64_t fast = st.fast_path_hits();
+      int64_t slow = st.slow_path_served();
+      int64_t total = fast + slow;
+      char rate[32];
+      snprintf(rate, sizeof rate, "%.1f%%",
+               total > 0 ? 100.0 * (double)fast / (double)total : 0.0);
+      os << "<p>fast path: " << (st.fast_path_eligible() ? "armed" : "cold")
+         << " &middot; hit rate " << rate << " (" << fast << " fast / "
+         << slow << " slow serves, " << st.slow_path_rounds()
+         << " full rounds)"
+         << " &middot; standby: "
+         << (st.standby_address().empty()
+                 ? std::string("none registered")
+                 : html_escape(st.standby_address()))
+         << "</p>";
+    }
+    os << "<table border=1 cellpadding=4><tr><th>replica</th><th>step</th>"
        << "<th>world</th><th>heartbeat age</th><th>heals</th>"
        << "<th>committed</th><th>aborted</th><th></th></tr>";
     int64_t max_step = 0;

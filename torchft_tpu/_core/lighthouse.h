@@ -5,15 +5,34 @@
 // quorum per tick with fast-quorum / min_replicas / join-timeout semantics
 // (reference :106-208), bumps quorum_id only when membership changes
 // (reference quorum_changed :81-86), parks Quorum RPCs until the next quorum
-// broadcast, records heartbeats (visualized only, reference :378-391), and
-// serves an HTML dashboard with kill buttons on the same port
-// (reference :234-252).
+// broadcast, records heartbeats, and serves an HTML dashboard with kill
+// buttons on the same port (reference :234-252).
+//
+// Beyond the reference, three control-plane scaling layers
+// (docs/design/control_plane.md):
+//   1. membership-unchanged FAST PATH: when every member of the previous
+//      quorum is provably live and no joiner is pending, a Quorum RPC is
+//      served from the cached decision with a bumped epoch — no tick-loop
+//      park, no fan-in barrier. Any membership delta (stale beat, joiner,
+//      farewell) makes requests ineligible and falls back to the slow path,
+//      so quorum semantics (join grace, eviction staleness) are untouched.
+//   2. coalesced, LOCK-STRIPED heartbeats: beats (standalone or piggybacked
+//      on Quorum RPCs) land in a sharded BeatTable so 64+ clients never
+//      serialize on the quorum mutex.
+//   3. WARM STANDBY: a second lighthouse follows the primary's quorum state
+//      over kLighthouseReplicate and starts serving (same quorum_id, jumped
+//      epoch) only once the primary is provably dead, so managers re-dial
+//      mid-step without a ring rebuild.
 #pragma once
 
+#include <array>
+#include <atomic>
 #include <condition_variable>
+#include <functional>
 #include <map>
 #include <memory>
 #include <mutex>
+#include <set>
 #include <string>
 #include <thread>
 
@@ -61,6 +80,75 @@ struct LighthouseOpt {
   // token-gated managers accept them. (The dashboard itself is read-only
   // apart from kill; put it behind your VPC firewall regardless.)
   std::string auth_token;
+  // Membership-unchanged fast path (docs/design/control_plane.md). Off
+  // restores strict reference behavior: every Quorum RPC parks in the
+  // tick-loop rendezvous.
+  bool fast_path = true;
+  // Non-empty = run as a warm standby of the primary at this address:
+  // follow its quorum state over kLighthouseReplicate every replicate_ms,
+  // refuse Quorum RPCs until the primary is provably dead, then promote and
+  // serve with the adopted quorum_id (+ an epoch jump covering any
+  // unreplicated fast-path serves).
+  std::string standby_of;
+  int64_t replicate_ms = 100;
+};
+
+// Sharded liveness table: beat writes (the per-member hot path — 64+ clients
+// beat or piggyback every step) take only one shard mutex, never the quorum
+// lock. Quorum logic reads through the same shard locks; they are leaf locks
+// (no method acquires anything else), so holding the lighthouse mutex while
+// calling in is deadlock-free by ordering.
+class BeatTable {
+ public:
+  struct Beat {
+    int64_t last_ms = -1;          // any heartbeat
+    int64_t last_joining_ms = -1;  // heartbeat with joining=true
+    // Operational counters piggybacked on beats (see proto heal_count),
+    // surfaced on the dashboard / status.json per member.
+    int64_t heal_count = 0;
+    int64_t committed_steps = 0;
+    int64_t aborted_steps = 0;
+  };
+
+  void record(const std::string& id, int64_t now, bool joining,
+              int64_t heal_count, int64_t committed, int64_t aborted);
+  // Adopt a replicated beat (standby): timestamps are pre-anchored by the
+  // caller; never moves an existing record backwards.
+  void adopt(const std::string& id, int64_t last_ms, int64_t last_joining_ms);
+  // Adopt a replicated farewell: records departure WITHOUT erasing a live
+  // beat the standby heard directly after the snapshot was taken.
+  void adopt_departed(const std::string& id, int64_t departed_ms);
+  void farewell(const std::string& id, int64_t now);
+  // Visit every farewell record (for replication).
+  void for_each_departed(
+      const std::function<void(const std::string&, int64_t)>& fn) const;
+  // A join is proof of life: clears any stale farewell for this id.
+  void revive(const std::string& id);
+  bool lookup(const std::string& id, Beat* out) const;
+  // max(last_ms, last_joining_ms); -1 when no record (incl. farewell'd).
+  int64_t latest_ms(const std::string& id) const;
+  bool departed(const std::string& id) const;
+  // Visit every live beat record (shard at a time; the callback must not
+  // re-enter this table).
+  void for_each(
+      const std::function<void(const std::string&, const Beat&)>& fn) const;
+  // Drop records staler than keep_ms unless the id is in keep_ids.
+  void prune(int64_t now, int64_t keep_ms, const std::set<std::string>& keep);
+
+ private:
+  static constexpr size_t kShards = 16;
+  struct Shard {
+    mutable std::mutex mu;
+    std::map<std::string, Beat> beats;
+    std::map<std::string, int64_t> departed;  // clean goodbyes: farewell ms
+  };
+  Shard& shard_for(const std::string& id) {
+    return shards_[std::hash<std::string>{}(id) % kShards];
+  }
+  const Shard& shard_for(const std::string& id) const {
+    return shards_[std::hash<std::string>{}(id) % kShards];
+  }
+  std::array<Shard, kShards> shards_;
 };
 
 class Lighthouse {
@@ -82,10 +170,19 @@ class Lighthouse {
   bool handle(uint8_t method, const std::string& req, std::string* resp,
               std::string* err);
   std::string handle_http(const std::string& request);
+  bool handle_quorum(const LighthouseQuorumRequest& r,
+                     LighthouseQuorumResponse* out, std::string* err);
+  void record_beat(const LighthouseHeartbeatRequest& r);
   // Requires mu_ held. Forms a quorum if valid; returns true if one formed.
   bool tick();
   bool quorum_valid_locked() const;
+  // Requires mu_ held: can `id`'s request at `step` be served from the
+  // cached decision? See docs/design/control_plane.md for the rules.
+  bool fast_eligible_locked(const std::string& id, int64_t step) const;
   void status_locked(StatusResponse* out) const;
+  void fill_response_locked(LighthouseQuorumResponse* out, bool fast) const;
+  void replicate_loop();
+  void adopt_replica_state(const ReplicateResponse& r);
 
   LighthouseOpt opt_;
   mutable std::mutex mu_;
@@ -109,27 +206,58 @@ class Lighthouse {
   // epoch << 8 (see lighthouse.cc) leaves 256 id bumps per MILLISECOND
   // of incarnation overlap while guaranteeing the new one starts
   // strictly higher — ms, not seconds, because a supervisor can respawn
-  // within the same second.
+  // within the same second. (A warm STANDBY instead adopts the primary's
+  // id exactly: it continues the live sequence, and minting a fresh id
+  // for unchanged membership would force the pointless ring rebuild the
+  // standby exists to avoid.)
   int64_t quorum_id_ = 0;
+  // This incarnation's identity = the boot-time quorum_id seed, frozen at
+  // construction. Shipped in ReplicateResponse so a standby can tell "the
+  // primary restarted" (epoch counter reset) from "a stale poll".
+  int64_t boot_id_ = 0;
+  // The incarnation the standby last adopted from (0 = none yet).
+  int64_t primary_boot_id_ = 0;
   int64_t broadcast_seq_ = 0;
-  struct Beat {
-    int64_t last_ms = -1;          // any heartbeat
-    int64_t last_joining_ms = -1;  // heartbeat with joining=true
-    // Operational counters piggybacked on beats (see proto heal_count),
-    // surfaced on the dashboard / status.json per member.
-    int64_t heal_count = 0;
-    int64_t committed_steps = 0;
-    int64_t aborted_steps = 0;
-  };
-  std::map<std::string, Beat> heartbeats_;  // replica_id -> last seen
-  // Clean goodbyes (leaving-flagged beats). A missing member is *provably*
-  // gone only if it farewell'd or its beats went stale; a member that never
-  // beat at all gets the plain join-timeout benefit of the doubt (it may be
-  // a non-beating client racing its first join). replica_id -> farewell ms.
-  std::map<std::string, int64_t> departed_;
+  // Monotonic decision counter (see Quorum.epoch): bumps on every slow-path
+  // formation and every fast-path serve.
+  int64_t epoch_ = 0;
+  // Highest step any fast-path serve answered. A pending joiner only blocks
+  // fast serves for steps ABOVE this mark: the current step generation is
+  // allowed to complete fast (mixing fast-served and parked members within
+  // one step would deadlock the parked member against the served member's
+  // collective), and the joiner is picked up by the next generation's slow
+  // round.
+  int64_t fast_round_step_ = -1;
+  int64_t fast_path_hits_ = 0;
+  int64_t slow_path_served_ = 0;
+  int64_t slow_path_rounds_ = 0;
+  // Previous-quorum membership as a set (updated at each formation /
+  // adoption); lets the fast path and beat handling test membership without
+  // scanning the proto.
+  std::set<std::string> prev_ids_;
+  // Registered warm standby (learned from ReplicateRequest), advertised in
+  // every quorum response.
+  std::string standby_addr_;
+  BeatTable beats_;
   bool shutdown_ = false;
 
+  // Standby machinery. promoted_ is true from birth on a primary; on a
+  // standby it flips once the primary is provably dead and gates Quorum
+  // serving (the split-brain fence: serving while the primary is alive
+  // would fork the job into two quorum arbiters). Promotion requires TWO
+  // independent observers: the standby's own replication polls failing
+  // (armed), AND a manager demonstrating primary-unreachability by
+  // dialing our fence with a Quorum attempt (corroborated) — the connect
+  // layer cannot distinguish "listener gone" from "packets dropped", so
+  // a standby-side partition alone must never promote (managers that can
+  // still reach the primary never dial us).
+  std::atomic<bool> promoted_{true};
+  int64_t last_primary_ok_ms_ = 0;
+  int64_t primary_poll_failures_ = 0;
+  std::atomic<int64_t> last_fenced_quorum_ms_{-1};
+
   std::thread tick_thread_;
+  std::thread replicate_thread_;
   std::unique_ptr<RpcServer> server_;
 };
 

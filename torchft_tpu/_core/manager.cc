@@ -11,12 +11,71 @@
 namespace torchft_tpu {
 
 ManagerServer::ManagerServer(const ManagerOpt& opt) : opt_(opt) {
+  // lighthouse_addr may be a comma-separated candidate list
+  // ("primary,standby"); a standby learned from quorum responses is
+  // appended at runtime (see rotate_lighthouse_locked).
+  {
+    std::string rest = opt_.lighthouse_addr;
+    while (!rest.empty()) {
+      size_t comma = rest.find(',');
+      std::string one =
+          comma == std::string::npos ? rest : rest.substr(0, comma);
+      rest = comma == std::string::npos ? "" : rest.substr(comma + 1);
+      // Trim surrounding spaces.
+      size_t b = one.find_first_not_of(' ');
+      size_t e = one.find_last_not_of(' ');
+      if (b != std::string::npos)
+        lighthouse_candidates_.push_back(one.substr(b, e - b + 1));
+    }
+    if (lighthouse_candidates_.empty())
+      lighthouse_candidates_.push_back(opt_.lighthouse_addr);
+  }
   server_ = std::make_unique<RpcServer>(
       opt.bind,
       [this](uint8_t m, const std::string& req, std::string* resp,
              std::string* err) { return handle(m, req, resp, err); },
       [this](const std::string& req) { return handle_http(req); });
   heartbeat_thread_ = std::thread([this] { heartbeat_loop(); });
+}
+
+std::string ManagerServer::current_lighthouse_locked() const {
+  return lighthouse_candidates_[lh_idx_ % lighthouse_candidates_.size()];
+}
+
+void ManagerServer::rotate_lighthouse_locked(const std::string& failed_addr) {
+  // Fold the learned standby into the candidate ring lazily (quorum
+  // responses can race its registration; dedup keeps the ring stable).
+  if (!learned_standby_.empty()) {
+    bool known = false;
+    for (const auto& a : lighthouse_candidates_)
+      if (a == learned_standby_) known = true;
+    if (!known) lighthouse_candidates_.push_back(learned_standby_);
+  }
+  if (lighthouse_candidates_.size() < 2) return;  // nowhere to go
+  // CAS-style: only advance if the caller failed against the endpoint we
+  // are still pointed at — the quorum and heartbeat loops both rotate, and
+  // blindly advancing twice would skip the live standby back to the
+  // corpse.
+  if (current_lighthouse_locked() != failed_addr) return;
+  lh_idx_ = (lh_idx_ + 1) % lighthouse_candidates_.size();
+  lighthouse_redials_++;
+  fprintf(stderr,
+          "torchft_tpu manager [%s]: lighthouse %s unreachable; re-dialing "
+          "%s (redial #%lld)\n",
+          opt_.replica_id.c_str(), failed_addr.c_str(),
+          current_lighthouse_locked().c_str(),
+          (long long)lighthouse_redials_);
+  fflush(stderr);
+}
+
+int64_t ManagerServer::lighthouse_redials() const {
+  std::lock_guard<std::mutex> lk(mu_);
+  return lighthouse_redials_;
+}
+
+std::string ManagerServer::lighthouse_addr() const {
+  std::lock_guard<std::mutex> lk(mu_);
+  return current_lighthouse_locked();
 }
 
 void ManagerServer::set_status(const std::string& metrics_json,
@@ -65,11 +124,13 @@ std::string ManagerServer::address() const {
 
 void ManagerServer::shutdown() {
   std::shared_ptr<RpcClient> inflight;
+  std::string lh_addr;
   {
     std::lock_guard<std::mutex> lk(mu_);
     if (shutdown_) return;
     shutdown_ = true;
     inflight = lighthouse_inflight_;
+    lh_addr = current_lighthouse_locked();
   }
   if (inflight) inflight->cancel();
   cv_.notify_all();
@@ -78,7 +139,7 @@ void ManagerServer::shutdown() {
   // next quorum cut is not deferred by our still-fresh heartbeats (clean
   // shutdowns say goodbye; crashes rely on staleness). Best-effort.
   try {
-    RpcClient c(opt_.lighthouse_addr, 1'000);
+    RpcClient c(lh_addr, 1'000);
     LighthouseHeartbeatRequest r;
     r.set_replica_id(opt_.replica_id);
     r.set_leaving(true);
@@ -91,11 +152,22 @@ void ManagerServer::shutdown() {
 
 void ManagerServer::heartbeat_loop() {
   // Periodic liveness signal to the lighthouse (reference
-  // src/manager.rs:148-159; only visualized there, same here).
+  // src/manager.rs:148-159; visualized only there — here it is
+  // load-bearing: grace, eviction, and fast-path eligibility all read it).
+  //
+  // Coalesced cadence: in steady state the quorum RPC piggybacks our beat
+  // every step, so this thread only needs to KEEP the record fresh across
+  // long steps/stalls — it relaxes to the lighthouse-advertised keepalive
+  // interval whenever the last round rode the fast path and no join is in
+  // flight, and skips a send entirely while a piggybacked beat is recent.
+  // During churn (slow rounds, quorum in flight) it stays at the full
+  // heartbeat_ms cadence: that is when grace/staleness decisions need
+  // prompt signals.
   std::unique_ptr<RpcClient> client;
   while (true) {
     bool joining;
-    int64_t heals, committed, aborted;
+    int64_t heals, committed, aborted, cadence, last_ok;
+    std::string addr;
     {
       std::unique_lock<std::mutex> lk(mu_);
       cv_.wait_for(lk, std::chrono::milliseconds(opt_.heartbeat_ms));
@@ -104,10 +176,19 @@ void ManagerServer::heartbeat_loop() {
       heals = heal_count_;
       committed = committed_steps_;
       aborted = aborted_steps_;
+      cadence = opt_.heartbeat_ms;
+      if (!joining && last_fast_path_ && keepalive_ms_ > cadence)
+        cadence = keepalive_ms_;
+      last_ok = last_beat_ok_ms_;
+      addr = current_lighthouse_locked();
     }
+    if (last_ok > 0 && now_ms() - last_ok < cadence)
+      continue;  // a beat (possibly piggybacked on a quorum RPC) is recent
     try {
-      if (!client)
-        client = std::make_unique<RpcClient>(opt_.lighthouse_addr, 1'000);
+      if (!client || client->address() != addr) {
+        client.reset();
+        client = std::make_unique<RpcClient>(addr, 1'000);
+      }
       LighthouseHeartbeatRequest r;
       r.set_replica_id(opt_.replica_id);
       r.set_joining(joining);
@@ -115,12 +196,23 @@ void ManagerServer::heartbeat_loop() {
       r.set_committed_steps(committed);
       r.set_aborted_steps(aborted);
       std::string resp, err;
-      if (!client->call(kLighthouseHeartbeat, r.SerializeAsString(), &resp,
-                        &err, 1'000))
+      if (client->call(kLighthouseHeartbeat, r.SerializeAsString(), &resp,
+                       &err, 1'000)) {
+        std::lock_guard<std::mutex> lk(mu_);
+        last_beat_ok_ms_ = now_ms();
+      } else {
         client.reset();
+      }
     } catch (...) {
       client.reset();
     }
+    // Deliberately NO rotation from this loop: beats are best-effort, and
+    // this 1s deadline trips on a primary that is merely stalled. Only
+    // the quorum path (5s deadline, the RPC that actually matters)
+    // rotates — which also keeps the standby's promotion corroboration
+    // honest: a Quorum dial against its fence can only mean a manager's
+    // QUORUM path to the primary failed, not a lost heartbeat. This loop
+    // follows any rotation via current_lighthouse_locked() above.
   }
 }
 
@@ -241,6 +333,25 @@ bool ManagerServer::handle_quorum(const ManagerQuorumRequest& r,
     self.set_step(r.step());
     self.set_world_size(opt_.world_size);
     quorum_inflight_++;
+    // Steady state (previous round rode the fast path): skip the announce
+    // RPC below — we are a settled member, the split-quorum guard it arms
+    // protects JOINERS, and the quorum RPC itself piggybacks our beat. This
+    // halves steady-state control RPCs per group per step.
+    bool skip_announce = last_fast_path_;
+    // Coalesced heartbeat: the quorum request carries our beat (joining
+    // flag + the operational counters the standalone beat sends), so the
+    // lighthouse's liveness record refreshes once per step for free.
+    LighthouseQuorumRequest lr;
+    *lr.mutable_requester() = self;
+    {
+      auto* beat = lr.mutable_beat();
+      beat->set_replica_id(opt_.replica_id);
+      beat->set_joining(true);
+      beat->set_heal_count(heal_count_);
+      beat->set_committed_steps(committed_steps_);
+      beat->set_aborted_steps(aborted_steps_);
+    }
+    std::string announce_addr = current_lighthouse_locked();
     lk.unlock();
 
     // Announce intent BEFORE the quorum RPC: a synchronous joining-flagged
@@ -250,15 +361,17 @@ bool ManagerServer::handle_quorum(const ManagerQuorumRequest& r,
     // fresh replica_id that no previous-quorum grace covers) defers until
     // our join arrives. Failure is non-fatal: the quorum loop below retries
     // against the same lighthouse anyway.
-    try {
-      RpcClient announce(opt_.lighthouse_addr, 2'000);
-      LighthouseHeartbeatRequest hb;
-      hb.set_replica_id(opt_.replica_id);
-      hb.set_joining(true);
-      std::string hresp, herr;
-      announce.call(kLighthouseHeartbeat, hb.SerializeAsString(), &hresp,
-                    &herr, 2'000);
-    } catch (...) {
+    if (!skip_announce) {
+      try {
+        RpcClient announce(announce_addr, 2'000);
+        LighthouseHeartbeatRequest hb;
+        hb.set_replica_id(opt_.replica_id);
+        hb.set_joining(true);
+        std::string hresp, herr;
+        announce.call(kLighthouseHeartbeat, hb.SerializeAsString(), &hresp,
+                      &herr, 2'000);
+      } catch (...) {
+      }
     }
 
     // The lighthouse legitimately parks this RPC until quorum forms (up to
@@ -266,25 +379,30 @@ bool ManagerServer::handle_quorum(const ManagerQuorumRequest& r,
     // deadlines and re-join on timeout — the lighthouse treats a re-join as
     // an overwrite of the same participant, and bounded calls keep this
     // thread cancellable by shutdown() (a deadline-less call here would
-    // deadlock shutdown against the parked connection).
-    Quorum quorum;
+    // deadlock shutdown against the parked connection). Transport failures
+    // rotate to the next lighthouse candidate (warm-standby failover): the
+    // standby serves the SAME membership under the SAME quorum_id, so the
+    // in-flight step commits without a ring rebuild. An unpromoted
+    // standby's "not serving" refusal is transient — retry, rotating back
+    // toward the primary.
+    LighthouseQuorumResponse lout;
     std::string rpc_err;
     bool ok = false;
     std::shared_ptr<RpcClient> client;
-    LighthouseQuorumRequest lr;
-    *lr.mutable_requester() = self;
     const std::string payload = lr.SerializeAsString();
     while (!ok) {
+      std::string addr;
       {
         std::lock_guard<std::mutex> g(mu_);
         if (shutdown_) {
           rpc_err = "manager shutting down";
           break;
         }
+        addr = current_lighthouse_locked();
       }
       try {
-        if (!client) {
-          client = std::make_shared<RpcClient>(opt_.lighthouse_addr, 2'000);
+        if (!client || client->address() != addr) {
+          client = std::make_shared<RpcClient>(addr, 2'000);
           std::lock_guard<std::mutex> g(mu_);
           lighthouse_inflight_ = client;
           if (shutdown_) client->cancel();
@@ -292,9 +410,7 @@ bool ManagerServer::handle_quorum(const ManagerQuorumRequest& r,
         std::string resp;
         if (client->call(kLighthouseQuorum, payload, &resp, &rpc_err,
                          5'000)) {
-          LighthouseQuorumResponse lout;
           if (lout.ParseFromString(resp)) {
-            quorum = lout.quorum();
             ok = true;
           } else {
             rpc_err = "bad LighthouseQuorumResponse";
@@ -302,10 +418,32 @@ bool ManagerServer::handle_quorum(const ManagerQuorumRequest& r,
           }
         } else if (rpc_err == "transport: cancelled") {
           break;
+        } else if (rpc_err.rfind("transport:", 0) == 0) {
+          // Dead/black-holed endpoint (read timeout counts: the 5s bound
+          // above already exceeds any legitimate fast-path serve, and a
+          // parked slow round re-joins idempotently wherever we land).
+          client.reset();
+          std::lock_guard<std::mutex> g(mu_);
+          rotate_lighthouse_locked(addr);
+        } else {
+          // Application refusal: an unpromoted standby fencing us off, or
+          // a lighthouse shutting down for replacement. Rotate and retry
+          // after a short backoff — the fence clears once the standby
+          // observes the primary's death.
+          client.reset();
+          {
+            std::lock_guard<std::mutex> g(mu_);
+            rotate_lighthouse_locked(addr);
+          }
+          usleep(100'000);
         }
       } catch (const std::exception& e) {
         rpc_err = e.what();
         client.reset();
+        {
+          std::lock_guard<std::mutex> g(mu_);
+          rotate_lighthouse_locked(addr);
+        }
         usleep(200'000);  // lighthouse unreachable; back off
       }
     }
@@ -316,7 +454,14 @@ bool ManagerServer::handle_quorum(const ManagerQuorumRequest& r,
     if (!ok) {
       round->error = "lighthouse quorum failed: " + rpc_err;
     } else {
-      round->quorum = quorum;
+      round->quorum = lout.quorum();
+      round->fast_path = lout.fast_path();
+      last_fast_path_ = lout.fast_path();
+      keepalive_ms_ = lout.keepalive_ms();
+      last_beat_ok_ms_ = now_ms();  // the request piggybacked our beat
+      if (!lout.standby_address().empty() &&
+          lout.standby_address() != learned_standby_)
+        learned_standby_ = lout.standby_address();
       // Refresh the healing registry for this quorum.
       checkpoint_addrs_.clear();
       for (const auto& [rank, addr] : round->joined)
@@ -366,6 +511,8 @@ bool ManagerServer::compute_response(const QuorumRound& round, int64_t rank,
   // healing traffic and store rendezvous load.
   const QuorumMember* primary = max_parts[rank % (int64_t)max_parts.size()];
   out->set_quorum_id(round.quorum.quorum_id());
+  out->set_fast_path(round.fast_path);
+  out->set_epoch(round.quorum.epoch());
   out->set_recover_manager_address(primary->address());
   // Rendezvous store for this rank's cross-group communicator = the
   // primary's store, namespaced by quorum_id downstream (the PrefixStore

@@ -18,6 +18,7 @@
 #include <set>
 #include <string>
 #include <thread>
+#include <vector>
 
 #include "rpc.h"
 #include "torchft.pb.h"
@@ -26,6 +27,12 @@ namespace torchft_tpu {
 
 struct ManagerOpt {
   std::string replica_id;
+  // One address, or a comma-separated candidate list ("primary,standby"):
+  // the manager dials the first and rotates to the next on transport
+  // failure (lighthouse death). A warm standby learned from quorum
+  // responses (LighthouseQuorumResponse.standby_address) is appended to
+  // the candidates automatically, so a single-address config still fails
+  // over once the primary has introduced its standby.
   std::string lighthouse_addr;
   std::string bind = "0.0.0.0:0";
   // Address advertised to peers (defaults to the bound address).
@@ -57,6 +64,13 @@ class ManagerServer {
   void set_status(const std::string& metrics_json, int64_t heal_count,
                   int64_t committed_steps, int64_t aborted_steps);
 
+  // Times this manager re-dialed a DIFFERENT lighthouse endpoint (primary
+  // death -> standby, or rotation through a configured candidate list).
+  // Surfaced in Manager.metrics() as `lighthouse_redials`.
+  int64_t lighthouse_redials() const;
+  // The lighthouse endpoint currently dialed (observability).
+  std::string lighthouse_addr() const;
+
  private:
   bool handle(uint8_t method, const std::string& req, std::string* resp,
               std::string* err);
@@ -69,7 +83,7 @@ class ManagerServer {
 
   ManagerOpt opt_;
 
-  std::mutex mu_;
+  mutable std::mutex mu_;
   std::condition_variable cv_;
   bool shutdown_ = false;
 
@@ -91,6 +105,7 @@ class ManagerServer {
     bool in_flight = false;  // lighthouse RPC running
     bool done = false;
     Quorum quorum;
+    bool fast_path = false;  // the lighthouse served this round from cache
     std::string error;
   };
   std::map<int64_t, std::shared_ptr<QuorumRound>> quorum_rounds_;  // by step
@@ -120,6 +135,27 @@ class ManagerServer {
   // split-quorum guard armed if our join parks longer than
   // heartbeat_fresh_ms (see LighthouseHeartbeatRequest.joining).
   int64_t quorum_inflight_ = 0;
+
+  // --- lighthouse endpoint rotation (warm-standby failover) -------------
+  // Candidates = the configured comma-list plus any standby learned from
+  // quorum responses; lh_idx_ indexes the current endpoint. All guarded by
+  // mu_. rotate is CAS-style (only advances when the caller still observes
+  // the endpoint it failed against) so the quorum and heartbeat loops
+  // cannot double-rotate past the live standby on one death.
+  std::vector<std::string> lighthouse_candidates_;
+  size_t lh_idx_ = 0;
+  std::string learned_standby_;
+  int64_t lighthouse_redials_ = 0;
+  // Coalesced-heartbeat state: keepalive cadence advertised by the
+  // lighthouse, whether the last quorum answer rode the fast path (steady
+  // state), and when our beat last reached the lighthouse (quorum
+  // piggybacks count — that is the point).
+  int64_t keepalive_ms_ = 0;
+  bool last_fast_path_ = false;
+  int64_t last_beat_ok_ms_ = 0;
+  // Requires mu_: current endpoint / CAS rotation.
+  std::string current_lighthouse_locked() const;
+  void rotate_lighthouse_locked(const std::string& failed_addr);
 
   // Last status push from the Python layer (see set_status).
   std::string metrics_json_;

@@ -33,6 +33,7 @@ enum Method : uint8_t {
   kLighthouseQuorum = 1,
   kLighthouseHeartbeat = 2,
   kLighthouseStatus = 3,
+  kLighthouseReplicate = 4,
   kManagerQuorum = 10,
   kManagerCheckpointAddress = 11,
   kManagerShouldCommit = 12,

@@ -43,14 +43,18 @@ def _build_native() -> None:
 
 
 def _stale() -> bool:
-    """True when any C++ source/header/proto is newer than the built .so —
-    calling a stale library through changed ctypes signatures is an ABI
-    mismatch (garbage args or a segfault), so rebuild instead."""
+    """True when any C++ source/header/proto — or the build config — is
+    newer than the built .so: calling a stale library through changed
+    ctypes signatures is an ABI mismatch (garbage args or a segfault), so
+    rebuild instead. CMakeLists.txt is part of the scan because a
+    build-config edit (new source file, changed flags/defines) also
+    changes what the .so SHOULD contain while leaving every .cc/.h mtime
+    older than the stale artifact."""
     if not os.path.exists(_LIB_PATH):
         return True
     built = os.path.getmtime(_LIB_PATH)
     for name in os.listdir(_CORE_DIR):
-        if name.endswith((".cc", ".h")):
+        if name.endswith((".cc", ".h")) or name == "CMakeLists.txt":
             if os.path.getmtime(os.path.join(_CORE_DIR, name)) > built:
                 return True
     proto = os.path.join(_CORE_DIR, "proto", "torchft.proto")
@@ -94,7 +98,7 @@ def _load() -> ctypes.CDLL:
     lib.tft_free.restype = None
 
     lib.tft_lighthouse_new.argtypes = [c, u64, i64, i64, i64, i64, i64, c,
-                                       ctypes.POINTER(vp)]
+                                       i32, c, i64, ctypes.POINTER(vp)]
     lib.tft_lighthouse_new.restype = vp
     lib.tft_lighthouse_address.argtypes = [vp]
     lib.tft_lighthouse_address.restype = vp
@@ -110,6 +114,10 @@ def _load() -> ctypes.CDLL:
     lib.tft_manager_free.argtypes = [vp]
     lib.tft_manager_set_status.argtypes = [vp, c, i64, i64, i64]
     lib.tft_manager_set_status.restype = None
+    lib.tft_manager_lighthouse_redials.argtypes = [vp]
+    lib.tft_manager_lighthouse_redials.restype = i64
+    lib.tft_manager_lighthouse_addr.argtypes = [vp]
+    lib.tft_manager_lighthouse_addr.restype = vp
 
     lib.tft_store_new.argtypes = [c, ctypes.POINTER(vp)]
     lib.tft_store_new.restype = vp
@@ -163,6 +171,8 @@ class _CQuorumResult(ctypes.Structure):
         ("replica_rank", ctypes.c_int64),
         ("replica_world_size", ctypes.c_int64),
         ("heal", ctypes.c_int32),
+        ("fast_path", ctypes.c_int32),
+        ("epoch", ctypes.c_int64),
     ]
 
 
@@ -208,7 +218,10 @@ class Lighthouse:
                  heartbeat_fresh_ms: int = 500,
                  heartbeat_grace_factor: int = 4,
                  eviction_staleness_factor: int = 3,
-                 auth_token: str = ""):
+                 auth_token: str = "",
+                 fast_path: bool = True,
+                 standby_of: str = "",
+                 replicate_ms: int = 100):
         """``heartbeat_fresh_ms``/``heartbeat_grace_factor``: a previous
         member absent from the join round but heartbeating within
         ``heartbeat_fresh_ms`` extends the straggler wait to
@@ -224,7 +237,22 @@ class Lighthouse:
         group stalls survivors for the full join timeout).
 
         ``auth_token``: shared job secret forwarded in dashboard Kill RPCs
-        so token-gated managers accept them."""
+        so token-gated managers accept them.
+
+        ``fast_path``: membership-unchanged fast path
+        (docs/design/control_plane.md) — when every member of the previous
+        quorum is provably live (beats within the eviction staleness
+        bound) and no joiner is pending, a Quorum RPC returns the cached
+        decision with a bumped epoch immediately instead of parking in the
+        tick-loop rendezvous. Any membership delta falls back to the slow
+        path, so quorum semantics are unchanged. False restores strict
+        reference behavior.
+
+        ``standby_of``: non-empty = run as a WARM STANDBY of the primary
+        lighthouse at this address — replicate its quorum state every
+        ``replicate_ms``, refuse Quorum RPCs until the primary is provably
+        dead, then promote and serve the same membership under the SAME
+        quorum_id so managers re-dial mid-step without a ring rebuild."""
         err = ctypes.c_void_p()
         self._h = _check_handle(
             lib().tft_lighthouse_new(bind.encode(), min_replicas,
@@ -233,6 +261,8 @@ class Lighthouse:
                                      heartbeat_grace_factor,
                                      eviction_staleness_factor,
                                      auth_token.encode(),
+                                     1 if fast_path else 0,
+                                     standby_of.encode(), replicate_ms,
                                      ctypes.byref(err)), err)
 
     def address(self) -> str:
@@ -285,6 +315,17 @@ class ManagerServer:
         lib().tft_manager_set_status(self._h, metrics_json.encode(),
                                      heal_count, committed_steps,
                                      aborted_steps)
+
+    def lighthouse_redials(self) -> int:
+        """Times this manager re-dialed a DIFFERENT lighthouse endpoint
+        (primary death -> warm standby, or rotation through a
+        comma-separated ``lighthouse_addr`` candidate list). Rides
+        ``Manager.metrics()`` as ``lighthouse_redials``."""
+        return int(lib().tft_manager_lighthouse_redials(self._h))
+
+    def lighthouse_addr(self) -> str:
+        """The lighthouse endpoint currently dialed (observability)."""
+        return _take_str(lib().tft_manager_lighthouse_addr(self._h))
 
     def shutdown(self) -> None:
         if self._h:
@@ -432,8 +473,11 @@ class StoreClient(_RetryingNativeClient):
 
 @dataclass
 class QuorumResult:
-    """The 9-field quorum view a rank receives each step (reference
-    ``ManagerQuorumResponse``, ``proto/torchft.proto:77-89``)."""
+    """The quorum view a rank receives each step (reference
+    ``ManagerQuorumResponse``, ``proto/torchft.proto:77-89``), plus the
+    control-plane provenance pair: ``fast_path`` (this round was served
+    from the lighthouse's membership-unchanged cache) and ``epoch`` (the
+    lighthouse's monotonic decision counter)."""
 
     quorum_id: int
     recover_manager_address: str
@@ -444,6 +488,8 @@ class QuorumResult:
     replica_rank: int
     replica_world_size: int
     heal: bool
+    fast_path: bool = False
+    epoch: int = 0
 
 
 class ManagerClient(_RetryingNativeClient):
@@ -494,6 +540,8 @@ class ManagerClient(_RetryingNativeClient):
             replica_rank=res.replica_rank,
             replica_world_size=res.replica_world_size,
             heal=bool(res.heal),
+            fast_path=bool(res.fast_path),
+            epoch=res.epoch,
         )
 
     def checkpoint_address(self, rank: int, timeout_ms: int = 10_000) -> str:

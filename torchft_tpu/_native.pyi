@@ -19,6 +19,9 @@ class Lighthouse:
         heartbeat_grace_factor: int = ...,
         eviction_staleness_factor: int = ...,
         auth_token: str = ...,
+        fast_path: bool = ...,
+        standby_of: str = ...,
+        replicate_ms: int = ...,
     ) -> None: ...
     def address(self) -> str: ...
     def status(self, timeout_ms: int = ...) -> dict: ...
@@ -43,6 +46,8 @@ class ManagerServer:
         committed_steps: int = ...,
         aborted_steps: int = ...,
     ) -> None: ...
+    def lighthouse_redials(self) -> int: ...
+    def lighthouse_addr(self) -> str: ...
     def shutdown(self) -> None: ...
 
 class Store:
@@ -68,6 +73,8 @@ class QuorumResult:
     replica_rank: int
     replica_world_size: int
     heal: bool
+    fast_path: bool = ...
+    epoch: int = ...
 
 class ManagerClient:
     def __init__(self, address: str, connect_timeout_ms: int = ...,

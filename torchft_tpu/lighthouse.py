@@ -46,6 +46,23 @@ def main(argv: list[str] | None = None) -> None:
                         default=os.environ.get("TORCHFT_AUTH_TOKEN", ""),
                         help="shared job secret forwarded in dashboard "
                         "Kill RPCs (env TORCHFT_AUTH_TOKEN)")
+    parser.add_argument("--no-fast-path", action="store_true",
+                        help="disable the membership-unchanged quorum fast "
+                        "path (cached decision + bumped epoch; see "
+                        "docs/design/control_plane.md) — every Quorum RPC "
+                        "then parks in the tick-loop rendezvous")
+    parser.add_argument("--standby-of", default="",
+                        help="run as a WARM STANDBY of the primary "
+                        "lighthouse at this host:port: replicate its "
+                        "quorum state, refuse Quorum RPCs until it is "
+                        "provably dead, then promote with the same "
+                        "quorum_id (managers re-dial without a ring "
+                        "rebuild)")
+    parser.add_argument("--replicate-ms", type=int, default=100,
+                        help="standby replication poll interval")
+    parser.add_argument("--address-file", default="",
+                        help="write the bound host:port to this file once "
+                        "listening (for scripts/tests that bind port 0)")
     args = parser.parse_args(argv)
 
     logging.basicConfig(level=logging.INFO)
@@ -58,9 +75,19 @@ def main(argv: list[str] | None = None) -> None:
         heartbeat_grace_factor=args.heartbeat_grace_factor,
         eviction_staleness_factor=args.eviction_staleness_factor,
         auth_token=args.auth_token,
+        fast_path=not args.no_fast_path,
+        standby_of=args.standby_of,
+        replicate_ms=args.replicate_ms,
     )
-    logging.info("lighthouse listening on %s (dashboard: http://%s/)",
-                 lh.address(), lh.address())
+    if args.address_file:
+        tmp = args.address_file + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(lh.address())
+        os.replace(tmp, args.address_file)  # readers never see a torn write
+    logging.info("lighthouse listening on %s (dashboard: http://%s/)%s",
+                 lh.address(), lh.address(),
+                 f" [standby of {args.standby_of}]" if args.standby_of
+                 else "")
     stop = threading.Event()
     signal.signal(signal.SIGINT, lambda *_: stop.set())
     signal.signal(signal.SIGTERM, lambda *_: stop.set())

@@ -64,6 +64,45 @@ MANAGER_ADDR_KEY: str = "manager/addr"
 T = TypeVar("T")
 
 
+class _LatencyReservoir:
+    """Bounded reservoir (Vitter's algorithm R) over a latency stream, with
+    the max tracked exactly: p50/p95 stay statistically representative of
+    the WHOLE run at O(1) memory, while the worst case is never sampled
+    away. Callers synchronize (the Manager mutates it under its metrics
+    lock); seeded RNG so two identically-driven managers report identical
+    percentiles."""
+
+    def __init__(self, size: int = 256, seed: int = 0xA5) -> None:
+        import random
+
+        self._size = size
+        self._samples: list[float] = []
+        self._n = 0
+        self._max = 0.0
+        self._rng = random.Random(seed)
+
+    def add(self, value_ms: float) -> None:
+        self._n += 1
+        self._max = max(self._max, value_ms)
+        if len(self._samples) < self._size:
+            self._samples.append(value_ms)
+        else:
+            j = self._rng.randrange(self._n)
+            if j < self._size:
+                self._samples[j] = value_ms
+
+    def percentiles(self) -> Dict[str, float]:
+        """``{p50, p95, max}`` in ms (zeros before the first sample)."""
+        if not self._samples:
+            return {"p50": 0.0, "p95": 0.0, "max": 0.0}
+        s = sorted(self._samples)
+        return {
+            "p50": s[len(s) // 2],
+            "p95": s[min(len(s) - 1, int(len(s) * 0.95))],
+            "max": self._max,
+        }
+
+
 class WorldSizeMode(Enum):
     """How the participating world reacts to membership changes (reference
     ``manager.py:55-70``).
@@ -292,6 +331,15 @@ class Manager:
         # can't answer (how long do quorums take, how often do we heal).
         self._metrics: Dict[str, float] = {
             "quorum_count": 0, "quorum_ms_total": 0.0, "quorum_ms_last": 0.0,
+            # Control-plane scaling observability
+            # (docs/design/control_plane.md): rounds served from the
+            # lighthouse's membership-unchanged cache vs. full rendezvous
+            # rounds, and the lighthouse's monotonic decision epoch as of
+            # the last round. quorum_ms_p50/p95/max (from a bounded
+            # reservoir) and lighthouse_redials join them in metrics().
+            "quorum_fast_path_hits": 0,
+            "quorum_slow_path_rounds": 0,
+            "quorum_epoch_last": 0,
             "reconfigure_count": 0, "reconfigure_ms_total": 0.0,
             "heal_count": 0,
             "heal_ms_total": 0.0, "heal_bytes_total": 0.0,
@@ -367,6 +415,9 @@ class Manager:
             "ckpt_save_skipped": 0.0,
         }
         self._metrics_lock = threading.Lock()
+        # Quorum latency distribution (p50/p95/max in metrics()): bounded
+        # reservoir, mutated under the metrics lock on the quorum thread.
+        self._quorum_latency = _LatencyReservoir()
         # Unified transient-error retry policy + shared counters for every
         # transport client this Manager owns (store, manager RPC, heal
         # fetch). The counters ride metrics()/metrics.json so a degraded-
@@ -588,9 +639,18 @@ class Manager:
             timeout_ms=self._quorum_timeout_ms,
         )
         quorum_ms = (time.perf_counter() - t0) * 1e3
-        self._record(quorum_count=1, quorum_ms_total=quorum_ms)
+        # getattr: duck-typed/mocked clients in tests predate the
+        # fast_path/epoch fields.
+        fast = bool(getattr(q, "fast_path", False) is True)
+        self._record(quorum_count=1, quorum_ms_total=quorum_ms,
+                     quorum_fast_path_hits=1 if fast else 0,
+                     quorum_slow_path_rounds=0 if fast else 1)
         with self._metrics_lock:
             self._metrics["quorum_ms_last"] = quorum_ms
+            self._quorum_latency.add(quorum_ms)
+            epoch = getattr(q, "epoch", 0)
+            if isinstance(epoch, int):
+                self._metrics["quorum_epoch_last"] = epoch
 
         # Defense in depth against transport desync: a structurally-invalid
         # quorum (no members, or we're not in it) must be treated as a
@@ -1797,6 +1857,17 @@ class Manager:
         transports are observable while retries still absorb them."""
         with self._metrics_lock:
             out = dict(self._metrics)
+            pct = self._quorum_latency.percentiles()
+        out["quorum_ms_p50"] = pct["p50"]
+        out["quorum_ms_p95"] = pct["p95"]
+        out["quorum_ms_max"] = pct["max"]
+        # Lighthouse endpoint re-dials (warm-standby failover) live in the
+        # C++ manager server, which owns the lighthouse connection; merge
+        # them so a failover is visible in /metrics.json next to the
+        # fast/slow round split.
+        out["lighthouse_redials"] = (
+            float(self._manager_server.lighthouse_redials())
+            if self._manager_server is not None else 0.0)
         out.update(self._retry_stats.snapshot())
         # Bytes that actually crossed the TCP ring, counted by the
         # backend at its send sites (halved vs allreduce_wire_bytes_total
