@@ -42,8 +42,27 @@ def _materialize(tree) -> float:
     return float(jnp.sum(leaf))
 
 
+_BENCH_SCHEMA = "tft-bench-2"
+_PROVENANCE: Dict[str, Any] = {}
+
+
+def _provenance() -> Dict[str, Any]:
+    """Environment stamp carried by every emitted row, so BENCH_r* files
+    are comparable across rigs: the jax platform actually used, the jax
+    version, and a schema tag readers can dispatch on (rows predating
+    the stamp are schema v1)."""
+    if not _PROVENANCE:
+        _PROVENANCE.update({
+            "platform": jax.devices()[0].platform,
+            "device_kind": jax.devices()[0].device_kind,
+            "jax": jax.__version__,
+            "schema": _BENCH_SCHEMA,
+        })
+    return dict(_PROVENANCE)
+
+
 def _emit(obj: Dict[str, Any]) -> None:
-    print(json.dumps(obj), file=sys.stderr)
+    print(json.dumps({**obj, **_provenance()}), file=sys.stderr)
 
 
 # Peak dense matmul throughput per chip, bf16 (f32 is ~half). Sources:
@@ -951,6 +970,273 @@ def bench_heal_striped(payload_mb: float = 48.0, donors: int = 3,
     return out
 
 
+class _UplinkCapProxy:
+    """TCP proxy capping AGGREGATE egress across ALL connections at
+    ``mb_s`` — the node-uplink model the publish fan-out A/B needs.
+    :class:`_RateCapProxy` throttles each stream independently (the
+    per-donor model); a fan-out's bottleneck is the shared NIC, so here
+    every capped pump draws from one token bucket. On a loopback rig
+    the raw transfer is CPU-bound and 1-vs-N topologies would measure
+    core count; capping every node's egress identically makes the A/B
+    answer the design's question: with uplink-bounded nodes, does a
+    relay tier multiply subscriber capacity by tree width?"""
+
+    def __init__(self, target_addr: str, mb_s: float) -> None:
+        import socket as _socket
+        import urllib.parse as _up
+
+        u = _up.urlparse(target_addr)
+        self._thost, self._tport = u.hostname, u.port
+        self._path = u.path
+        self._rate = mb_s * 1e6
+        self._tokens = 0.0
+        self._last = time.perf_counter()
+        self._tlock = threading.Lock()
+        self._srv = _socket.create_server(("127.0.0.1", 0), backlog=128)
+        self._alive = True
+        t = threading.Thread(target=self._accept, daemon=True)
+        t.start()
+
+    def address(self) -> str:
+        host, port = self._srv.getsockname()[:2]
+        return f"http://{host}:{port}{self._path}"
+
+    def _take(self, want: int) -> int:
+        with self._tlock:
+            now = time.perf_counter()
+            self._tokens = min(self._tokens
+                               + (now - self._last) * self._rate,
+                               self._rate * 0.05)  # 50ms burst bound
+            self._last = now
+            got = int(min(self._tokens, want))
+            self._tokens -= got
+            return got
+
+    def _accept(self) -> None:
+        import socket as _socket
+
+        while self._alive:
+            try:
+                cli, _ = self._srv.accept()
+            except OSError:
+                return
+            try:
+                up = _socket.create_connection((self._thost, self._tport))
+            except OSError:
+                cli.close()
+                continue
+            for src, dst, capped in ((cli, up, False), (up, cli, True)):
+                threading.Thread(target=self._pump,
+                                 args=(src, dst, capped),
+                                 daemon=True).start()
+
+    def _pump(self, src, dst, capped: bool) -> None:
+        try:
+            while True:
+                data = src.recv(65536)
+                if not data:
+                    break
+                if not capped:
+                    dst.sendall(data)
+                    continue
+                sent = 0
+                while sent < len(data):
+                    k = self._take(len(data) - sent)
+                    if k == 0:
+                        time.sleep(0.002)
+                        continue
+                    dst.sendall(data[sent:sent + k])
+                    sent += k
+        except OSError:
+            pass
+        finally:
+            for s in (src, dst):
+                try:
+                    s.shutdown(2)
+                except OSError:
+                    pass
+
+    def shutdown(self) -> None:
+        self._alive = False
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+
+
+def bench_publish_fanout(payload_mb: float = 4.0, subscribers: int = 12,
+                         relays: int = 6, uplink_mb_s: float = 32.0,
+                         publishes: int = 4,
+                         capacity_secs: float = 3.0) -> Dict[str, float]:
+    """Weight-distribution tier A/B (docs/design/serving.md). Three
+    measurements, one dict:
+
+    * **publish-to-visible latency** (uncapped, long-polling
+      subscribers): p50/p95 across ``subscribers x publishes`` of
+      publish()-call → crc-verified atomic swap.
+    * **delta minimality**: a small-touch publish (1 of 12 leaves
+      changed) against a synced subscriber — wire bytes / full payload.
+    * **fan-out capacity, direct vs relay, uplink-capped**: every
+      node's egress capped at ``uplink_mb_s`` (:class:`_UplinkCapProxy`
+      — aggregate, not per-stream). Fresh-subscriber full syncs (the
+      "capacity" question: how many cold consumers/sec can the tier
+      sustain) hammer (a) the publisher directly, (b) ``relays`` relay
+      nodes fed by the same capped publisher. ``fanout_capacity_ratio``
+      = relay/direct aggregate delivered MB/s; the design target is
+      >= 4x (relay capacity grows with tree width; direct is pinned at
+      one uplink).
+
+    Pure-python transport (WeightPublisher/Subscriber/Relay over HTTP),
+    no native library needed."""
+    from torchft_tpu.retry import RetryPolicy
+    from torchft_tpu.serving import (PublicationServer, WeightPublisher,
+                                     WeightRelay, WeightSubscriber)
+
+    rng = np.random.default_rng(23)
+    n_leaves = 12
+    per = max(int(payload_mb * 1e6 / 4 / n_leaves), 1)
+    state = {f"l{i}": rng.normal(size=per).astype(np.float32)
+             for i in range(n_leaves)}
+    template = {f"l{i}": np.zeros(per, np.float32)
+                for i in range(n_leaves)}
+    pol = RetryPolicy(max_attempts=4, base_delay_ms=10.0, jitter=0.0)
+    out: Dict[str, float] = {
+        "payload_mbytes": per * 4 * n_leaves / 1e6,
+        "subscribers": subscribers, "relays": relays,
+        "uplink_cap_mb_s": uplink_mb_s, "publishes": publishes,
+    }
+
+    class _TimedSub(WeightSubscriber):
+        def __init__(self, *a, **kw):
+            super().__init__(*a, **kw)
+            self.seen: Dict[int, float] = {}
+
+        def _on_generation(self, held, body_digests):
+            self.seen[held.generation] = time.perf_counter()
+
+    # --- publish-to-visible latency (uncapped, long-poll) --------------
+    pub = WeightPublisher(keep_generations=2)
+    srv = PublicationServer(pub, bind_host="127.0.0.1")
+    subs = []
+    try:
+        pub.publish(state, step=0)
+        subs = [_TimedSub(srv.address(), template, retry_policy=pol,
+                          long_poll_s=10.0, poll_interval_s=0.02,
+                          name=f"p2v{i}").start()
+                for i in range(subscribers)]
+        deadline = time.monotonic() + 30
+        while any(s.generation() < 1 for s in subs):
+            if time.monotonic() > deadline:
+                raise TimeoutError("subscribers never reached gen 1")
+            time.sleep(0.01)
+        lat_ms = []
+        for k in range(publishes):
+            st = dict(state)
+            st[f"l{k % n_leaves}"] = st[f"l{k % n_leaves}"] + (k + 1)
+            t0 = time.perf_counter()
+            gen = pub.publish(st, step=k + 1)
+            deadline = time.monotonic() + 30
+            while any(gen not in s.seen for s in subs):
+                if time.monotonic() > deadline:
+                    raise TimeoutError(f"gen {gen} never fully visible")
+                time.sleep(0.005)
+            lat_ms += [(s.seen[gen] - t0) * 1e3 for s in subs]
+        lat_ms.sort()
+        out["publish_to_visible_p50_ms"] = lat_ms[len(lat_ms) // 2]
+        out["publish_to_visible_p95_ms"] = lat_ms[
+            min(int(len(lat_ms) * 0.95), len(lat_ms) - 1)]
+
+        # --- delta minimality (small-touch publish) ---------------------
+        probe = WeightSubscriber(srv.address(), template,
+                                 retry_policy=pol, name="delta-probe")
+        probe.sync()
+        st = dict(pub._head.state)
+        st["l0"] = np.asarray(st["l0"]) + 1
+        pub.publish(st, step=publishes + 1)
+        probe.sync()
+        pm = probe.metrics()
+        out["delta_bytes"] = pm["serve_delta_bytes_last"]
+        out["full_payload_bytes"] = pm["serve_payload_bytes_last"]
+        out["delta_full_ratio"] = pm["serve_delta_ratio_last"]
+        probe.stop()
+    finally:
+        for s in subs:
+            s.stop()
+        srv.shutdown()
+
+    # --- fan-out capacity, uplink-capped: direct vs relay tier ---------
+    def capacity(parent_addrs: list) -> Dict[str, float]:
+        """Aggregate delivered MB/s of continuous fresh-subscriber full
+        syncs across ``subscribers`` workers round-robined over
+        ``parent_addrs``."""
+        stop = time.perf_counter() + capacity_secs
+        done = [0]
+        lock = threading.Lock()
+
+        def worker(wid: int) -> None:
+            while time.perf_counter() < stop:
+                s = WeightSubscriber(
+                    parent_addrs[wid % len(parent_addrs)], template,
+                    retry_policy=pol, stall_timeout_sec=30.0,
+                    name=f"cap{wid}")
+                try:
+                    if s.sync():
+                        with lock:
+                            done[0] += 1
+                except Exception:  # noqa: BLE001 — churny rig, count only
+                    pass
+                finally:
+                    s.stop()
+
+        threads = [threading.Thread(target=worker, args=(i,), daemon=True)
+                   for i in range(subscribers)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=capacity_secs + 60)
+        wall = time.perf_counter() - t0
+        payload = per * 4 * n_leaves
+        return {"syncs": float(done[0]),
+                "agg_mb_s": done[0] * payload / 1e6 / max(wall, 1e-9)}
+
+    pub2 = WeightPublisher(keep_generations=2)
+    srv2 = PublicationServer(pub2, bind_host="127.0.0.1")
+    pub2.publish(state, step=1)
+    pub_proxy = _UplinkCapProxy(srv2.address(), uplink_mb_s)
+    relay_nodes: list = []
+    relay_proxies: list = []
+    try:
+        direct = capacity([pub_proxy.address()])
+        out["direct_syncs"] = direct["syncs"]
+        out["direct_agg_mb_s"] = direct["agg_mb_s"]
+
+        relay_nodes = [
+            WeightRelay(pub_proxy.address(), template,
+                        bind_host="127.0.0.1", retry_policy=pol,
+                        name=f"relay{i}")
+            for i in range(relays)
+        ]
+        for r in relay_nodes:
+            r.sync()  # warm: relays hold the generation before the clock
+        relay_proxies = [_UplinkCapProxy(r.address(), uplink_mb_s)
+                         for r in relay_nodes]
+        relayed = capacity([p.address() for p in relay_proxies])
+        out["relay_syncs"] = relayed["syncs"]
+        out["relay_agg_mb_s"] = relayed["agg_mb_s"]
+        out["fanout_capacity_ratio"] = (
+            relayed["agg_mb_s"] / max(direct["agg_mb_s"], 1e-9))
+        out["capacity_target_ratio"] = 4.0
+    finally:
+        for p in relay_proxies:
+            p.shutdown()
+        for r in relay_nodes:
+            r.stop()
+        pub_proxy.shutdown()
+        srv2.shutdown()
+    return out
+
+
 # --------------------------------------------------------------- scenario 6
 
 def _native_control_plane_available() -> bool:
@@ -1395,6 +1681,28 @@ def main() -> None:
                          "reconfigure_busy_s") if k in rec},
            "heal_mbytes": round(rec.get("heal_mbytes", 0.0), 3)})
 
+    # Weight-distribution tier (docs/design/serving.md): publish-to-
+    # visible latency for a long-polling fleet, small-touch delta ratio
+    # (target: ~changed-leaves/total, here 1/12), and the uplink-capped
+    # fan-out capacity A/B (relay tier target: >= 4x direct).
+    pf = bench_publish_fanout()
+    _emit({"metric": "publish_fanout",
+           "payload_mbytes": round(pf["payload_mbytes"], 2),
+           "subscribers": pf["subscribers"], "relays": pf["relays"],
+           "uplink_cap_mb_s": pf["uplink_cap_mb_s"],
+           "publish_to_visible_p50_ms":
+               round(pf["publish_to_visible_p50_ms"], 1),
+           "publish_to_visible_p95_ms":
+               round(pf["publish_to_visible_p95_ms"], 1),
+           "delta_full_ratio": round(pf["delta_full_ratio"], 4),
+           "direct_agg_mb_s": round(pf["direct_agg_mb_s"], 2),
+           "relay_agg_mb_s": round(pf["relay_agg_mb_s"], 2),
+           "fanout_capacity_ratio":
+               round(pf["fanout_capacity_ratio"], 2),
+           "vs_capacity_target": round(
+               pf["fanout_capacity_ratio"]
+               / pf["capacity_target_ratio"], 3)})
+
     # Headline (stdout, exactly one line): FT efficiency vs the 0.90
     # north-star bar (BASELINE.json; the reference publishes no numbers).
     print(json.dumps({
@@ -1402,6 +1710,7 @@ def main() -> None:
         "value": round(single["ft_steps_per_s"], 3),
         "unit": "steps/s",
         "vs_baseline": round(single["efficiency"] / 0.90, 4),
+        **_provenance(),
     }))
     print(f"# raw={single['raw_steps_per_s']:.3f} steps/s "
           f"ft={single['ft_steps_per_s']:.3f} steps/s "

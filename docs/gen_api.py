@@ -33,6 +33,7 @@ MODULES = [
     ("torchft_tpu.backends.mesh", "On-device full-membership backend"),
     ("torchft_tpu.checkpointing", "Live peer-to-peer healing transfer"),
     ("torchft_tpu.checkpoint_io", "Durable checkpoint save/load"),
+    ("torchft_tpu.serving", "Live weight publication + relay fan-out"),
     ("torchft_tpu.serialization", "Streaming pytree wire format"),
     ("torchft_tpu.optim", "Commit-gated optimizer wrappers"),
     ("torchft_tpu.data", "Replica-group data sharding"),

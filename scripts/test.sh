@@ -97,6 +97,24 @@ if [[ "${1:-}" == "control-plane" ]]; then
     exit 0
 fi
 
+# Serve tier: the weight-distribution tier's focused gate
+# (docs/design/serving.md) — the delta-publication protocol (head /
+# manifest / ranged generations, eviction, long-poll), delta minimality
+# byte accounting, the crc-verified atomic swap under TORCHFT_CHAOS net
+# faults (torn-read guarantee, publisher restart, relay death
+# failover), the relay tree, staleness bounds, Manager.publish commit
+# coupling, and ranged-fetch connection reuse. Tier-1 too (not marked
+# slow); this tier reruns just them on serving/checkpointing/manager
+# changes. The seeded subscriber-churn soak (kill/revive of subscribers
+# and a relay mid-publish) is marked nightly+slow and rides the nightly
+# tier.
+if [[ "${1:-}" == "serve" ]]; then
+    stage serve env JAX_PLATFORMS=cpu \
+        python -m pytest tests/test_serving.py -q -m serve
+    echo "== total: ${SECONDS}s"
+    exit 0
+fi
+
 # Cold-start tier: seeded kill-all → cold-restart soak — every round a
 # 2-group job checkpoints under disk chaos (torn writes, silent
 # bit-flips, ENOSPC), the whole fleet "dies", and recovery must come

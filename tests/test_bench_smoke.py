@@ -121,3 +121,45 @@ class TestAllreduceABSmoke:
             assert out["ring_wire_mbytes_per_step"] > 0
         finally:
             chaos.uninstall()
+
+
+class TestPublishFanoutSmoke:
+    """Publish-fanout bench plumbing at tiny size — pure-python
+    transport, no native library needed. The full-scale >=4x capacity
+    gate runs in bench.py (relays=6, 4MB payload); at smoke scale we
+    assert the machinery: both legs complete, the direct leg respects
+    the uplink cap, the relay tier beats direct, and the small-touch
+    delta ratio is ~changed/total."""
+
+    def test_publish_fanout_plumbing(self):
+        from bench import bench_publish_fanout
+
+        out = bench_publish_fanout(
+            payload_mb=0.6, subscribers=4, relays=3, uplink_mb_s=24.0,
+            publishes=2, capacity_secs=1.5)
+        assert out["publish_to_visible_p50_ms"] > 0
+        assert out["publish_to_visible_p95_ms"] >= \
+            out["publish_to_visible_p50_ms"]
+        # small-touch publish moved ~1/12 of the payload
+        assert out["delta_full_ratio"] == pytest.approx(1 / 12, rel=0.05)
+        # direct leg is uplink-bound: within the cap (+ scheduling slop)
+        assert out["direct_agg_mb_s"] <= 24.0 * 1.15
+        assert out["direct_syncs"] >= 1
+        # the relay tier multiplies capacity (full 4x gate at bench
+        # scale where per-sync overhead amortizes; at smoke scale the
+        # measured ratio is ~1.7, and >=1.3x is already impossible
+        # without a working tier — direct is pinned at one uplink)
+        assert out["fanout_capacity_ratio"] >= 1.3, out
+
+    def test_emitted_rows_carry_provenance(self, capsys):
+        import bench
+
+        bench._emit({"metric": "smoke"})
+        err = capsys.readouterr().err.strip().splitlines()[-1]
+        import json as _json
+
+        row = _json.loads(err)
+        assert row["metric"] == "smoke"
+        assert row["schema"] == bench._BENCH_SCHEMA
+        assert row["platform"] == "cpu"
+        assert "jax" in row and row["jax"]

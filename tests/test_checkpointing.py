@@ -868,8 +868,8 @@ class TestResumableHeal:
 
             orig = CheckpointServer._fetch_manifest
 
-            def lying_manifest(addr, stall, auth, endpoint):
-                real = orig(addr, stall, auth, endpoint)
+            def lying_manifest(addr, stall, auth, endpoint, **kw):
+                real = orig(addr, stall, auth, endpoint, **kw)
                 return bad if real is not None else None
 
             with pytest.MonkeyPatch.context() as mp:

@@ -1,0 +1,122 @@
+"""Schema-stability snapshot of ``Manager.metrics()`` (tier-1).
+
+Every counter below is documented behavior: dashboards, the
+``/metrics.json`` endpoint, the pod runbook's diagnosis recipes, and the
+bench emitters all read these keys by name. A refactor that renames or
+drops one silently breaks them long after the refactor's own tests went
+green — this test is the tripwire: a key may be ADDED freely (add it
+here), but an existing key disappearing fails loudly.
+"""
+
+from unittest.mock import MagicMock
+
+import numpy as np
+
+from torchft_tpu import DummyCommunicator
+from torchft_tpu.manager import Manager
+
+# The documented metrics() schema, by subsystem. Append when a PR adds a
+# counter; never remove without a deliberate deprecation (and a grep for
+# every reader: docs/*, bench.py, dashboards).
+DOCUMENTED_KEYS = frozenset([
+    # quorum / control plane
+    "quorum_count", "quorum_ms_total", "quorum_ms_last",
+    "quorum_fast_path_hits", "quorum_slow_path_rounds",
+    "quorum_epoch_last", "quorum_ms_p50", "quorum_ms_p95",
+    "quorum_ms_max", "lighthouse_redials",
+    "reconfigure_count", "reconfigure_ms_total",
+    # healing
+    "heal_count", "heal_ms_total", "heal_bytes_total",
+    "heal_bytes_resumed_total", "heal_donor_failovers",
+    "heal_leaf_digest_mismatches", "heal_attempts_total",
+    "heal_last_bytes_committed", "heal_last_payload_bytes",
+    "heal_striped_donors", "heal_redials_avoided",
+    # allreduce pipeline
+    "allreduce_count", "allreduce_ms_total",
+    "allreduce_fetch_ms_total", "allreduce_fetch_dispatch_ms_total",
+    "allreduce_fetch_wait_ms_total", "allreduce_ring_ms_total",
+    "allreduce_put_ms_total", "allreduce_wire_bytes_total",
+    "allreduce_ring_wire_bytes_total",
+    "allreduce_pack_cache_misses", "allreduce_d2h_async_fallbacks",
+    # cross-step overlap engine
+    "allreduce_hidden_ms_total", "allreduce_drain_wait_ms_total",
+    "allreduce_inflight", "overlap_steps_deferred",
+    "overlap_grads_dropped",
+    # sharded update
+    "reduce_scatter_count", "update_count", "update_ms_total",
+    "shard_state_bytes", "shard_state_resets",
+    # commit votes
+    "commit_count", "commit_ms_total", "committed_steps",
+    "aborted_steps",
+    # durable checkpoints
+    "ckpt_corrupt_quarantined", "ckpt_recover_fallbacks",
+    "ckpt_recover_legacy", "ckpt_cold_starts", "ckpt_save_skipped",
+    # live publication (serving tier)
+    "publish_count", "publish_skipped", "publish_ms_total",
+    "publish_last_generation",
+    # transport retries
+    "retry_count", "retry_ms_total", "retry_giveups",
+])
+
+
+def make_manager():
+    return Manager(
+        comm=DummyCommunicator(),
+        load_state_dict=MagicMock(),
+        state_dict=lambda: {"w": np.ones(2)},
+        min_replica_size=2,
+        rank=0,
+        world_size=1,
+        replica_id="metrics-schema",
+        _manager_client=MagicMock(),
+    )
+
+
+class TestMetricsSchema:
+    def test_every_documented_key_present(self):
+        m = make_manager()
+        try:
+            got = set(m.metrics())
+            missing = DOCUMENTED_KEYS - got
+            assert not missing, (
+                f"Manager.metrics() lost documented counter key(s): "
+                f"{sorted(missing)} — dashboards/runbook/bench readers "
+                "depend on these by name. If this is a deliberate "
+                "rename, update every reader AND this snapshot.")
+        finally:
+            m.shutdown()
+
+    def test_values_are_numeric(self):
+        """Every documented key must stay JSON-safe numeric — the
+        /metrics.json contract (string-valued diagnostics like
+        ckpt_last_error use their own keys, outside this set)."""
+        m = make_manager()
+        try:
+            mx = m.metrics()
+            for key in DOCUMENTED_KEYS:
+                assert isinstance(mx[key], (int, float)), (
+                    f"{key} is {type(mx[key]).__name__}, expected "
+                    "int/float")
+        finally:
+            m.shutdown()
+
+    def test_attached_publisher_merges_serving_keys(self):
+        """Attaching a WeightPublisher via publish() must surface the
+        serving tier's counters in the same snapshot."""
+        from torchft_tpu.serving import WeightPublisher
+
+        m = make_manager()
+        try:
+            pub = WeightPublisher()
+            gen = m.publish(pub)
+            assert gen == 1
+            mx = m.metrics()
+            for key in ("publish_generations", "publish_delta_ratio_last",
+                        "publish_payload_bytes_last", "serve_requests",
+                        "serve_bytes_sent", "publish_generation_last",
+                        "publish_step_last"):
+                assert key in mx, key
+            assert mx["publish_count"] == 1
+            assert mx["publish_last_generation"] == 1
+        finally:
+            m.shutdown()

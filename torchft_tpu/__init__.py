@@ -40,6 +40,9 @@ from torchft_tpu.local_sgd import (DiLoCoTrainer, StreamingDiLoCoTrainer,
 from torchft_tpu.manager import Manager, WorldSizeMode
 from torchft_tpu.optim import (DelayedOptimizer, FTOptimizer,
                                OptimizerWrapper)
+from torchft_tpu.serving import (PublicationServer, StaleWeightsError,
+                                 WeightPublisher, WeightRelay,
+                                 WeightSubscriber)
 
 __all__ = [
     "AsyncCheckpointer",
@@ -75,9 +78,14 @@ __all__ = [
     "ManagerClient",
     "ManagerServer",
     "OptimizerWrapper",
+    "PublicationServer",
     "QuorumResult",
+    "StaleWeightsError",
     "Store",
     "StoreClient",
+    "WeightPublisher",
+    "WeightRelay",
+    "WeightSubscriber",
     "WorldSizeMode",
 ]
 

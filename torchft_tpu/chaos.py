@@ -10,6 +10,11 @@ injects exactly those, deterministically, at every Python-side transport:
   :func:`wrap_socket`;
 * the heal transport (:mod:`torchft_tpu.checkpointing`) via
   :func:`wrap_reader` around the streamed HTTP body;
+* the weight-distribution tier (:mod:`torchft_tpu.serving`) on the
+  ``serve`` channel — head/manifest/Range fetches of subscribers and
+  relays, with per-parent endpoints ``serve:<host:port>`` so a kill
+  fault latches ONE parent dead (the relay-death case) while the
+  channel config/RNG stream stays shared across the tree;
 * the native KV-store / manager-RPC clients (:mod:`torchft_tpu._native`)
   via the :func:`begin`/:func:`end` shims around each foreign call (the
   C++ sockets themselves are out of Python's reach, so faults are
@@ -44,8 +49,8 @@ Activation:
   ``seed=<int>`` first (optional, default 0), then
   ``<channel>:<field>=<value>,...`` clauses separated by ``;`` where
   ``<channel>`` is an endpoint channel (``ring``, ``store``,
-  ``manager``, ``heal``, ``allreduce``, ``disk``) or ``*`` for all, and
-  ``<field>`` is any :class:`EndpointChaos` field.
+  ``manager``, ``heal``, ``serve``, ``allreduce``, ``disk``) or ``*``
+  for all, and ``<field>`` is any :class:`EndpointChaos` field.
 
 When nothing is installed and ``TORCHFT_CHAOS`` is unset, every hook is
 a no-op costing one global read on the hot path.

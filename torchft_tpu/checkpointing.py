@@ -38,6 +38,8 @@ TPU-native differences from the reference:
 
 from __future__ import annotations
 
+import http.client
+import io
 import json
 import logging
 import re
@@ -97,6 +99,75 @@ class LeafDigestError(ValueError):
 _RANGE_RE = re.compile(r"bytes=(\d+)-(\d+)?$")
 
 
+def _check_bearer_auth(handler: Any, token: Optional[str]) -> bool:
+    """Shared bearer-token gate of the checkpoint and publication
+    servers; sends the 401 itself, returns True when authorized.
+
+    Constant-time compare: plain ``!=`` short-circuits and leaks the
+    token prefix via response timing. Compare as bytes —
+    ``compare_digest`` raises TypeError on non-ASCII str, which an
+    attacker could trigger with a latin-1 header to crash the handler
+    instead of getting a 401. ``got`` came from http.server's latin-1
+    header decode, so latin-1 re-encode recovers the client's raw
+    bytes; ``want`` encodes UTF-8, the byte form a legitimate client
+    sends for a non-ASCII token."""
+    if token is None:
+        return True
+    import hmac
+    got = handler.headers.get("Authorization", "")
+    want = f"Bearer {token}"
+    if not hmac.compare_digest(got.encode("latin-1", "replace"),
+                               want.encode("utf-8")):
+        handler.send_error(401, "missing/bad bearer token")
+        return False
+    return True
+
+
+def _serve_ranged_body(handler: Any, state: Any, plan: Any,
+                       send_timeout_sec: float) -> int:
+    """Stream one serialized snapshot's bytes on ``handler`` with HTTP
+    Range semantics (200 full / 206 partial + Content-Range / 416) —
+    the ONE body-serving implementation shared by the checkpoint heal
+    endpoint and the publication tier, so Range behavior cannot drift
+    between them. Total length is known from the plan before any
+    device data is fetched (Content-Length up front), chunks are
+    zero-copy memoryviews, and socket-write backpressure paces the
+    fetches. Returns bytes written (0 for a 416)."""
+    total = int(plan[1])
+    start, end = 0, total
+    status = 200
+    rng = handler.headers.get("Range")
+    if rng:
+        m = _RANGE_RE.match(rng.strip())
+        if m:
+            start = int(m.group(1))
+            if m.group(2) is not None:
+                end = min(int(m.group(2)) + 1, total)
+            if start >= total or start >= end:
+                handler.send_response(416)
+                handler.send_header("Content-Range", f"bytes */{total}")
+                handler.send_header("Content-Length", "0")
+                handler.end_headers()
+                return 0
+            status = 206
+        # Unparseable Range: ignore it and serve the full stream with
+        # 200, as HTTP permits.
+    handler.send_response(status)
+    handler.send_header("Content-Type", "application/octet-stream")
+    handler.send_header("Content-Length", str(end - start))
+    if status == 206:
+        handler.send_header("Content-Range",
+                            f"bytes {start}-{end - 1}/{total}")
+    handler.end_headers()
+    handler.connection.settimeout(send_timeout_sec)
+    sent = 0
+    for chunk in iter_pytree_chunks(state, plan=plan, start=start,
+                                    end=end):
+        handler.wfile.write(chunk)
+        sent += len(chunk)
+    return sent
+
+
 def build_manifest(plan: Any, step: int) -> dict:
     """JSON transfer manifest for one serialized snapshot: the header's
     leaf entries (array entries annotated with ``offset``/``nbytes``
@@ -115,16 +186,150 @@ def build_manifest(plan: Any, step: int) -> dict:
 
 
 def _open_url(url: str, stall: float, auth_token: Optional[str],
-              headers: Optional[Dict[str, str]] = None) -> Any:
+              headers: Optional[Dict[str, str]] = None,
+              pool: Optional["_ConnectionPool"] = None) -> Any:
     """Dial a checkpoint URL. ``stall`` becomes the socket-op timeout:
     it bounds how long ANY read may sit with zero bytes arriving — the
-    stall watchdog — rather than the whole transfer's wall clock."""
+    stall watchdog — rather than the whole transfer's wall clock.
+    ``pool``, when given, serves the request over a persistent
+    per-donor connection instead of a fresh TCP dial per request."""
+    if pool is not None:
+        return pool.request(url, stall, auth_token, headers=headers)
     req = urllib.request.Request(url)
     if auth_token is not None:
         req.add_header("Authorization", f"Bearer {auth_token}")
     for k, v in (headers or {}).items():
         req.add_header(k, v)
     return urllib.request.urlopen(req, timeout=stall)
+
+
+class _PooledResponse:
+    """Response off a pooled connection: returns the connection to its
+    pool on close iff the body was consumed to completion
+    (``http.client`` marks the response closed at EOF) and the server
+    did not ask to close — anything else (exception, partial read,
+    ``Connection: close``) drops the connection so a later request can
+    never read a previous response's tail bytes."""
+
+    def __init__(self, resp: Any, conn: Any, pool: "_ConnectionPool",
+                 key: str) -> None:
+        self._resp = resp
+        self._conn = conn
+        self._pool = pool
+        self._key = key
+
+    def __getattr__(self, name: str) -> Any:
+        return getattr(self._resp, name)
+
+    def getcode(self) -> int:
+        return self._resp.status
+
+    def read(self, n: int = -1) -> bytes:
+        return self._resp.read(n)
+
+    def readinto(self, b) -> int:
+        return self._resp.readinto(b)
+
+    def close(self) -> None:
+        conn, self._conn = self._conn, None
+        if conn is None:
+            return
+        resp = self._resp
+        clean = resp.isclosed() and not resp.will_close
+        try:
+            resp.close()
+        except Exception:  # noqa: BLE001 — a dirty close just drops conn
+            clean = False
+        if clean:
+            self._pool._put_idle(self._key, conn)
+        else:
+            conn.close()
+
+    def __enter__(self) -> "_PooledResponse":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.close()
+
+
+class _ConnectionPool:
+    """One persistent HTTP connection per ``host:port``, reused across
+    the Range/manifest requests of an attempt wave (and across a weight
+    subscriber's polling lifetime). Every reuse is a TCP dial avoided —
+    counted in ``redials_avoided``, surfaced as ``heal_redials_avoided``
+    in ``Manager.metrics()``. Only *idle* connections live in the pool:
+    a request pops its donor's connection (or dials fresh) and the
+    response returns it on close only when the body was read clean, so
+    the striped fetch's one-thread-per-donor concurrency never shares a
+    connection — the dict itself is lock-guarded."""
+
+    def __init__(self) -> None:
+        self._idle: Dict[str, Any] = {}
+        self._lock = threading.Lock()
+        self.redials = 0
+        self.redials_avoided = 0
+
+    def _put_idle(self, key: str, conn: Any) -> None:
+        with self._lock:
+            if key not in self._idle:
+                self._idle[key] = conn
+                return
+        conn.close()
+
+    def request(self, url: str, stall: float, auth_token: Optional[str],
+                headers: Optional[Dict[str, str]] = None) -> Any:
+        u = urllib.parse.urlsplit(url)
+        key = u.netloc
+        path = (u.path or "/") + (f"?{u.query}" if u.query else "")
+        hdrs = dict(headers or {})
+        if auth_token is not None:
+            hdrs["Authorization"] = f"Bearer {auth_token}"
+        with self._lock:
+            conn = self._idle.pop(key, None)
+        reused = conn is not None
+        resp = None
+        for attempt in (0, 1):
+            if conn is None:
+                conn = http.client.HTTPConnection(u.hostname, u.port,
+                                                  timeout=stall)
+            try:
+                conn.timeout = stall
+                if conn.sock is not None:
+                    conn.sock.settimeout(stall)
+                conn.request("GET", path, headers=hdrs)
+                resp = conn.getresponse()
+                break
+            except Exception:
+                conn.close()
+                conn = None
+                # A kept-alive connection the server idle-closed between
+                # waves looks like a send/recv failure on the FIRST use
+                # after reuse: retry once on a fresh dial. Fresh-dial
+                # failures propagate — they are the donor's problem, and
+                # the caller's retry/failover discipline owns them.
+                if not reused or attempt:
+                    raise
+                reused = False
+        with self._lock:
+            if reused:
+                self.redials_avoided += 1
+            else:
+                self.redials += 1
+        if resp.status >= 400:
+            # Error responses carry Connection: close (send_error);
+            # capture the bounded body for the HTTPError, drop the conn.
+            body = resp.read(65536)
+            conn.close()
+            raise urllib.error.HTTPError(url, resp.status, resp.reason,
+                                         resp.headers, io.BytesIO(body))
+        return _PooledResponse(resp, conn, self, key)
+
+    def close(self) -> None:
+        with self._lock:
+            conns = list(self._idle.values())
+            self._idle.clear()
+        for c in conns:
+            c.close()
 
 
 def _heal_endpoint(addr: str) -> str:
@@ -226,16 +431,23 @@ class _HealSession:
         self.donors_used: set = set()
         self.stripe_deaths = 0              # striped donors dropped dead
         self.lock = threading.Lock()
+        # Persistent per-donor connections shared by every attempt of
+        # this transfer: Range waves stop paying a TCP dial per span.
+        self.pool = _ConnectionPool()
 
-    def adopt_manifest(self, mf: dict) -> None:
+    def adopt_manifest(self, mf: dict, expect_changes: bool = False
+                       ) -> None:
         """Validate a donor's manifest against the target (structure,
         shapes, dtypes — the same untrusted-header discipline as the
-        byte stream) and reconcile committed progress: on a failover,
-        leaves stay committed iff the new donor's digest matches the one
-        we verified — the runtime check of the same-step
-        bitwise-identity invariant. A violation drops just those leaves
-        back into the missing set (and is loud: it means two donors
-        disagree about the same step's state)."""
+        byte stream) and reconcile committed progress: leaves stay
+        committed iff the new manifest's digest matches the one we
+        verified. By default a mismatch is a VIOLATION of the same-step
+        bitwise-identity invariant (a heal failover to another donor of
+        the same step) — loud, and counted in ``digest_mismatches``.
+        ``expect_changes=True`` is the delta-publication mode
+        (:mod:`torchft_tpu.serving`): the manifest describes a *newer
+        generation*, so differing digests are the changed leaves the
+        delta fetch exists to re-fetch — dropped quietly, not counted."""
         pairs, treedef = _match_entries({"leaves": mf["leaves"]},
                                         self.target)
         first = self.pairs is None
@@ -246,8 +458,10 @@ class _HealSession:
         self.preamble_len = int(mf["preamble_len"])
         self.total_len = int(mf["total_len"])
         if not first:
-            # A fresh donor gets a fresh per-leaf refetch budget: the
-            # persistent-mismatch verdict was about the OLD donor's copy.
+            # A fresh donor/generation gets a fresh per-leaf refetch
+            # budget: the persistent-mismatch verdict was about the OLD
+            # copy. (Re-adopting the SAME manifest is the caller's to
+            # avoid — it would reset the budget every round.)
             self.refetches.clear()
             for i in list(self.committed):
                 entry = pairs[i][0]
@@ -256,13 +470,15 @@ class _HealSession:
                 want = entry.get("crc32")
                 if want is not None and i in self.crcs \
                         and int(want) != self.crcs[i]:
-                    logger.warning(
-                        "heal: cross-donor digest mismatch on leaf %r "
-                        "(had %08x, new donor claims %08x) — same-step "
-                        "snapshots should be bitwise identical; "
-                        "re-fetching it from the new donor",
-                        entry["key"], self.crcs[i], int(want))
-                    self.digest_mismatches += 1
+                    if not expect_changes:
+                        logger.warning(
+                            "heal: cross-donor digest mismatch on leaf "
+                            "%r (had %08x, new donor claims %08x) — "
+                            "same-step snapshots should be bitwise "
+                            "identical; re-fetching it from the new "
+                            "donor",
+                            entry["key"], self.crcs[i], int(want))
+                        self.digest_mismatches += 1
                     del self.committed[i]
                     self.crcs.pop(i, None)
                     self.committed_bytes -= int(entry["nbytes"])
@@ -420,33 +636,44 @@ class CheckpointServer:
         # (step, state, plan): snapshot shared by every GET of the same
         # step, so N concurrent healers cost one copy, not N.
         self._snap: Optional[Tuple[int, Any, Any]] = None
+        # Attached live-publication store (torchft_tpu.serving): serves
+        # /publish/* generations through this same server — published
+        # snapshots are immutable, so they are NOT step-gated by the
+        # heal serve window (a commit in progress never blocks them).
+        self._publication: Optional[Any] = None
 
         ckpt_server = self
 
         class Handler(BaseHTTPRequestHandler):
+            # Keep-alive: healers and weight subscribers reuse one
+            # connection across Range waves (_ConnectionPool). Every
+            # response path sends Content-Length, which HTTP/1.1
+            # persistence requires.
+            protocol_version = "HTTP/1.1"
+
             def log_message(self, fmt, *args):  # quiet
                 logger.debug("checkpoint http: " + fmt, *args)
 
             def do_GET(self) -> None:
-                if ckpt_server._auth_token is not None:
-                    import hmac
-                    got = self.headers.get("Authorization", "")
-                    want = f"Bearer {ckpt_server._auth_token}"
-                    # Constant-time compare: plain != short-circuits and
-                    # leaks the token prefix via response timing. Compare as
-                    # bytes — compare_digest raises TypeError on non-ASCII
-                    # str, which an attacker could trigger with a latin-1
-                    # header to crash the handler instead of getting a 401.
-                    # `got` came from http.server's latin-1 header decode,
-                    # so latin-1 re-encode recovers the client's raw bytes;
-                    # `want` encodes UTF-8, the byte form a legitimate
-                    # client sends for a non-ASCII token.
-                    if not hmac.compare_digest(
-                        got.encode("latin-1", "replace"),
-                        want.encode("utf-8"),
-                    ):
-                        self.send_error(401, "missing/bad bearer token")
+                if not _check_bearer_auth(self, ckpt_server._auth_token):
+                    return
+                if self.path.split("?", 1)[0].rstrip("/") == "/publish" \
+                        or self.path.startswith("/publish/"):
+                    if ckpt_server._shutdown:
+                        # Drop kept-alive connections like a dead
+                        # process would: subscribers re-dial and reach
+                        # the restarted server on this port, instead of
+                        # a zombie handler thread serving stale
+                        # generations.
+                        self.close_connection = True
                         return
+                    pub = ckpt_server._publication
+                    if pub is None:
+                        self.send_error(404, "no publication attached")
+                        return
+                    pub.handle_request(
+                        self, send_timeout_sec=ckpt_server._send_timeout_sec)
+                    return
                 prefix = "/checkpoint/"
                 if not self.path.startswith(prefix):
                     self.send_error(404, "unknown path")
@@ -518,44 +745,13 @@ class CheckpointServer:
                         self.connection.settimeout(srv._send_timeout_sec)
                         self.wfile.write(body)
                         return
-                    total = plan[1]
-                    start, end = 0, total
-                    status = 200
-                    rng = self.headers.get("Range")
-                    if rng:
-                        m = _RANGE_RE.match(rng.strip())
-                        if m:
-                            start = int(m.group(1))
-                            if m.group(2) is not None:
-                                end = min(int(m.group(2)) + 1, total)
-                            if start >= total or start >= end:
-                                self.send_response(416)
-                                self.send_header("Content-Range",
-                                                 f"bytes */{total}")
-                                self.send_header("Content-Length", "0")
-                                self.end_headers()
-                                return
-                            status = 206
-                        # Unparseable Range: ignore it and serve the full
-                        # stream with 200, as HTTP permits.
-                    self.send_response(status)
-                    self.send_header("Content-Type",
-                                     "application/octet-stream")
-                    self.send_header("Content-Length", str(end - start))
-                    if status == 206:
-                        self.send_header(
-                            "Content-Range",
-                            f"bytes {start}-{end - 1}/{total}")
-                    self.end_headers()
-                    # The status line is already committed: a device_get
+                    # Once the status line is committed, a device_get
                     # failure mid-stream can only short-close the socket
                     # (healer sees "truncated"), so log the real cause
                     # here.
-                    self.connection.settimeout(srv._send_timeout_sec)
                     try:
-                        for chunk in iter_pytree_chunks(
-                                state, plan=plan, start=start, end=end):
-                            self.wfile.write(chunk)
+                        _serve_ranged_body(self, state, plan,
+                                           srv._send_timeout_sec)
                     except Exception:
                         logger.exception(
                             "checkpoint stream failed mid-transfer "
@@ -603,6 +799,20 @@ class CheckpointServer:
         if ":" in host:  # bare IPv6 literals need brackets in URLs
             host = f"[{host}]"
         return f"http://{host}:{port}/checkpoint/{self._step}"
+
+    def attach_publication(self, publication: Any) -> None:
+        """Attach a live-publication store
+        (:class:`torchft_tpu.serving.WeightPublisher`): its generations
+        are then served at ``/publish/*`` on this server's port, next to
+        the heal endpoints — one socket, one auth gate, two protocols."""
+        self._publication = publication
+
+    def publish_address(self) -> str:
+        """Dialable base URL of the attached publication tier
+        (``…/publish``); hand it to
+        :class:`~torchft_tpu.serving.WeightSubscriber` parents."""
+        base = self.address()
+        return base[:base.rindex("/checkpoint/")] + "/publish"
 
     def allow_checkpoint(self, step: int) -> None:
         """Open the serve window for ``step`` (called at step start, while
@@ -758,6 +968,9 @@ class CheckpointServer:
                     max(len(session.donors_used), 1))
                 stats["stripe_donor_deaths"] = float(
                     session.stripe_deaths)
+                stats["redials_avoided"] = float(
+                    session.pool.redials_avoided)
+            session.pool.close()
         dt = time.perf_counter() - t0
         logger.info(
             "checkpoint transfer: %.1f MB in %.2fs (%.0f MB/s; "
@@ -795,7 +1008,7 @@ class CheckpointServer:
             try:
                 if legacy is not True and need_manifest:
                     mf = cls._fetch_manifest(addr, stall, auth_token,
-                                             endpoint)
+                                             endpoint, pool=session.pool)
                     if mf is None:
                         legacy = True
                         logger.info(
@@ -913,13 +1126,16 @@ class CheckpointServer:
     @staticmethod
     def _fetch_manifest(addr: str, stall: float,
                         auth_token: Optional[str],
-                        endpoint: str) -> Optional[dict]:
+                        endpoint: str,
+                        pool: Optional[_ConnectionPool] = None
+                        ) -> Optional[dict]:
         """GET the donor's transfer manifest; ``None`` when the donor
         cannot serve one (404: lock_streaming mode or an older build) —
         the caller then uses the legacy whole-stream fetch."""
         tok = chaos.begin(endpoint, "manifest")
         try:
-            resp = _open_url(addr + MANIFEST_SUFFIX, stall, auth_token)
+            resp = _open_url(addr + MANIFEST_SUFFIX, stall, auth_token,
+                             pool=pool)
         except urllib.error.HTTPError as e:
             reason = str(getattr(e, "reason", "") or e).lower()
             # 404: this build, lock_streaming mode. 400 "bad step": a
@@ -965,11 +1181,15 @@ class CheckpointServer:
         """Fetch one contiguous byte span of missing leaves via an HTTP
         Range request; verify + commit each leaf as it lands. Raises on
         transport failure (committed leaves are retained by the session)
-        and :class:`HealCorruptError` when a leaf keeps mismatching."""
+        and :class:`HealCorruptError` when a leaf keeps mismatching.
+        Requests ride the session's persistent per-donor connection
+        pool, so a multi-span wave pays one TCP dial per donor, not one
+        per span."""
         a, b, idxs = span
         tok = chaos.begin(endpoint, "fetch")
         resp = _open_url(addr, stall, auth_token,
-                         headers={"Range": f"bytes={a}-{b - 1}"})
+                         headers={"Range": f"bytes={a}-{b - 1}"},
+                         pool=session.pool)
         counter = [0]
         try:
             reader = _CountingReader(
@@ -1089,7 +1309,7 @@ class CheckpointServer:
         from byte 0 on every attempt; bytes are still counted truthfully
         via the wrapping reader (never the Content-Length claim)."""
         tok = chaos.begin(endpoint, "fetch")
-        resp = _open_url(addr, stall, auth_token)
+        resp = _open_url(addr, stall, auth_token, pool=session.pool)
         counter = [0]
         try:
             # Best-effort payload size for the progress gauge /

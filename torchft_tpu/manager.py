@@ -413,6 +413,21 @@ class Manager:
             "ckpt_recover_legacy": 0.0,
             "ckpt_cold_starts": 0.0,
             "ckpt_save_skipped": 0.0,
+            # Ranged-fetch connection reuse (heal + serving transport):
+            # requests served over an already-open per-donor connection
+            # instead of a fresh TCP dial.
+            "heal_redials_avoided": 0.0,
+            # Live-publication tier (docs/design/serving.md): commit-
+            # coupled publishes, refusals (mid-heal/errored/aborted/
+            # deferred state — the publish analogue of ckpt_save_skipped),
+            # cumulative publish wall, and the newest generation id
+            # (gauge). The attached WeightPublisher's own counters
+            # (publish_generations, delta bytes/ratio, serve volume)
+            # merge in via metrics().
+            "publish_count": 0.0,
+            "publish_skipped": 0.0,
+            "publish_ms_total": 0.0,
+            "publish_last_generation": 0.0,
         }
         self._metrics_lock = threading.Lock()
         # Quorum latency distribution (p50/p95/max in metrics()): bounded
@@ -481,6 +496,9 @@ class Manager:
         # Attached durable-checkpoint writer (save_durable); its save
         # counters and last error ride metrics()/metrics.json.
         self._ckpt_writer: Optional[Any] = None
+        # Attached live-publication store (publish); its publish/serve
+        # counters ride metrics()/metrics.json the same way.
+        self._publisher: Optional[Any] = None
 
         # --- checkpoint transport (component 8) --------------------------
         # Shared-secret + bind hardening (round-3 verdict weak #6): the
@@ -834,6 +852,8 @@ class Manager:
                     heal_leaf_digest_mismatches=heal_stats.get(
                         "digest_mismatches", 0.0),
                     heal_attempts_total=heal_stats.get("attempts", 0.0),
+                    heal_redials_avoided=heal_stats.get(
+                        "redials_avoided", 0.0),
                 )
                 with self._metrics_lock:  # gauge, not a counter
                     self._metrics["heal_striped_donors"] = heal_stats.get(
@@ -1894,6 +1914,12 @@ class Manager:
             last = self._ckpt_writer.last_error()
             if last:
                 out["ckpt_last_error"] = last
+        # Live-publication counters (generations, delta bytes/ratio,
+        # serve volume) from the attached WeightPublisher, so
+        # /metrics.json shows what the serving tier is doing next to
+        # what training is doing.
+        if self._publisher is not None:
+            out.update(self._publisher.metrics())
         return out
 
     # ------------------------------------------------- durable checkpoints
@@ -1958,6 +1984,71 @@ class Manager:
         fut = writer.save_async(path, state, self.state_dict(), meta=meta)
         self._log_event(event="ckpt_save", step=self._step, path=path)
         return fut
+
+    # ------------------------------------------------- live publication
+
+    def publish(self, publisher: Any,
+                user_state: Optional[Any] = None) -> Optional[int]:
+        """Commit-coupled live publication
+        (:mod:`torchft_tpu.serving`, docs/design/serving.md): register
+        the current committed state as the next generation of
+        ``publisher`` (a :class:`~torchft_tpu.serving.WeightPublisher`)
+        and serve it — manifest head, per-leaf digest manifest, ranged
+        bytes — through this manager's CheckpointServer at
+        ``/publish/*`` (:meth:`publish_address`). Subscribers holding
+        generation G fetch only the leaves whose digest changed.
+
+        Same coupling discipline as :meth:`save_durable`: refuses —
+        returning ``None`` and counting ``publish_skipped`` — when the
+        state did not come from a settled committed step (mid-heal,
+        latched error, aborted vote, or a deferred allreduce in
+        flight). A generation published then could hand subscribers
+        exactly the inconsistent state the torn-read guarantee exists
+        to rule out; the next committed step's publish covers the gap.
+        While this manager heals or cold-starts, publication simply
+        pauses — subscribers keep serving the newest *committed*
+        generation, aging against their ``max_lag_steps`` bound.
+
+        ``user_state`` overrides the published tree (default: the
+        registered ``state_dict`` callable — the weights, not the
+        manager metadata). Returns the generation id, or ``None`` when
+        refused."""
+        with self._metrics_lock:
+            healing = self._healing
+        committed = self._should_step
+        deferred = self.deferred_pending()
+        if healing or self._errored is not None or not committed or deferred:
+            logger.warning(
+                "%s: skipping publish at step %d (healing=%s errored=%s "
+                "committed=%s deferred=%s) — state is not a settled "
+                "committed step's", self._replica_id, self._step, healing,
+                self._errored is not None, committed, deferred)
+            self._record(publish_skipped=1)
+            self._log_event(
+                event="publish_skip", step=self._step, healing=healing,
+                errored=self._errored is not None, committed=committed,
+                deferred=deferred)
+            return None
+        self._publisher = publisher
+        attach = getattr(self._ckpt_server, "attach_publication", None)
+        if attach is not None:
+            attach(publisher)
+        t0 = time.perf_counter()
+        state = (user_state if user_state is not None
+                 else self._user_state_dict())
+        gen = publisher.publish(state, step=self._step)
+        self._record(publish_count=1,
+                     publish_ms_total=(time.perf_counter() - t0) * 1e3)
+        with self._metrics_lock:  # gauge, not a counter
+            self._metrics["publish_last_generation"] = float(gen)
+        self._log_event(event="publish", step=self._step, generation=gen)
+        return gen
+
+    def publish_address(self) -> str:
+        """Dialable base URL of this manager's publication tier
+        (``…/publish`` on the checkpoint server's port) — what
+        subscribers and first-level relays dial."""
+        return self._ckpt_server.publish_address()
 
     def cold_start(self, directory: str, prefix: str = "ckpt_",
                    ) -> Optional[str]:

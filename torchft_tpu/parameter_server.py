@@ -24,13 +24,13 @@ import json
 import logging
 import socket
 import threading
+import time
 import uuid
 from abc import ABC, abstractmethod
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Any
+from typing import Any, Dict
 from urllib.request import urlopen
 
-from torchft_tpu._native import Store
 from torchft_tpu.communicator import Communicator
 from torchft_tpu.utils import advertise_host
 
@@ -53,11 +53,41 @@ class ParameterServer(ABC):
     Client side: ``comm = MyPS.new_session(addr)`` → a configured
     :class:`Communicator` (rank 1 of a 2-member world) ready for
     broadcast/allreduce against the server.
+
+    Sessions are TRACKED and REAPED: a client that vanishes right after
+    ``new_session`` (never configures its half of the rendezvous) used
+    to park its hijacked handler thread — and the per-session
+    communicator and store prefix with it — until the communicator's
+    own rendezvous timeout, or forever with a generous one. A daemon
+    reaper now force-shuts any session still in its CONFIGURING phase
+    after ``session_timeout_sec`` (aborting the blocked rendezvous).
+    Sessions that reached ``forward`` are deliberately exempt — the
+    documented model of use is a long-lived collective loop, and their
+    liveness is bounded by the communicator's own timeouts, not a wall
+    clock. ``GET /status.json`` (:meth:`status`) reports live session
+    count/age plus opened/reaped totals, so a leak is observable before
+    it is a process restart.
     """
 
-    def __init__(self, port: int = 0) -> None:
-        self._store = Store()
+    def __init__(self, port: int = 0,
+                 session_timeout_sec: float = 600.0,
+                 reap_interval_sec: float | None = None) -> None:
+        self._store = self._make_store()
         self._store_addr = self._store.address()
+        self._session_timeout_sec = float(session_timeout_sec)
+        self._reap_interval_sec = (
+            float(reap_interval_sec) if reap_interval_sec is not None
+            else max(min(self._session_timeout_sec / 4.0, 5.0), 0.05))
+        # Live sessions: id -> {"t0": monotonic, "comm": Communicator,
+        # "phase": "configuring" | "active"}. The handler thread owns
+        # the entry's lifecycle (registers, pops in its finally); the
+        # reaper only force-shuts the communicator, which unblocks the
+        # owning thread into that finally.
+        self._sessions: Dict[str, Dict[str, Any]] = {}
+        self._slock = threading.Lock()
+        self._sessions_total = 0
+        self._sessions_reaped = 0
+        self._shutdown_ev = threading.Event()
         ps = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -65,6 +95,14 @@ class ParameterServer(ABC):
                 logger.debug("ps http: " + fmt, *args)
 
             def do_GET(self) -> None:
+                if self.path == "/status.json":
+                    body = json.dumps(ps.status()).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
                 if self.path != "/new_session":
                     self.send_error(404)
                     return
@@ -91,6 +129,19 @@ class ParameterServer(ABC):
             target=self._server.serve_forever, daemon=True,
             name="parameter-server")
         self._thread.start()
+        self._reaper = threading.Thread(
+            target=self._reap_loop, daemon=True,
+            name="parameter-server-reaper")
+        self._reaper.start()
+
+    def _make_store(self) -> Any:
+        """Rendezvous KV store for session communicators (anything with
+        ``address()``/``shutdown()``). Factored out so tests of the
+        session machinery can substitute a stub when the native library
+        is unavailable."""
+        from torchft_tpu._native import Store
+
+        return Store()
 
     def address(self) -> str:
         port = self._server.server_address[1]
@@ -98,14 +149,86 @@ class ParameterServer(ABC):
 
     def _handle_session(self, session_id: str) -> None:
         comm = self.new_communicator()
+        rec = {"t0": time.monotonic(), "comm": comm,
+               "phase": "configuring"}
+        with self._slock:
+            self._sessions[session_id] = rec
+            self._sessions_total += 1
         try:
             comm.configure(f"{self._store_addr}/session/{session_id}",
                            rank=0, world_size=2)
+            with self._slock:  # pair with the reaper's phase recheck
+                rec["phase"] = "active"
             self.forward(session_id, comm)
         finally:
+            with self._slock:
+                self._sessions.pop(session_id, None)
             comm.shutdown()
 
+    # ------------------------------------------------------ reap + status
+
+    def _reap_loop(self) -> None:
+        while not self._shutdown_ev.wait(self._reap_interval_sec):
+            now = time.monotonic()
+            with self._slock:
+                # Only the rendezvous phase is age-bounded: a session
+                # stuck "configuring" past the timeout means the client
+                # took the /new_session response and vanished (its
+                # rendezvous peer will never arrive). ACTIVE sessions
+                # are legitimately long-lived (the documented model of
+                # use is a DiLoCo outer loop running collectives for
+                # the whole training run) — their liveness is the
+                # communicator timeout's job, not a wall clock's.
+                stale = [(sid, rec) for sid, rec in self._sessions.items()
+                         if rec["phase"] == "configuring"
+                         and now - rec["t0"] > self._session_timeout_sec]
+            for sid, rec in stale:
+                with self._slock:
+                    # Recheck BOTH identity and phase under the lock: a
+                    # slow client whose configure completed right at
+                    # the timeout turned this into a legitimate active
+                    # session between scan and pop — leave it alone.
+                    if (self._sessions.get(sid) is not rec
+                            or rec["phase"] != "configuring"):
+                        continue
+                    self._sessions.pop(sid)
+                    # Pop-under-lock before the shutdown: the entry was
+                    # provably ours, so the count is exact — a session
+                    # finishing naturally in the window can never be
+                    # miscounted as reaped (the owner's finally pop is
+                    # now a no-op).
+                    self._sessions_reaped += 1
+                logger.warning(
+                    "parameter server: reaping session %s (configuring "
+                    "for %.1fs > %.1fs timeout)", sid,
+                    now - rec["t0"], self._session_timeout_sec)
+                try:
+                    # Aborts the session's blocked rendezvous; the
+                    # owning handler thread falls into its finally and
+                    # shuts the comm again (shutdown is idempotent).
+                    rec["comm"].shutdown()
+                except Exception:  # noqa: BLE001 — reap must not die
+                    logger.exception("session %s reap shutdown failed",
+                                     sid)
+
+    def status(self) -> Dict[str, Any]:
+        """Session observability (also served at ``GET /status.json``):
+        live session count and oldest age, plus lifetime totals —
+        ``sessions_total`` opened, ``sessions_reaped`` force-closed by
+        the timeout reaper."""
+        now = time.monotonic()
+        with self._slock:
+            ages = [now - rec["t0"] for rec in self._sessions.values()]
+            return {
+                "active_sessions": len(ages),
+                "oldest_session_age_s": max(ages) if ages else 0.0,
+                "sessions_total": self._sessions_total,
+                "sessions_reaped": self._sessions_reaped,
+                "session_timeout_sec": self._session_timeout_sec,
+            }
+
     def shutdown(self) -> None:
+        self._shutdown_ev.set()
         self._server.shutdown()
         self._server.server_close()
         self._store.shutdown()

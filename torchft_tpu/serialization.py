@@ -32,7 +32,8 @@ from __future__ import annotations
 import json
 import threading
 import zlib
-from typing import Any, BinaryIO, Callable, Iterator, List, Optional, Tuple
+from typing import (Any, BinaryIO, Callable, Dict, Iterator, List, Optional,
+                    Tuple)
 
 import jax
 import numpy as np
@@ -161,6 +162,39 @@ def manifest_from(plan: PytreePlan,
         "total_len": int(plan.total_len),
         "leaves": leaves,
     }
+
+
+def manifest_delta(old: Optional[dict], new: dict) -> dict:
+    """Changed-leaf summary between two digest manifests of the same
+    pytree structure — the delta-publication primitive
+    (docs/design/serving.md): an array leaf is *changed* when its key
+    has no counterpart in ``old`` or its crc32 differs, and a
+    subscriber holding the ``old`` generation needs to fetch exactly
+    the changed leaves to reach ``new``. Returns ``{"changed":
+    [body-order array indices], "changed_bytes", "total_bytes",
+    "leaves"}``. ``old=None`` (cold subscriber) marks every array leaf
+    changed."""
+    old_crcs: Dict[str, int] = {}
+    if old is not None:
+        for e in old.get("leaves", ()):
+            if e.get("kind") == "array" and "crc32" in e:
+                old_crcs[e["key"]] = int(e["crc32"])
+    changed: List[int] = []
+    changed_bytes = 0
+    total_bytes = 0
+    arr_idx = 0
+    for e in new["leaves"]:
+        if e.get("kind") != "array":
+            continue
+        nbytes = int(e["nbytes"])
+        total_bytes += nbytes
+        want = e.get("crc32")
+        if want is None or old_crcs.get(e["key"]) != int(want):
+            changed.append(arr_idx)
+            changed_bytes += nbytes
+        arr_idx += 1
+    return {"changed": changed, "changed_bytes": changed_bytes,
+            "total_bytes": total_bytes, "leaves": arr_idx}
 
 
 def plan_pytree(tree: Any) -> PytreePlan:

@@ -1,0 +1,1007 @@
+"""Live weight publication: delta updates through a relay fan-out tree.
+
+The training side keeps a model alive; this module is what *consumes* it
+(docs/design/serving.md, ROADMAP item 5 — the "millions of users" half
+of the north star). Training pushes **delta updates** to a subscriber
+fleet:
+
+* :class:`WeightPublisher` — an immutable-generation store the trainer
+  registers committed snapshots with (``Manager.publish`` hooks the
+  commit boundary with the same coupling discipline as
+  ``save_durable``: it refuses mid-heal / errored / aborted / deferred
+  state, so a published generation is always a settled committed
+  step's). Served either through the existing
+  :class:`~torchft_tpu.checkpointing.CheckpointServer`
+  (``attach_publication`` — one socket, one auth gate) or a standalone
+  :class:`PublicationServer`.
+* :class:`WeightSubscriber` — polls (or long-polls) the manifest head
+  and fetches **only leaves whose crc32 digest changed** since the
+  generation it holds, over the same HTTP-Range machinery the heal path
+  uses (coalesced spans, persistent per-parent connections, per-leaf
+  digest verification BEFORE placement). The new pytree is swapped in
+  atomically only when every fetched leaf crc-verified against the
+  *published* manifest — a subscriber can never observe a torn or
+  uncommitted weight set, under ``TORCHFT_CHAOS`` net faults included
+  (channel ``serve``, per-parent endpoints ``serve:<host:port>``).
+* :class:`WeightRelay` — a subscriber that re-serves the identical
+  ranged-manifest protocol downstream, so fan-out scales with tree
+  width instead of saturating the trainer's NIC; generation ids,
+  digests, and the publisher's boot nonce propagate unchanged, which is
+  what lets a downstream subscriber fail over between its relay and the
+  root publisher without refetching leaves it already verified.
+
+Staleness is explicit, not implicit: every head carries the publisher's
+step, the subscriber tracks the newest step it has *seen advertised*,
+and :meth:`WeightSubscriber.weights` raises :class:`StaleWeightsError`
+when the held generation lags it by more than ``max_lag_steps``. While
+the publisher heals or cold-starts it publishes nothing (``publish``
+refuses), so held weights stay the newest *committed* state — the bound
+re-engages the moment publication resumes.
+
+Transport failures follow the heal discipline: transient errors retry
+with backoff and budget by consecutive zero-progress rounds, a
+connection-refused parent is classified dead and the subscriber rotates
+to the next parent, and committed leaves survive the failover iff the
+new parent's manifest digests match what was already verified (the
+cross-server bitwise-identity check).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import re
+import threading
+import time
+import urllib.error
+import urllib.parse
+import uuid
+from collections import OrderedDict
+from http.server import BaseHTTPRequestHandler
+from typing import Any, Dict, List, Optional
+
+from torchft_tpu import chaos
+from torchft_tpu.checkpointing import (
+    CheckpointServer,
+    HealCorruptError,
+    MANIFEST_FORMAT,
+    _check_bearer_auth,
+    _CheckpointHTTPServer,
+    _ConnectionPool,
+    _HealSession,
+    _heal_transient,
+    _looks_donor_dead,
+    _open_url,
+    _serve_ranged_body,
+    _snapshot_tree,
+)
+from torchft_tpu.retry import RetryError, RetryPolicy
+from torchft_tpu.serialization import (
+    device_put_like,
+    manifest_delta,
+    manifest_from,
+    plan_pytree,
+)
+from torchft_tpu.utils import advertise_host
+
+logger: logging.Logger = logging.getLogger(__name__)
+
+HEAD_FORMAT = "tft-publish-head-1"
+_GEN_RE = re.compile(r"^/publish/(\d+)(/manifest)?$")
+
+
+class StaleWeightsError(RuntimeError):
+    """The held weights lag the newest advertised publication by more
+    than the subscriber's ``max_lag_steps`` bound (or nothing has been
+    received yet)."""
+
+
+def _serve_endpoint(addr: str) -> str:
+    """Per-parent chaos endpoint (``serve:<host:port>``), mirroring the
+    heal transport's ``heal:<host:port>`` — kill faults latch a single
+    parent dead while the ``serve`` channel's config/RNG stream stays
+    shared across the tree."""
+    netloc = urllib.parse.urlparse(addr).netloc
+    return f"serve:{netloc}" if netloc else "serve"
+
+
+class _Generation:
+    """One immutable published snapshot: the (host- or device-side)
+    state tree, its streaming plan, per-array-leaf digests in body
+    order, and the manifest served to subscribers."""
+
+    __slots__ = ("generation", "step", "boot", "state", "plan",
+                 "digests", "manifest")
+
+    def __init__(self, generation: int, step: int, boot: str, state: Any,
+                 plan: Any, digests: List[int], manifest: dict) -> None:
+        self.generation = generation
+        self.step = step
+        self.boot = boot
+        self.state = state
+        self.plan = plan
+        self.digests = digests
+        self.manifest = manifest
+
+
+class WeightPublisher:
+    """Generation store + HTTP handler of the publication protocol.
+
+    ``publish()`` registers an immutable snapshot as the next
+    generation; subscribers reach it at::
+
+        GET /publish/head[?wait_gen=G&wait_boot=B&timeout_s=T]   (long-poll)
+        GET /publish/<gen>/manifest
+        GET /publish/<gen>          (HTTP Range honored: 206/416)
+
+    The last ``keep_generations`` generations stay fetchable so a
+    subscriber mid-transfer of generation G is not 404'd the moment
+    G+1 publishes (an evicted generation makes it re-read the head and
+    converge on the newest — committed leaves with unchanged digests
+    carry over, so the restart costs metadata, not bytes).
+
+    ``boot`` is a per-publisher-process nonce stamped into every head
+    and manifest: a restarted publisher's generation counter restarts
+    too, and the nonce is what lets subscribers tell "gen 1 of a new
+    life" from "an old head I already passed". Publishing with an
+    explicit ``boot`` (relays propagate their upstream's) evicts all
+    generations of the previous boot.
+
+    Single-writer by design: ``publish`` is called from the training
+    loop's commit boundary (or a relay's swap hook), never
+    concurrently.
+    """
+
+    def __init__(self, keep_generations: int = 2,
+                 snapshot: bool = True) -> None:
+        self._cond = threading.Condition()
+        self._gens: "OrderedDict[int, _Generation]" = OrderedDict()
+        self._head: Optional[_Generation] = None
+        self._boot = uuid.uuid4().hex[:12]
+        self._keep = max(int(keep_generations), 1)
+        self._snapshot = snapshot
+        self._m: Dict[str, float] = {
+            "publish_generations": 0.0,
+            "publish_digest_ms_total": 0.0,
+            "publish_changed_leaves_last": 0.0,
+            "publish_delta_bytes_last": 0.0,
+            "publish_payload_bytes_last": 0.0,
+            "publish_delta_ratio_last": 1.0,
+            "serve_requests": 0.0,
+            "serve_bytes_sent": 0.0,
+        }
+
+    # ------------------------------------------------------------ publish
+
+    def publish(self, state: Any, step: int = 0,
+                generation: Optional[int] = None,
+                digests: Optional[List[int]] = None,
+                boot: Optional[str] = None) -> int:
+        """Register ``state`` as the next generation and wake every
+        long-polling subscriber. The snapshot is copied on-device first
+        (:func:`~torchft_tpu.checkpointing._snapshot_tree`) unless the
+        publisher was built with ``snapshot=False`` (relays: their held
+        trees are already immutable host copies). ``digests`` reuses
+        crcs already verified (relays again) — otherwise one batched
+        ``device_get`` digest pass runs here, off the commit's critical
+        path. Returns the generation id."""
+        t0 = time.perf_counter()
+        if self._snapshot:
+            state = _snapshot_tree(state)
+        plan = plan_pytree(state)
+        digs = list(digests) if digests is not None else plan.digests()
+        digest_ms = (time.perf_counter() - t0) * 1e3
+        with self._cond:
+            boot = boot or self._boot
+            prev = self._head
+            if prev is not None and prev.boot != boot:
+                # Upstream restarted: its generation ids restarted too —
+                # the old boot's generations are unreachable history.
+                self._gens.clear()
+                prev = None
+            gen = (int(generation) if generation is not None
+                   else (prev.generation + 1 if prev is not None else 1))
+            if prev is not None and gen <= prev.generation:
+                raise ValueError(
+                    f"generation {gen} is not newer than head "
+                    f"{prev.generation}")
+            manifest = {
+                "format": MANIFEST_FORMAT,
+                "step": int(step),
+                "generation": gen,
+                "boot": boot,
+                **manifest_from(plan, digests=digs),
+            }
+            delta = manifest_delta(
+                prev.manifest if prev is not None else None, manifest)
+            rec = _Generation(gen, int(step), boot, state, plan, digs,
+                              manifest)
+            self._gens[gen] = rec
+            self._head = rec
+            while len(self._gens) > self._keep:
+                self._gens.popitem(last=False)
+            self._m["publish_generations"] += 1
+            self._m["publish_digest_ms_total"] += digest_ms
+            self._m["publish_changed_leaves_last"] = float(
+                len(delta["changed"]))
+            self._m["publish_delta_bytes_last"] = float(
+                delta["changed_bytes"])
+            self._m["publish_payload_bytes_last"] = float(
+                delta["total_bytes"])
+            self._m["publish_delta_ratio_last"] = (
+                delta["changed_bytes"] / delta["total_bytes"]
+                if delta["total_bytes"] else 1.0)
+            self._cond.notify_all()
+        return gen
+
+    def head(self) -> Optional[dict]:
+        """The newest generation's head document (``None`` before the
+        first publish)."""
+        with self._cond:
+            return self._head_locked()
+
+    def _head_locked(self) -> Optional[dict]:
+        rec = self._head
+        if rec is None:
+            return None
+        return {
+            "format": HEAD_FORMAT,
+            "generation": rec.generation,
+            "step": rec.step,
+            "boot": rec.boot,
+            "total_len": int(rec.plan.total_len),
+            "manifest": f"/publish/{rec.generation}/manifest",
+            "data": f"/publish/{rec.generation}",
+        }
+
+    def wait_head(self, after_gen: Optional[int], after_boot: Optional[str],
+                  timeout_s: float) -> Optional[dict]:
+        """Long-poll primitive: park until the head is newer than
+        ``(after_boot, after_gen)`` or ``timeout_s`` elapses, then
+        return the current head (the caller compares generations). A
+        boot mismatch returns immediately — the caller's "after"
+        coordinates are from another publisher life."""
+        deadline = time.monotonic() + max(timeout_s, 0.0)
+        with self._cond:
+            while True:
+                rec = self._head
+                if rec is not None and (
+                        after_gen is None
+                        or rec.boot != (after_boot or rec.boot)
+                        or rec.generation > after_gen):
+                    return self._head_locked()
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return self._head_locked()
+                self._cond.wait(timeout=remaining)
+
+    def metrics(self) -> Dict[str, float]:
+        with self._cond:
+            out = dict(self._m)
+            out["publish_generation_last"] = float(
+                self._head.generation if self._head is not None else 0)
+            out["publish_step_last"] = float(
+                self._head.step if self._head is not None else 0)
+        return out
+
+    # ------------------------------------------------------------- serving
+
+    def handle_request(self, handler: BaseHTTPRequestHandler,
+                       send_timeout_sec: float = 120.0) -> None:
+        """Serve one ``/publish/*`` GET on ``handler`` (called from the
+        hosting server's request handler, after its auth gate). Every
+        response carries Content-Length, so HTTP/1.1 keep-alive holds."""
+        with self._cond:
+            self._m["serve_requests"] += 1
+        path, _, query = handler.path.partition("?")
+        path = path.rstrip("/") or "/publish"
+        if path in ("/publish", "/publish/head"):
+            qs = urllib.parse.parse_qs(query)
+            wait_gen = (int(qs["wait_gen"][0]) if "wait_gen" in qs
+                        else None)
+            wait_boot = qs.get("wait_boot", [None])[0]
+            timeout_s = float(qs.get("timeout_s", ["0"])[0])
+            head = self.wait_head(wait_gen, wait_boot,
+                                  min(timeout_s, send_timeout_sec))
+            if head is None:
+                handler.send_error(404, "nothing published yet")
+                return
+            self._send_json(handler, head, send_timeout_sec)
+            return
+        m = _GEN_RE.match(path)
+        if m is None:
+            handler.send_error(404, "unknown publish path")
+            return
+        with self._cond:
+            rec = self._gens.get(int(m.group(1)))
+        if rec is None:
+            handler.send_error(
+                404, f"generation {m.group(1)} unknown or evicted")
+            return
+        if m.group(2):
+            self._send_json(handler, rec.manifest, send_timeout_sec)
+            return
+        # Ranged byte serving off the cached plan — the heal
+        # transport's one shared body-serving implementation
+        # (200/206/416), zero-copy memoryview chunks, one leaf + one
+        # chunk of host RAM at a time.
+        sent = _serve_ranged_body(handler, rec.state, rec.plan,
+                                  send_timeout_sec)
+        with self._cond:
+            self._m["serve_bytes_sent"] += sent
+
+    def _send_json(self, handler: BaseHTTPRequestHandler, obj: dict,
+                   send_timeout_sec: float) -> None:
+        body = json.dumps(obj).encode()
+        handler.send_response(200)
+        handler.send_header("Content-Type", "application/json")
+        handler.send_header("Content-Length", str(len(body)))
+        handler.end_headers()
+        handler.connection.settimeout(send_timeout_sec)
+        handler.wfile.write(body)
+
+
+class PublicationServer:
+    """Standalone HTTP host for a :class:`WeightPublisher` — what a
+    relay runs (it has no CheckpointServer), and what a bench/test
+    publisher uses without a full Manager. Same auth gate and keep-alive
+    behavior as the checkpoint server."""
+
+    def __init__(self, publisher: WeightPublisher,
+                 bind_host: str = "0.0.0.0",
+                 port: int = 0,
+                 auth_token: Optional[str] = None,
+                 send_timeout_sec: float = 120.0) -> None:
+        self._publisher = publisher
+        self._bind_host = bind_host
+        self._auth_token = auth_token
+        self._down = False
+        srv_self = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):  # quiet
+                logger.debug("publication http: " + fmt, *args)
+
+            def do_GET(self) -> None:
+                if srv_self._down:
+                    # Shut down: drop the (possibly kept-alive)
+                    # connection without a response, like a dead
+                    # process would — clients re-dial and reach
+                    # whatever now owns the port (the restart case).
+                    self.close_connection = True
+                    return
+                if not _check_bearer_auth(self, srv_self._auth_token):
+                    return
+                if not (self.path.split("?", 1)[0].rstrip("/") == "/publish"
+                        or self.path.startswith("/publish/")):
+                    self.send_error(404, "unknown path")
+                    return
+                srv_self._publisher.handle_request(
+                    self, send_timeout_sec=send_timeout_sec)
+
+        self._server = _CheckpointHTTPServer((bind_host, port), Handler)
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name="publication-server")
+        self._thread.start()
+
+    def address(self) -> str:
+        port = self._server.server_address[1]
+        host = (self._bind_host
+                if self._bind_host not in ("", "0.0.0.0", "::")
+                else advertise_host())
+        if ":" in host:
+            host = f"[{host}]"
+        return f"http://{host}:{port}/publish"
+
+    def shutdown(self) -> None:
+        self._down = True
+        self._server.shutdown()
+        self._server.server_close()
+
+
+class _Held:
+    """The subscriber's atomically-swapped unit: one fully-verified
+    generation — the assembled tree plus the per-leaf crcs/leaves that
+    seed the next delta fetch."""
+
+    __slots__ = ("tree", "generation", "step", "boot", "leaves", "crcs",
+                 "total_len")
+
+    def __init__(self, tree: Any, generation: int, step: int, boot: str,
+                 leaves: Dict[int, Any], crcs: Dict[int, int],
+                 total_len: int) -> None:
+        self.tree = tree
+        self.generation = generation
+        self.step = step
+        self.boot = boot
+        self.leaves = leaves
+        self.crcs = crcs
+        self.total_len = total_len
+
+
+class WeightSubscriber:
+    """Crc-verified, delta-fetching consumer of a publication tier.
+
+    Args:
+        parents: ordered candidate base URLs (``…/publish``) — the first
+            is preferred; a dead parent rotates to the next (and a relay
+            subscriber typically lists its relay first and the root
+            publisher last, the donor-failover discipline of the heal
+            path).
+        target: template pytree supplying structure/shapes/dtypes (and
+            shardings when ``device_put``). Plain numpy templates keep
+            everything host-side — the relay/inference-fleet mode.
+        device_put: place fetched leaves like the template's
+            (``jax.device_put`` with its sharding); default False.
+        max_lag_steps: when set, :meth:`weights` raises
+            :class:`StaleWeightsError` once the held generation's step
+            lags the newest *advertised* head step by more than this.
+        poll_interval_s / long_poll_s: background-thread cadence; a
+            nonzero ``long_poll_s`` parks head requests server-side so
+            publish-to-visible latency is network-bound, not
+            poll-cadence-bound.
+
+    ``sync()`` is the one synchronous primitive (the background thread
+    just loops it): poll the head, and if it is newer than what is held,
+    fetch the manifest, carry over every leaf whose digest is unchanged,
+    Range-fetch the rest over the persistent parent connection, verify
+    each leaf's crc32 BEFORE it is placed, and only then swap the
+    assembled tree in — all-or-nothing, under ``TORCHFT_CHAOS`` faults
+    included.
+    """
+
+    def __init__(self, parents: Any, target: Any,
+                 device_put: bool = False,
+                 poll_interval_s: float = 0.5,
+                 long_poll_s: float = 0.0,
+                 max_lag_steps: Optional[int] = None,
+                 auth_token: Optional[str] = None,
+                 retry_policy: Optional[RetryPolicy] = None,
+                 stall_timeout_sec: float = 30.0,
+                 name: str = "subscriber") -> None:
+        if isinstance(parents, str):
+            parents = [parents]
+        if not parents:
+            raise ValueError("at least one parent address required")
+        self._parents = [p.rstrip("/") for p in parents]
+        self._parent_idx = 0
+        self._target = target
+        self._dput = device_put_like if device_put else None
+        self._poll_interval_s = float(poll_interval_s)
+        self._long_poll_s = float(long_poll_s)
+        self._max_lag_steps = max_lag_steps
+        self._auth_token = auth_token
+        self._retry_policy = (retry_policy if retry_policy is not None
+                              else RetryPolicy())
+        self._stall = float(stall_timeout_sec)
+        self._name = name
+        self._pool = _ConnectionPool()
+        self._lock = threading.Lock()
+        # One sync in flight at a time: a caller-issued sync() racing
+        # the background thread's would double-fetch and interleave
+        # session state; the swap itself stays guarded by _lock.
+        self._sync_lock = threading.Lock()
+        self._fresh = threading.Condition(self._lock)
+        self._held: Optional[_Held] = None
+        self._head_step: Optional[int] = None   # newest step seen advertised
+        # Publisher lives we have moved PAST: boot nonces are random
+        # per-process and never come back, so once a swap leaves boot A
+        # for boot B, any parent still serving A is by definition stale
+        # — its heads must neither look "fresher" (a wedged old-boot
+        # relay next to a restarted root would otherwise make the
+        # subscriber flip-flop between lives forever) nor feed the
+        # staleness gauge (a dead life's step 100 would black out a
+        # fleet correctly holding the restarted life's step 60).
+        self._left_boots: set = set()
+        # Sibling-head probes (the stale-parent escape hatch) are rate
+        # limited: per-poll probing would re-centralize head traffic on
+        # the root the relay tree exists to offload.
+        self._probe_min_interval_s = max(2.0, 4.0 * float(poll_interval_s))
+        self._last_probe = 0.0
+        self._stop_ev = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._m: Dict[str, float] = {
+            "serve_generations_applied": 0.0,
+            "serve_bytes_fetched_total": 0.0,
+            "serve_delta_bytes_last": 0.0,
+            "serve_payload_bytes_last": 0.0,
+            "serve_delta_ratio_last": 1.0,
+            "serve_leaves_fetched_last": 0.0,
+            "serve_leaves_carried_last": 0.0,
+            "serve_head_polls": 0.0,
+            "serve_parent_failovers": 0.0,
+            "serve_sync_errors": 0.0,
+            "serve_digest_rejects": 0.0,
+        }
+
+    # -------------------------------------------------------------- readers
+
+    def weights(self) -> Any:
+        """The newest fully-verified weight tree (never torn: swapped in
+        atomically only after every leaf crc-verified). Raises
+        :class:`StaleWeightsError` before the first sync, or when the
+        held step lags the newest advertised head step by more than
+        ``max_lag_steps`` — the caller decides whether stale weights
+        are servable. Leaves are shared, not copied: treat them as
+        read-only."""
+        with self._lock:
+            held = self._held
+            head_step = self._head_step
+        if held is None:
+            raise StaleWeightsError(
+                f"{self._name}: no published generation received yet")
+        if (self._max_lag_steps is not None and head_step is not None
+                and head_step - held.step > self._max_lag_steps):
+            raise StaleWeightsError(
+                f"{self._name}: held step {held.step} lags advertised "
+                f"head step {head_step} by {head_step - held.step} > "
+                f"max_lag_steps={self._max_lag_steps}")
+        return held.tree
+
+    def generation(self) -> int:
+        """Held generation id (0 before the first sync)."""
+        with self._lock:
+            return self._held.generation if self._held is not None else 0
+
+    def step(self) -> int:
+        """Publisher step of the held generation (0 before the first)."""
+        with self._lock:
+            return self._held.step if self._held is not None else 0
+
+    def lag_steps(self) -> int:
+        """How many steps the held weights lag the newest *advertised*
+        head (0 when in sync or before any head was seen)."""
+        with self._lock:
+            if self._held is None or self._head_step is None:
+                return 0
+            return max(self._head_step - self._held.step, 0)
+
+    def wait_generation(self, min_generation: int = 1,
+                        timeout: Optional[float] = None) -> bool:
+        """Block until a generation ``>= min_generation`` is held (the
+        background thread must be running, or another thread calling
+        :meth:`sync`)."""
+        deadline = (time.monotonic() + timeout
+                    if timeout is not None else None)
+        with self._lock:
+            while (self._held is None
+                   or self._held.generation < min_generation):
+                remaining = (deadline - time.monotonic()
+                             if deadline is not None else None)
+                if remaining is not None and remaining <= 0:
+                    return False
+                self._fresh.wait(timeout=remaining)
+            return True
+
+    def metrics(self) -> Dict[str, float]:
+        with self._lock:
+            out = dict(self._m)
+            out["serve_generation"] = float(
+                self._held.generation if self._held is not None else 0)
+            out["serve_step"] = float(
+                self._held.step if self._held is not None else 0)
+        out["serve_lag_steps"] = float(self.lag_steps())
+        out["serve_redials_avoided"] = float(self._pool.redials_avoided)
+        return out
+
+    # ---------------------------------------------------------------- sync
+
+    def sync(self, wait_s: float = 0.0) -> bool:
+        """One publication poll: head → (if newer) manifest → delta
+        fetch → verified atomic swap. Returns True iff a new generation
+        was swapped in. Transient transport failures retry with backoff
+        (budgeted by consecutive zero-progress rounds, like the heal
+        loop); a dead parent or an exhausted budget rotates to the next
+        parent, keeping committed leaves whose digests still match.
+        Raises :class:`~torchft_tpu.retry.RetryError` when every parent
+        is exhausted. Serialized: concurrent calls queue on a lock."""
+        with self._sync_lock:
+            return self._sync_locked(wait_s)
+
+    def _sync_locked(self, wait_s: float) -> bool:
+        pol = self._retry_policy
+        attempts = max(int(pol.max_attempts), 1)
+        no_progress = 0
+        rotations = 0
+        empty_heads = 0
+        session: Optional[_HealSession] = None
+        adopted: Optional[tuple] = None     # (boot, gen) session follows
+        adopted_mf: Optional[dict] = None
+        carried = 0
+        while True:
+            addr = self._parents[self._parent_idx]
+            endpoint = _serve_endpoint(addr)
+            committed_before = (len(session.committed)
+                                if session is not None else 0)
+            try:
+                head = self._fetch_head(
+                    addr, endpoint, wait_s if session is None else 0.0)
+                if head is None:
+                    # This parent has nothing published (a relay that
+                    # never synced, or a genuinely cold publisher). Try
+                    # the other parents before concluding "nothing yet"
+                    # — a broken first parent must not mask a root that
+                    # is serving fresh generations.
+                    empty_heads += 1
+                    if empty_heads >= len(self._parents):
+                        return False
+                    self._parent_idx = ((self._parent_idx + 1)
+                                        % len(self._parents))
+                    continue
+                empty_heads = 0
+                held = self._held
+                self._note_head(head)
+                stale_boot = (held is not None and
+                              head.get("boot") in self._left_boots)
+                if (held is not None
+                        and (stale_boot
+                             or (head.get("boot") == held.boot
+                                 and int(head["generation"])
+                                 <= held.generation))):
+                    # This parent has nothing newer (same life, older
+                    # or equal generation — or an abandoned life
+                    # entirely). But is anything ELSE newer? A
+                    # stale-but-alive parent (a relay whose own uplink
+                    # partitioned) must not pin us forever while its
+                    # siblings serve fresh generations AND silently
+                    # defeat the staleness bound.
+                    fresher = self._probe_other_parents(held)
+                    if fresher is None:
+                        return False  # genuinely current
+                    self._parent_idx = fresher
+                    continue
+                gen = int(head["generation"])
+                boot = str(head.get("boot", ""))
+                data_url = f"{addr}/{gen}"
+                if session is None:
+                    session = _HealSession(
+                        held.tree if held is not None else self._target,
+                        self._dput)
+                    # Data fetches ride the subscriber's long-lived
+                    # per-parent connections (head/manifest already
+                    # do), not a throwaway per-sync pool that would
+                    # re-dial every generation and leak its kept-alive
+                    # socket to GC.
+                    session.pool.close()
+                    session.pool = self._pool
+                if adopted != (boot, gen):
+                    # Adopt once per generation — NOT once per retry
+                    # round: re-adopting the same manifest would clear
+                    # the per-leaf refetch budget every round, making
+                    # the persistent-corruption verdict
+                    # (HealCorruptError -> rotate parent) unreachable.
+                    # Leaves fetched after a parent rotation still
+                    # verify against this adopted manifest, which is
+                    # what makes mixing parents sound. expect_changes:
+                    # digests differing from a PREVIOUS generation are
+                    # the delta, not corruption.
+                    mf = CheckpointServer._fetch_manifest(
+                        data_url, self._stall, self._auth_token,
+                        endpoint, pool=self._pool)
+                    if mf is None:
+                        # The generation was evicted between head and
+                        # manifest (a newer publish raced us): re-read
+                        # the head next round, converge on the newest.
+                        raise _GenerationEvicted(gen)
+                    session.adopt_manifest(
+                        mf, expect_changes=adopted is not None
+                        or held is not None)
+                    adopted = (boot, gen)
+                    adopted_mf = mf
+                    carried = self._preseed(session, held)
+                if not session.complete():
+                    session.rounds += 1
+                    for span in session.spans():
+                        CheckpointServer._fetch_span(
+                            data_url, session, span, self._stall,
+                            self._auth_token, endpoint, None)
+                if not session.complete():
+                    raise _GenerationEvicted(gen)  # leaves mismatched; retry
+                with self._lock:
+                    # In-transit crc rejections only: generation-delta
+                    # drops at adopt time are expected and not counted.
+                    self._m["serve_digest_rejects"] += \
+                        session.digest_mismatches
+                self._swap(session, adopted_mf, head, carried)
+                return True
+            except Exception as e:  # noqa: BLE001 — classified below
+                # A 404 on manifest/data means the generation was
+                # evicted under us (a newer publish raced this fetch):
+                # transient by construction, the next round re-reads the
+                # head and converges on the newest generation.
+                evicted = (isinstance(e, _GenerationEvicted)
+                           or (isinstance(e, urllib.error.HTTPError)
+                               and e.code == 404))
+                transient = evicted or _heal_transient(e)
+                # A persistently corrupt leaf condemns the PARENT's
+                # copy (same classification as the heal loop's donor
+                # failover): retrying it can never help, the next
+                # parent's can.
+                dead = (_looks_donor_dead(e)
+                        or isinstance(e, HealCorruptError))
+                if not transient and not dead:
+                    with self._lock:
+                        self._m["serve_sync_errors"] += 1
+                    raise
+                progressed = (session is not None
+                              and len(session.committed) > committed_before)
+                no_progress = 0 if progressed else no_progress + 1
+                if dead or no_progress >= attempts:
+                    rotations += 1
+                    if rotations > len(self._parents):
+                        with self._lock:
+                            self._m["serve_sync_errors"] += 1
+                        raise RetryError(
+                            f"{self._name}: every parent exhausted "
+                            f"({len(self._parents)} candidate(s); last "
+                            f"error: {e})") from e
+                    self._parent_idx = ((self._parent_idx + 1)
+                                        % len(self._parents))
+                    with self._lock:
+                        self._m["serve_parent_failovers"] += 1
+                    logger.warning(
+                        "%s: parent %s unusable (%s); failing over to %s",
+                        self._name, addr, e,
+                        self._parents[self._parent_idx])
+                    no_progress = 0
+                    continue
+                delay = pol.delay_ms(min(max(no_progress - 1, 0), 16)) / 1e3
+                logger.debug("%s: sync attempt failed (%s); retrying",
+                             self._name, e)
+                time.sleep(delay)
+
+    # ------------------------------------------------------------- plumbing
+
+    def _note_head(self, head: dict) -> None:
+        with self._lock:
+            # Heads of abandoned publisher lives never feed the gauge:
+            # a dead life's high-water step would mark a subscriber
+            # stale forever after a cold-start step regression.
+            if head.get("boot") in self._left_boots:
+                return
+            step = int(head.get("step", 0))
+            if self._head_step is None or step > self._head_step:
+                self._head_step = step
+
+    def _probe_other_parents(self, held: _Held) -> Optional[int]:
+        """The current parent reports nothing newer than what we hold.
+        Probe the sibling parents' heads (cheap JSON GETs over the
+        kept-alive connections, rate-limited so idle polls don't
+        re-centralize head traffic on the root): every answer feeds the
+        staleness gauge (``lag_steps`` must reflect the FLEET's head,
+        not a wedged relay's), and the index of a parent advertising
+        something strictly newer — a generation past ours on the same
+        publisher life, or a life we have NOT already moved past — is
+        returned so the caller re-targets it. ``None`` when nobody has
+        anything newer (we are genuinely current, or the probe window
+        hasn't elapsed)."""
+        now = time.monotonic()
+        if (len(self._parents) < 2
+                or now - self._last_probe < self._probe_min_interval_s):
+            return None
+        self._last_probe = now
+        fresher: Optional[int] = None
+        for i, addr in enumerate(self._parents):
+            if i == self._parent_idx:
+                continue
+            try:
+                h = self._fetch_head(addr, _serve_endpoint(addr), 0.0)
+            except Exception:  # noqa: BLE001 — probe must not fail sync
+                continue
+            if h is None:
+                continue
+            self._note_head(h)
+            boot = h.get("boot")
+            newer = (int(h["generation"]) > held.generation
+                     if boot == held.boot
+                     else boot not in self._left_boots)
+            if fresher is None and newer:
+                fresher = i
+        return fresher
+
+    def _fetch_head(self, addr: str, endpoint: str,
+                    wait_s: float) -> Optional[dict]:
+        held = self._held
+        q = ""
+        if wait_s > 0 and held is not None:
+            q = (f"?wait_gen={held.generation}&wait_boot={held.boot}"
+                 f"&timeout_s={wait_s:g}")
+        with self._lock:
+            self._m["serve_head_polls"] += 1
+        tok = chaos.begin(endpoint, "head")
+        try:
+            resp = _open_url(f"{addr}/head{q}", self._stall + wait_s,
+                             self._auth_token, pool=self._pool)
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                chaos.end(tok)
+                return None
+            raise
+        with resp:
+            reader = chaos.wrap_reader(resp, endpoint)
+            parts = []
+            while True:
+                piece = reader.read(65536)
+                if not piece:
+                    break
+                parts.append(piece)
+        chaos.end(tok)
+        head = json.loads(b"".join(parts))
+        if head.get("format") != HEAD_FORMAT:
+            raise ValueError(
+                f"invalid publication head format {head.get('format')!r}")
+        return head
+
+    def _preseed(self, session: _HealSession,
+                 held: Optional[_Held]) -> int:
+        """Carry every held leaf whose digest the new manifest still
+        claims into the session as already-committed — the delta fetch:
+        what remains missing is exactly the changed-digest set. Boot
+        changes don't matter here: digests are content addresses."""
+        if held is None or session.pairs is None:
+            return 0
+        carried = 0
+        for i, (entry, _) in enumerate(session.pairs):
+            if entry.get("kind") != "array" or i in session.committed:
+                continue
+            want = entry.get("crc32")
+            if (want is not None and held.crcs.get(i) == int(want)
+                    and i in held.leaves):
+                with session.lock:
+                    session.committed[i] = held.leaves[i]
+                    session.crcs[i] = held.crcs[i]
+                    session.committed_bytes += int(entry["nbytes"])
+                carried += 1
+        return carried
+
+    def _swap(self, session: _HealSession, mf: dict, head: dict,
+              carried: int) -> None:
+        tree = session.assemble()
+        leaves = {i: session.committed[i] for i in session.arr_order}
+        crcs = dict(session.crcs)
+        held = _Held(tree, int(head["generation"]),
+                     int(mf.get("step", head.get("step", 0))),
+                     str(head.get("boot", "")), leaves, crcs,
+                     int(session.total_len))
+        fetched_leaves = len(session.arr_order) - carried
+        with self._lock:
+            if (self._held is not None
+                    and self._held.boot != held.boot):
+                # Crossing into a new publisher life: the old life is
+                # DEAD to us from here on (nonces never repeat) — its
+                # parents can no longer look "fresher", and its
+                # high-water step no longer defines staleness (a
+                # cold-started publisher legitimately regresses steps).
+                self._left_boots.add(self._held.boot)
+                if len(self._left_boots) > 64:   # bounded paranoia
+                    self._left_boots.pop()
+                self._head_step = held.step
+            self._left_boots.discard(held.boot)
+            self._held = held
+            self._m["serve_generations_applied"] += 1
+            self._m["serve_bytes_fetched_total"] += session.bytes_read
+            self._m["serve_delta_bytes_last"] = float(session.bytes_read)
+            self._m["serve_payload_bytes_last"] = float(session.total_len)
+            self._m["serve_delta_ratio_last"] = (
+                session.bytes_read / session.total_len
+                if session.total_len else 1.0)
+            self._m["serve_leaves_fetched_last"] = float(fetched_leaves)
+            self._m["serve_leaves_carried_last"] = float(carried)
+            self._fresh.notify_all()
+        self._on_generation(held, [crcs[i] for i in session.arr_order])
+        logger.info(
+            "%s: generation %d (step %d) visible — %.1f/%.1f MB fetched "
+            "(%d leaves, %d carried over)", self._name, held.generation,
+            held.step, session.bytes_read / 1e6, session.total_len / 1e6,
+            fetched_leaves, carried)
+
+    def _on_generation(self, held: _Held,
+                       body_digests: List[int]) -> None:
+        """Hook for subclasses (relays) — called after each verified
+        swap, outside the reader lock."""
+
+    # ----------------------------------------------------- background loop
+
+    def start(self) -> "WeightSubscriber":
+        """Run the poll/sync loop on a daemon thread until
+        :meth:`stop`. Sync failures are counted and retried at the poll
+        cadence, never raised to the caller."""
+        if self._thread is not None:
+            return self
+        self._stop_ev.clear()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name=f"{self._name}-poll")
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop_ev.is_set():
+            try:
+                self.sync(wait_s=self._long_poll_s)
+            except Exception:  # noqa: BLE001 — keep polling
+                logger.warning("%s: sync failed; retrying at poll "
+                               "cadence", self._name, exc_info=True)
+            if self._long_poll_s <= 0 or self._held is None:
+                self._stop_ev.wait(self._poll_interval_s)
+            else:
+                # Long-poll mode: the head request itself parks
+                # server-side; only pause briefly to bound a tight error
+                # loop against a broken parent.
+                self._stop_ev.wait(0.01)
+
+    def stop(self) -> None:
+        self._stop_ev.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=max(self._stall + self._long_poll_s, 1.0) + 5)
+        self._pool.close()
+
+
+class _GenerationEvicted(Exception):
+    """The target generation vanished mid-fetch (a newer publish evicted
+    it) or leaves kept mismatching this round — transient by
+    construction: the next round re-reads the head and converges on the
+    newest generation, carrying verified leaves over."""
+
+    def __init__(self, generation: int) -> None:
+        super().__init__(f"generation {generation} evicted or incomplete; "
+                         "re-reading head")
+
+
+class WeightRelay(WeightSubscriber):
+    """A subscriber that re-serves what it verifies: after every
+    atomic swap it registers the held generation — same id, same boot,
+    same digests — with its own :class:`WeightPublisher` behind a
+    standalone :class:`PublicationServer`, so downstream subscribers
+    speak the identical protocol against :meth:`address`. Digests are
+    reused (already verified leaf-by-leaf on the way in), so relaying
+    costs zero re-hashing; generation identity propagating unchanged is
+    what makes a downstream failover between this relay and the root
+    publisher seamless."""
+
+    def __init__(self, parents: Any, target: Any,
+                 bind_host: str = "0.0.0.0",
+                 keep_generations: int = 2,
+                 name: str = "relay", **kw: Any) -> None:
+        super().__init__(parents, target, name=name, **kw)
+        self._relay_publisher = WeightPublisher(
+            keep_generations=keep_generations, snapshot=False)
+        self._relay_server = PublicationServer(
+            self._relay_publisher, bind_host=bind_host,
+            auth_token=self._auth_token)
+
+    def address(self) -> str:
+        """Downstream-facing base URL (``…/publish``)."""
+        return self._relay_server.address()
+
+    def publisher(self) -> WeightPublisher:
+        return self._relay_publisher
+
+    def metrics(self) -> Dict[str, float]:
+        out = super().metrics()
+        for k, v in self._relay_publisher.metrics().items():
+            out[f"relay_{k}"] = v
+        return out
+
+    def _on_generation(self, held: _Held,
+                       body_digests: List[int]) -> None:
+        self._relay_publisher.publish(
+            held.tree, step=held.step, generation=held.generation,
+            digests=body_digests, boot=held.boot)
+
+    def stop(self) -> None:
+        super().stop()
+        self._relay_server.shutdown()
+
+
+__all__ = [
+    "HEAD_FORMAT",
+    "PublicationServer",
+    "StaleWeightsError",
+    "WeightPublisher",
+    "WeightRelay",
+    "WeightSubscriber",
+]
