@@ -318,6 +318,8 @@ def bench_multigroup(n_groups: int = 2, steps: int = 20,
                    for l in jax.tree_util.tree_leaves(params0))
     results: Dict[str, Dict[str, float]] = {}
 
+    policy_box: Dict[str, str] = {}
+
     def worker(gid: str) -> None:
         trainer = FTTrainer(
             loss_fn=loss_fn, tx=optax.sgd(0.05), params=params0,
@@ -332,6 +334,9 @@ def bench_multigroup(n_groups: int = 2, steps: int = 20,
                 shard_update=shard_update,
             ),
         )
+        # Stamp the policy in force so BENCH trajectories are
+        # attributable to it (fixed-knob managers synthesize one).
+        policy_box[gid] = trainer.manager.policy().name
         b = {"x": x, "y": y}
         trainer.train_step(b)  # compile + join + first reconfigure
         m0 = trainer.manager.metrics()
@@ -419,6 +424,7 @@ def bench_multigroup(n_groups: int = 2, steps: int = 20,
         "n_groups": n_groups,
         "backend": backend,
         "overlap_steps": overlap_steps,
+        "policy": next(iter(policy_box.values()), "unknown"),
         "steps_per_s": med["steps_per_s"],
         "allreduce_ms_avg": med["allreduce_ms_avg"],
         "grad_mbytes": n_params * 4 / 1e6,
@@ -1239,6 +1245,173 @@ def bench_publish_fanout(payload_mb: float = 4.0, subscribers: int = 12,
 
 # --------------------------------------------------------------- scenario 6
 
+# ------------------------------------------------------------ scenario 9
+# Adaptive FT policy vs fixed policies under phase-varying chaos
+# (docs/design/adaptive_policy.md; ROADMAP item 3's acceptance gate).
+
+def bench_policy_soak(policy: str = "adaptive",
+                      phases: tuple = ((5.0, 0.0), (12.0, 1.0),
+                                       (5.0, 0.0)),
+                      seed: int = 77, n_groups: int = 2,
+                      hidden: int = 128,
+                      drain_steps: int = 4) -> Dict[str, Any]:
+    """One leg of the adaptive-vs-fixed A/B: ``n_groups`` replica groups
+    run :class:`~torchft_tpu.policy.AdaptiveTrainer` for a FIXED wall
+    budget (the phase table's total) while a seeded chaos schedule
+    sweeps stable -> storm -> stable intensity over the host ring, then
+    a short clean drain lets in-flight recoveries converge so the
+    bitwise-lockstep oracle is exact.
+
+    ``policy="adaptive"`` attaches a
+    :class:`~torchft_tpu.policy.PolicyController` per manager (the
+    quorum's rank 0 decides, the rest follow the published rung); any
+    other name pins that fixed :data:`~torchft_tpu.policy.POLICIES`
+    entry for the whole run.
+
+    The gate metric is **protocol-committed batches per second** —
+    ``Manager.batches_committed`` (min across groups), the repo's
+    long-standing commit counter: it advances by the participating
+    world per committed BOUNDARY, so a DiLoCo leg earns credit once
+    per outer round, not per inner step. That deliberately prices
+    DiLoCo's trade — protocol-visible commit granularity coarsens by
+    ``sync_every`` (durable saves/publishes gate on commits, and a
+    failure costs a whole round of agreed progress) — which also means
+    a fixed ``diloco-16`` leg loses this gate by construction; the
+    competitive baselines are sync-f32 and overlap-bf16. The result
+    additionally reports ``trainer_batches_per_s`` (the driver's count,
+    crediting a committed round with its ``sync_every`` inner batches)
+    so the raw-throughput view of the same runs is visible next to the
+    gate."""
+    from torchft_tpu import (HostCommunicator, Lighthouse, Manager,
+                             chaos)
+    from torchft_tpu.chaos import ChaosCommunicator, ChaosSchedule, \
+        EndpointChaos
+    from torchft_tpu.policy import (POLICIES, AdaptiveTrainer,
+                                    PhasedChaos, PolicyController)
+
+    adaptive = policy == "adaptive"
+    schedule = ChaosSchedule(seed=seed, endpoints={
+        # Storm faults target the per-segment ring ops: narrower wire
+        # rungs do fewer ops per collective, so descending the ladder
+        # genuinely shrinks the per-step fault exposure (and the
+        # per-op latency tax).
+        "ring": EndpointChaos(latency_ms=0.5, jitter_ms=1.0,
+                              reset_rate=0.03, short_rate=0.02),
+        "allreduce": EndpointChaos(reset_rate=0.01),
+    }, intensity=0.0)
+    chaos.install(schedule)
+    phaser = PhasedChaos(schedule, phases)
+    lh = Lighthouse(bind="127.0.0.1:0", min_replicas=1,
+                    join_timeout_ms=1000, quorum_tick_ms=50)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(32, 16)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 4, size=(32,)), jnp.int32)
+    from torchft_tpu.models import MLP
+
+    model = MLP(features=(hidden,), num_classes=4)
+    params0 = model.init(jax.random.key(7), x[:1])
+
+    def loss_fn(params, batch):
+        logits = model.apply(params, batch["x"])
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, batch["y"]).mean()
+
+    results: Dict[str, Dict[str, Any]] = {}
+
+    def worker(gid: str) -> None:
+        kwargs: Dict[str, Any] = {}
+        if adaptive:
+            kwargs["policy_controller"] = PolicyController(
+                window=6, escalate_failures=2, relax_after=8,
+                cooldown=3)
+        else:
+            kwargs["policy"] = POLICIES[policy]
+        trainer = AdaptiveTrainer(
+            loss_fn=loss_fn, tx=optax.sgd(0.05), params=params0,
+            manager_factory=lambda load, save: Manager(
+                comm=ChaosCommunicator(HostCommunicator(timeout_sec=15)),
+                load_state_dict=load, state_dict=save,
+                min_replica_size=1, replica_id=f"{policy}-{gid}",
+                lighthouse_addr=lh.address(), rank=0, world_size=1,
+                timeout_ms=15_000, quorum_timeout_ms=15_000,
+                max_consecutive_failures=1000, **kwargs))
+        b = {"x": x, "y": y}
+        try:
+            trainer.train_step(b)  # compile + join + first reconfigure
+            t0 = time.perf_counter()
+            base = trainer.manager.batches_committed()
+            deadline = t0 + phaser.total_seconds()
+            while time.perf_counter() < deadline:
+                trainer.train_step(b)
+            trainer.flush()
+            # Clean drain TO A COMMITTED BOUNDARY: chaos is silenced
+            # (intensity 0 terminal phase + uninstall below), and the
+            # groups keep stepping until a boundary commits — which in
+            # DiLoCo mode means driving through the remainder of the
+            # inner cycle to the next outer round, where params land on
+            # the shared anchor. Both groups' committed boundary is the
+            # SAME collective, so both stop in the same protocol state
+            # and the bitwise-lockstep oracle is exact (a fixed step
+            # count would slice a DiLoCo leg mid-cycle at
+            # thread-skewed local_steps).
+            for _ in range(max(drain_steps, 1) * 64):
+                _, committed = trainer.train_step(b)
+                if committed:
+                    break
+            trainer.flush()
+            wall = time.perf_counter() - t0
+            mx = trainer.manager.metrics()
+            results[gid] = {
+                "params": jax.device_get(trainer.params),
+                "committed_batches":
+                    trainer.manager.batches_committed() - base,
+                "trainer_batches": trainer.committed_batches,
+                "wall_s": wall,
+                "switches": mx["policy_switches_total"],
+                "aborted_steps": mx["aborted_steps"],
+                "policy_final": mx["policy_name"],
+                "int8_ring_mbytes":
+                    mx["allreduce_int8_ring_bytes_total"] / 1e6,
+                "events": [e for e in trainer.manager.history()
+                           if str(e.get("event", ""))
+                           .startswith("policy")],
+            }
+        finally:
+            trainer.shutdown()
+
+    phaser.start()
+    threads = [threading.Thread(target=worker, args=(f"g{i}",))
+               for i in range(n_groups)]
+    try:
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=phaser.total_seconds() + 240)
+    finally:
+        phaser.stop()
+        chaos.uninstall()
+        lh.shutdown()
+    if len(results) != n_groups:
+        raise RuntimeError(f"policy soak leg {policy!r}: only "
+                           f"{len(results)}/{n_groups} groups finished")
+    walls = [r["wall_s"] for r in results.values()]
+    committed = min(r["committed_batches"] for r in results.values())
+    trainer_batches = min(r["trainer_batches"]
+                          for r in results.values())
+    return {
+        "policy": policy,
+        "committed_batches_per_s": committed / max(max(walls), 1e-9),
+        "committed_batches": committed,
+        "trainer_batches_per_s":
+            trainer_batches / max(max(walls), 1e-9),
+        "switches": max(r["switches"] for r in results.values()),
+        "aborted_steps": max(r["aborted_steps"]
+                             for r in results.values()),
+        "events": next(iter(results.values()))["events"],
+        "groups": results,
+    }
+
+
 def _native_control_plane_available() -> bool:
     """Probe for the C++ control-plane library (mirrors tests/conftest.py's
     native_available): the quorum benches are thin ctypes loops and skip
@@ -1464,6 +1637,7 @@ def main() -> None:
     _emit({"metric": "multigroup_steps_per_s",
            "value": round(mg["steps_per_s"], 2), "unit": "steps/s",
            "n_groups": mg["n_groups"], "backend": "host",
+           "policy": mg["policy"],
            "allreduce_ms_avg": round(mg["allreduce_ms_avg"], 2),
            "grad_mbytes": round(mg["grad_mbytes"], 2),
            "quorum_ms_p50": round(mg["quorum_ms_p50"], 2),
@@ -1475,6 +1649,7 @@ def main() -> None:
     _emit({"metric": "multigroup_bf16_wire_steps_per_s",
            "value": round(mw["steps_per_s"], 2), "unit": "steps/s",
            "n_groups": mw["n_groups"], "backend": "host+bf16wire",
+           "policy": mw["policy"],
            "allreduce_ms_avg": round(mw["allreduce_ms_avg"], 2),
            "speedup_vs_exact": round(mw["steps_per_s"]
                                      / max(mg["steps_per_s"], 1e-9), 2),
@@ -1490,6 +1665,7 @@ def main() -> None:
     m1 = bench_multigroup(bucket_bytes=1 << 40, **big)  # single-shot
     mb = bench_multigroup(bucket_bytes=2 << 20, **big)  # pipelined buckets
     _emit({"metric": "multigroup_8mb_ab",
+           "policy": mb["policy"],
            "grad_mbytes": round(mb["grad_mbytes"], 2),
            "single_shot_steps_per_s": round(m1["steps_per_s"], 3),
            "bucketed_steps_per_s": round(mb["steps_per_s"], 3),
@@ -1501,6 +1677,7 @@ def main() -> None:
                            wire_dtype=jnp.bfloat16, **big)
     _emit({"metric": "multigroup_8mb_bf16_wire",
            "value": round(mwb["steps_per_s"], 3), "unit": "steps/s",
+           "policy": mwb["policy"],
            "speedup_vs_exact": round(
                mwb["steps_per_s"] / max(mb["steps_per_s"], 1e-9), 2),
            "wire_mbytes_per_step": round(mwb["wire_mbytes_per_step"], 2),
@@ -1524,6 +1701,7 @@ def main() -> None:
                 for k, v in r["stages_ms"].items()}
 
     _emit({"metric": "multigroup_8mb_overlap_ab",
+           "sync_policy": mb["policy"], "overlap_policy": mov["policy"],
            "grad_mbytes": round(mov["grad_mbytes"], 2),
            "sync_steps_per_s": round(mb["steps_per_s"], 3),
            "overlap_steps_per_s": round(mov["steps_per_s"], 3),
@@ -1542,6 +1720,7 @@ def main() -> None:
     # (less fold compute; comparable ring bytes at world 2).
     mrs = bench_multigroup(bucket_bytes=2 << 20, shard_update=True, **big)
     _emit({"metric": "multigroup_8mb_rs_ab",
+           "policy": mrs["policy"],
            "grad_mbytes": round(mrs["grad_mbytes"], 2),
            "allreduce_steps_per_s": round(mb["steps_per_s"], 3),
            "rs_steps_per_s": round(mrs["steps_per_s"], 3),
@@ -1614,6 +1793,7 @@ def main() -> None:
     _emit({"metric": "multigroup_mesh_steps_per_s",
            "value": round(mm["steps_per_s"], 2), "unit": "steps/s",
            "n_groups": mm["n_groups"], "backend": "mesh",
+           "policy": mm["policy"],
            "allreduce_ms_avg": round(mm["allreduce_ms_avg"], 2),
            "speedup_vs_host": round(mm["steps_per_s"]
                                     / max(mg["steps_per_s"], 1e-9), 2)})

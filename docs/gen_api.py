@@ -36,6 +36,7 @@ MODULES = [
     ("torchft_tpu.serving", "Live weight publication + relay fan-out"),
     ("torchft_tpu.serialization", "Streaming pytree wire format"),
     ("torchft_tpu.optim", "Commit-gated optimizer wrappers"),
+    ("torchft_tpu.policy", "Adaptive fault-tolerance policy"),
     ("torchft_tpu.data", "Replica-group data sharding"),
     ("torchft_tpu.local_sgd", "DiLoCo-style local SGD"),
     ("torchft_tpu.parallel.step", "Fault-tolerant training step"),

@@ -115,6 +115,25 @@ if [[ "${1:-}" == "serve" ]]; then
     exit 0
 fi
 
+# Policy tier: the adaptive FT policy layer's focused gate
+# (docs/design/adaptive_policy.md) — FTPolicy/PolicyController
+# ladder+hysteresis units, the Manager's commit-boundary switch
+# machinery (refusal mid-heal/mid-deferred, state-dict adoption,
+# fake-store decider/follower coordination incl. the
+# switch-racing-a-heal deferral), the int8+error-feedback wire rung
+# (socketpair-ring bitwise identity at worlds 2/3/5, ~1/4 ring bytes,
+# EF drift A/B, wire-format-skew detection), DiLoCo set_sync_every,
+# and AdaptiveTrainer mode transitions. Tier-1 too (not marked slow);
+# run this tier on policy/manager/communicator/host changes. The
+# phase-varying adaptive-vs-fixed chaos soak is nightly+slow and rides
+# the nightly tier.
+if [[ "${1:-}" == "policy" ]]; then
+    stage policy env JAX_PLATFORMS=cpu \
+        python -m pytest tests/test_policy.py -q -m "policy and not slow"
+    echo "== total: ${SECONDS}s"
+    exit 0
+fi
+
 # Cold-start tier: seeded kill-all → cold-restart soak — every round a
 # 2-group job checkpoints under disk chaos (torn writes, silent
 # bit-flips, ENOSPC), the whole fleet "dies", and recovery must come

@@ -56,6 +56,17 @@ DOCUMENTED_KEYS = frozenset([
     "publish_last_generation",
     # transport retries
     "retry_count", "retry_ms_total", "retry_giveups",
+    # adaptive FT policy (docs/design/adaptive_policy.md)
+    "policy_current", "policy_switches_total",
+    "policy_switch_refusals", "policy_switch_deferrals",
+    "failure_rate", "wire_quant_residual_bytes",
+    "allreduce_int8_ring_bytes_total",
+])
+
+# String-valued diagnostics (like ckpt_last_error): present in every
+# snapshot but outside the numeric schema above.
+DOCUMENTED_STRING_KEYS = frozenset([
+    "policy_name", "policy_last_reason",
 ])
 
 
@@ -97,6 +108,19 @@ class TestMetricsSchema:
                 assert isinstance(mx[key], (int, float)), (
                     f"{key} is {type(mx[key]).__name__}, expected "
                     "int/float")
+        finally:
+            m.shutdown()
+
+    def test_string_diagnostics_present(self):
+        """The policy identity keys are strings by design (dashboards
+        show the policy NAME next to its counters); they must stay
+        present and non-numeric-schema."""
+        m = make_manager()
+        try:
+            mx = m.metrics()
+            for key in DOCUMENTED_STRING_KEYS:
+                assert isinstance(mx[key], str), key
+            assert mx["policy_name"]
         finally:
             m.shutdown()
 

@@ -40,13 +40,25 @@ from torchft_tpu.local_sgd import (DiLoCoTrainer, StreamingDiLoCoTrainer,
 from torchft_tpu.manager import Manager, WorldSizeMode
 from torchft_tpu.optim import (DelayedOptimizer, FTOptimizer,
                                OptimizerWrapper)
+from torchft_tpu.policy import (LADDER, POLICIES, AdaptiveTrainer,
+                                FTPolicy, PhasedChaos, PolicyController,
+                                PolicySignals)
+from torchft_tpu.communicator import Int8Wire
 from torchft_tpu.serving import (PublicationServer, StaleWeightsError,
                                  WeightPublisher, WeightRelay,
                                  WeightSubscriber)
 
 __all__ = [
+    "AdaptiveTrainer",
     "AsyncCheckpointer",
     "BatchIterator",
+    "FTPolicy",
+    "Int8Wire",
+    "LADDER",
+    "PhasedChaos",
+    "POLICIES",
+    "PolicyController",
+    "PolicySignals",
     "ChaosCommunicator",
     "ChaosSchedule",
     "CheckpointServer",

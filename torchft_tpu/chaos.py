@@ -161,16 +161,30 @@ class ChaosSchedule:
 
     def __init__(self, seed: int = 0,
                  endpoints: Optional[Dict[str, EndpointChaos]] = None,
-                 trace_cap: int = 100_000) -> None:
+                 trace_cap: int = 100_000,
+                 intensity: float = 1.0) -> None:
         """``trace_cap`` bounds the recorded trace: a multi-hour soak
         draws a decision per ring segment / RPC / stream read, and an
         unbounded list would grow into gigabytes on the collective hot
         path. Decisions past the cap still DRAW (determinism and fault
         injection are unaffected) but are only counted —
         ``trace_dropped`` says how many; reproducibility asserts must
-        fit their op sequence under the cap."""
+        fit their op sequence under the cap.
+
+        ``intensity`` scales every hard-fault rate (reset/short/
+        blackhole/kill/torn/flip/enospc — latency and jitter are left
+        alone) and can be changed live via :meth:`set_intensity`, which
+        is what gives a soak *time-varying* chaos: stable -> storm ->
+        stable phases for an adaptive policy to adapt across
+        (ISSUE 10; :class:`torchft_tpu.policy.PhasedChaos` drives it
+        from a wall-clock phase table). The RNG draw SEQUENCE is
+        intensity-independent — only the fault threshold moves — so
+        per-channel streams keep their (seed, channel, n) purity and a
+        replay that applies the same intensity at the same op indices
+        reproduces the identical trace."""
         self.seed = int(seed)
         self.endpoints: Dict[str, EndpointChaos] = dict(endpoints or {})
+        self._intensity = float(intensity)
         self.trace_cap = int(trace_cap)
         self.trace_dropped = 0
         self._lock = threading.Lock()
@@ -185,6 +199,18 @@ class ChaosSchedule:
         self._bytes: Dict[str, int] = {}
 
     # ------------------------------------------------------------- config
+
+    def set_intensity(self, scale: float) -> None:
+        """Scale every channel's hard-fault rates by ``scale`` from the
+        next decision on (0 = the storm is over, 1 = as configured,
+        >1 = storm). Latency/jitter and ``kill_after_bytes`` are
+        unaffected; ``max_faults`` caps keep counting."""
+        with self._lock:
+            self._intensity = max(0.0, float(scale))
+
+    def intensity(self) -> float:
+        with self._lock:
+            return self._intensity
 
     def config_for(self, endpoint: str) -> Optional[EndpointChaos]:
         """Effective config: exact endpoint, else its channel (the part
@@ -226,6 +252,7 @@ class ChaosSchedule:
             fault: Optional[str] = None
             u = rng.random()
             acc = 0.0
+            scale = self._intensity
             for rate, kind in ((cfg.reset_rate, "reset"),
                                (cfg.short_rate, "short"),
                                (cfg.blackhole_rate, "blackhole"),
@@ -233,7 +260,7 @@ class ChaosSchedule:
                                (cfg.torn_rate, "torn"),
                                (cfg.flip_rate, "flip"),
                                (cfg.enospc_rate, "enospc")):
-                acc += rate
+                acc += rate * scale
                 if u < acc:
                     fault = kind
                     break
@@ -331,6 +358,7 @@ class ChaosSchedule:
 def parse_spec(spec: str) -> ChaosSchedule:
     """Parse a ``TORCHFT_CHAOS`` spec string into a schedule."""
     seed = 0
+    intensity = 1.0
     endpoints: Dict[str, EndpointChaos] = {}
     valid = {f.name: f.type for f in fields(EndpointChaos)}
     for clause in spec.split(";"):
@@ -339,6 +367,11 @@ def parse_spec(spec: str) -> ChaosSchedule:
             continue
         if clause.startswith("seed="):
             seed = int(clause[len("seed="):])
+            continue
+        if clause.startswith("intensity="):
+            # Initial hard-fault-rate scale (set_intensity can move it
+            # live — the stable->storm->stable soak knob).
+            intensity = float(clause[len("intensity="):])
             continue
         channel, sep, params = clause.partition(":")
         if not sep:
@@ -359,7 +392,8 @@ def parse_spec(spec: str) -> ChaosSchedule:
             cast = int if key == "max_faults" else float
             cfg = replace(cfg, **{key: cast(value)})
         endpoints[channel.strip()] = cfg
-    return ChaosSchedule(seed=seed, endpoints=endpoints)
+    return ChaosSchedule(seed=seed, endpoints=endpoints,
+                         intensity=intensity)
 
 
 # ------------------------------------------------------- global activation
@@ -818,8 +852,14 @@ class ChaosCommunicator(Communicator):
     def set_retry_policy(self, policy: Any, stats: Any = None) -> None:
         self._comm.set_retry_policy(policy, stats)
 
+    def set_wire_tag(self, tag: str) -> None:
+        self._comm.set_wire_tag(tag)
+
     def ring_bytes_total(self) -> float:
         return self._comm.ring_bytes_total()
+
+    def int8_ring_bytes_total(self) -> float:
+        return self._comm.int8_ring_bytes_total()
 
     def shutdown(self) -> None:
         self._comm.shutdown()

@@ -44,11 +44,147 @@ logger: logging.Logger = logging.getLogger(__name__)
 def _upcast_buffers(buffers: Sequence[Any],
                     orig_dtypes: Sequence[Any]) -> List[np.ndarray]:
     """Flatten + upcast wire buffers to their accumulator dtypes (the
-    default / fallback spelling of :meth:`Communicator.allreduce_wire`)."""
-    return [
-        np.ravel(np.asarray(b)).astype(np.dtype(d), copy=False)
-        for b, d in zip(buffers, orig_dtypes)
-    ]
+    default / fallback spelling of :meth:`Communicator.allreduce_wire`).
+    :class:`Int8Wire` buffers dequantize — one affine reconstruction,
+    exactly the contribution the ring fold would have used."""
+    out = []
+    for b, d in zip(buffers, orig_dtypes):
+        if isinstance(b, Int8Wire):
+            out.append(b.dequantize(np.dtype(d)))
+        else:
+            out.append(np.ravel(np.asarray(b)).astype(np.dtype(d),
+                                                      copy=False))
+    return out
+
+
+# Elements per int8 quantization segment: small enough that one affine
+# (scale, zero) pair tracks the local value range (gradients are far
+# from uniform across a packed chunk), large enough that the 8-byte
+# per-segment header is noise (<0.02% of payload) — so the ring moves
+# ~1/4 of the f32 bytes, the rung's reason to exist.
+INT8_SEG_ELEMS = 65_536
+
+
+class Int8Wire:
+    """One chunk's int8 + per-segment-affine wire form (the new rung of
+    the wire ladder, ISSUE 10): ``q[k]`` reconstructs as
+    ``q[k] * scale[seg] + zero[seg]`` with ``seg = k // seg_elems``.
+
+    Quantization happens exactly once per contribution, on the
+    contributing rank (``quantize``, usually with the Manager's
+    error-feedback residual already folded into ``values``); the ring
+    moves raw ``(scales, zeros, q)`` — never partial sums — and every
+    rank folds the dequantized contributions in canonical rank order
+    into a full-precision accumulator, the same
+    bitwise-identity-across-ranks contract as the bf16 wire path
+    (``backends/host.py:_ring_allreduce_int8``).
+
+    Constant segments (all values equal — e.g. a healer's zero
+    contribution) encode as ``scale=0, zero=v`` and reconstruct
+    EXACTLY: zeros stay exact in this format just as they do in any
+    float wire dtype.
+    """
+
+    __slots__ = ("q", "scales", "zeros", "size", "seg_elems")
+
+    def __init__(self, q: np.ndarray, scales: np.ndarray,
+                 zeros: np.ndarray,
+                 seg_elems: int = INT8_SEG_ELEMS) -> None:
+        self.q = q
+        self.scales = scales
+        self.zeros = zeros
+        self.size = int(q.size)
+        self.seg_elems = int(seg_elems)
+
+    @staticmethod
+    def nseg(size: int, seg_elems: int = INT8_SEG_ELEMS) -> int:
+        return max(1, -(-int(size) // int(seg_elems)))
+
+    @staticmethod
+    def quantize(values: np.ndarray,
+                 seg_elems: int = INT8_SEG_ELEMS) -> "Int8Wire":
+        """Per-segment affine quantization of a 1-D float buffer.
+        Deterministic (pure numpy, round-half-even via ``np.rint``) so
+        identically-seeded groups quantize identically."""
+        v = np.ravel(np.asarray(values)).astype(np.float32, copy=False)
+        n = v.size
+        nseg = Int8Wire.nseg(n, seg_elems)
+        scales = np.zeros(nseg, np.float32)
+        zeros = np.zeros(nseg, np.float32)
+        q = np.zeros(n, np.int8)
+        for s in range(nseg):
+            seg = v[s * seg_elems:(s + 1) * seg_elems]
+            lo = float(seg.min())
+            hi = float(seg.max())
+            zero = (hi + lo) / 2.0
+            scale = (hi - lo) / 254.0
+            if not (np.isfinite(zero) and np.isfinite(scale)):
+                # Non-finite segment (a loss-spike inf/NaN element):
+                # encode as exact zero rather than poisoning the whole
+                # segment's reconstruction with NaN — the contribution
+                # is junk either way, but this keeps the format (and
+                # the caller's error-feedback residual, see
+                # Manager._int8_quantize_bucket) finite so the rank
+                # recovers on the next clean step instead of banking
+                # NaN forever.
+                continue
+            zeros[s] = zero
+            if scale <= 0.0:
+                continue  # constant segment: q=0, reconstructs exactly
+            scales[s] = scale
+            q[s * seg_elems:(s + 1) * seg_elems] = np.clip(
+                np.rint((seg - zero) / scale), -127, 127).astype(np.int8)
+        return Int8Wire(q, scales, zeros, seg_elems)
+
+    def dequantize(self, dtype: Any = np.float32) -> np.ndarray:
+        """Affine reconstruction into the accumulator dtype."""
+        out = np.empty(self.size, np.float32)
+        for s in range(len(self.scales)):
+            sl = slice(s * self.seg_elems,
+                       min((s + 1) * self.seg_elems, self.size))
+            out[sl] = (self.q[sl].astype(np.float32) * self.scales[s]
+                       + self.zeros[s])
+        return out.astype(np.dtype(dtype), copy=False)
+
+    # -------------------------------------------------- ring wire format
+    # Fixed-size payload derivable from (size, seg_elems) alone, so
+    # every rank computes identical byte counts from the shared chunk
+    # geometry — the property the ring's symmetric exchanges need.
+
+    def wire_nbytes(self) -> int:
+        return Int8Wire.payload_nbytes(self.size, self.seg_elems)
+
+    @staticmethod
+    def payload_nbytes(size: int,
+                       seg_elems: int = INT8_SEG_ELEMS) -> int:
+        return 8 * Int8Wire.nseg(size, seg_elems) + int(size)
+
+    def to_bytes(self) -> bytes:
+        return (self.scales.astype("<f4").tobytes()
+                + self.zeros.astype("<f4").tobytes()
+                + np.ascontiguousarray(self.q).tobytes())
+
+    @staticmethod
+    def from_bytes(payload: Any, size: int,
+                   seg_elems: int = INT8_SEG_ELEMS) -> "Int8Wire":
+        nseg = Int8Wire.nseg(size, seg_elems)
+        mv = memoryview(payload)
+        scales = np.frombuffer(mv[:4 * nseg], "<f4").astype(np.float32)
+        zeros = np.frombuffer(mv[4 * nseg:8 * nseg],
+                              "<f4").astype(np.float32)
+        q = np.frombuffer(mv[8 * nseg:8 * nseg + size],
+                          np.int8).copy()
+        return Int8Wire(q, scales, zeros, seg_elems)
+
+    @staticmethod
+    def zeros_like(size: int,
+                   seg_elems: int = INT8_SEG_ELEMS) -> "Int8Wire":
+        """Exact-zero contribution from metadata only (healers/spares —
+        the int8 spelling of ``np.zeros(c.total, c.wire)``)."""
+        nseg = Int8Wire.nseg(size, seg_elems)
+        return Int8Wire(np.zeros(size, np.int8),
+                        np.zeros(nseg, np.float32),
+                        np.zeros(nseg, np.float32), seg_elems)
 
 
 def shard_bounds(size: int, world: int) -> np.ndarray:
@@ -165,6 +301,13 @@ class Communicator(ABC):
         byte-counted transport report 0.0; wrappers MUST forward."""
         return 0.0
 
+    def int8_ring_bytes_total(self) -> float:
+        """The :class:`Int8Wire` slice of :meth:`ring_bytes_total`
+        (payload + per-segment headers), surfaced by the Manager as
+        ``allreduce_int8_ring_bytes_total`` so the int8 rung's ~4x ring
+        saving is observable on its own. Wrappers MUST forward."""
+        return 0.0
+
     @abstractmethod
     def broadcast(self, tree: Any, root: int = 0) -> Future:
         """Broadcast root's pytree to all ranks."""
@@ -196,6 +339,19 @@ class Communicator(ABC):
         communicator — a fingerprint stranded on a wrapper silently
         disables the check."""
         self.allreduce_config_fingerprint = fp
+
+    def set_wire_tag(self, tag: str) -> None:
+        """Name the PAYLOAD KIND of subsequent wire ops (the Manager
+        sets "step" for per-step grads, "diloco" for outer-round
+        pseudo-gradients, synchronously before issuing each pipeline's
+        ops). Byte-counted transports mix it into the per-op format
+        preamble so two groups momentarily skewed across a DiLoCo mode
+        transition abort cleanly instead of folding a pseudo-gradient
+        into a per-step gradient of identical geometry. Wrappers MUST
+        forward inward — a tag stranded on a wrapper silently disables
+        the check (degrading to no-tag matching, never to a false
+        abort)."""
+        self.wire_tag = tag
 
     def set_retry_policy(self, policy: Any, stats: Any = None) -> None:
         """Install the owning Manager's transient-error retry policy and
@@ -402,8 +558,14 @@ class ErrorSwallowingCommunicator(Communicator):
     def set_retry_policy(self, policy: Any, stats: Any = None) -> None:
         self._comm.set_retry_policy(policy, stats)
 
+    def set_wire_tag(self, tag: str) -> None:
+        self._comm.set_wire_tag(tag)
+
     def ring_bytes_total(self) -> float:
         return self._comm.ring_bytes_total()
+
+    def int8_ring_bytes_total(self) -> float:
+        return self._comm.int8_ring_bytes_total()
 
     def shutdown(self) -> None:
         self._comm.shutdown()
@@ -523,8 +685,14 @@ class ManagedCommunicator(Communicator):
     def set_retry_policy(self, policy: Any, stats: Any = None) -> None:
         self._comm.set_retry_policy(policy, stats)
 
+    def set_wire_tag(self, tag: str) -> None:
+        self._comm.set_wire_tag(tag)
+
     def ring_bytes_total(self) -> float:
         return self._comm.ring_bytes_total()
+
+    def int8_ring_bytes_total(self) -> float:
+        return self._comm.int8_ring_bytes_total()
 
     @property
     def wants_device_arrays(self) -> bool:

@@ -68,6 +68,10 @@ class DiLoCoTrainer:
         self.inner_state = inner_tx.init(params)
         self.outer_state = self._outer_tx.init(params)
         self.local_steps = 0
+        # Boundary-staged sync_every change (set_sync_every): applied at
+        # the END of the next outer round, so the current inner cycle
+        # completes under the cadence its peers are counting with.
+        self._pending_sync_every: Optional[int] = None
 
         def inner_step(p, st, batch):
             loss, grads = jax.value_and_grad(loss_fn)(p, batch)
@@ -121,7 +125,39 @@ class DiLoCoTrainer:
         else:
             logger.warning("outer round %d aborted; continuing locally",
                            m.current_step())
+        self._apply_pending_sync_every()
         return committed
+
+    # ---------------------------------------------- adaptive cadence
+
+    def set_sync_every(self, sync_every: int) -> None:
+        """Boundary-safe cadence change (needed by the adaptive policy
+        controller — the DiLoCo rung tunes ``sync_every`` to the
+        observed failure rate — and useful standalone): validated
+        eagerly (same rules as the constructor, including the
+        ``fragments`` divisibility in
+        :class:`StreamingDiLoCoTrainer`), staged, and applied at the
+        END of the next outer round — the current inner cycle completes
+        under the old cadence, so every group's round boundaries keep
+        agreeing (rounds are the only point the FT protocol
+        synchronizes, and cadence must only change there)."""
+        self._validate_sync_every(int(sync_every))
+        self._pending_sync_every = int(sync_every)
+
+    def _validate_sync_every(self, sync_every: int) -> None:
+        if sync_every < 1:
+            raise ValueError(
+                f"sync_every must be >= 1, got {sync_every!r}")
+
+    def _apply_pending_sync_every(self) -> None:
+        if self._pending_sync_every is None:
+            return
+        old, self.sync_every = self.sync_every, self._pending_sync_every
+        self._pending_sync_every = None
+        if old != self.sync_every:
+            logger.info("sync_every %d -> %d at round boundary "
+                        "(step %d)", old, self.sync_every,
+                        self.manager.current_step())
 
     # ------------------------------------------------- state (for healing)
 
@@ -314,6 +350,7 @@ class StreamingDiLoCoTrainer(DiLoCoTrainer):
         if self.local_steps % self.interval == 0:
             committed = self.collect_pending()
             self.launch_fragment()
+            self._apply_pending_sync_every()
         return loss, committed
 
     def outer_round(self) -> bool:
@@ -321,7 +358,21 @@ class StreamingDiLoCoTrainer(DiLoCoTrainer):
         in-flight fragment round (if any), then launch the next one."""
         committed = self.collect_pending()
         self.launch_fragment()
+        self._apply_pending_sync_every()
         return bool(committed)
+
+    def _validate_sync_every(self, sync_every: int) -> None:
+        super()._validate_sync_every(sync_every)
+        if sync_every % self.fragments:
+            raise ValueError(
+                f"sync_every ({sync_every}) must be divisible by "
+                f"fragments ({self.fragments})")
+
+    def _apply_pending_sync_every(self) -> None:
+        changed = self._pending_sync_every is not None
+        super()._apply_pending_sync_every()
+        if changed:
+            self.interval = self.sync_every // self.fragments
 
     def launch_fragment(self) -> int:
         """Start the next fragment's outer round: the fragment's

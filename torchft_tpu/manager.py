@@ -51,16 +51,21 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from torchft_tpu import policy as policy_mod
 from torchft_tpu._native import ManagerClient, ManagerServer, Store, StoreClient
 from torchft_tpu.checkpointing import CheckpointServer
 from torchft_tpu.communicator import (Communicator, CommunicatorError,
-                                      shard_bounds)
+                                      Int8Wire, shard_bounds)
 from torchft_tpu.retry import RetryPolicy, RetryStats
 from torchft_tpu.utils import advertise_host, div_by_count
 
 logger: logging.Logger = logging.getLogger(__name__)
 
 MANAGER_ADDR_KEY: str = "manager/addr"
+# Fixed quorum-store key the adaptive-policy decision rides on (fixed,
+# like the healset keys: the store has no delete/TTL, so a per-step key
+# would leak one entry per boundary for the life of the job).
+_POLICY_KEY: str = "torchft/policy"
 T = TypeVar("T")
 
 
@@ -240,6 +245,26 @@ class Manager:
             concurrent healers spread their load. Falls back to the
             single-donor resumable fetch when the donor set cannot be
             resolved (no native store, lone donor).
+        policy: explicit initial :class:`~torchft_tpu.policy.FTPolicy`
+            (docs/design/adaptive_policy.md): one hot-swappable bundle
+            of the FT knobs (overlap_steps / wire rung / DiLoCo /
+            durable-checkpoint cadence) that wins over the legacy knob
+            args and can be switched between steps via
+            :meth:`set_policy`. Without it, a fixed policy is
+            synthesized from the legacy knobs so :meth:`policy` is
+            always answerable.
+        policy_controller: optional
+            :class:`~torchft_tpu.policy.PolicyController` enabling the
+            ADAPTIVE mode: the quorum's participating rank 0 walks the
+            controller's escalation ladder from the windowed failure
+            rate and comm/compute ratio, publishing each decision on
+            the quorum store at the commit boundary; every group
+            (controller attached) follows. Composes with ``policy``
+            (the explicit policy is the starting rung).
+        event_history: depth of the event log served at
+            ``/metrics.json`` (env ``TORCHFT_EVENT_HISTORY``, default
+            64) — the controller's failure-rate window reads it, and
+            64 events is shallow for that at high churn.
     """
 
     def __init__(
@@ -271,6 +296,9 @@ class Manager:
         retry_policy: Optional[RetryPolicy] = None,
         heal_stall_timeout_sec: Optional[float] = None,
         heal_max_donor_failovers: int = 3,
+        policy: Optional["policy_mod.FTPolicy"] = None,
+        policy_controller: Optional["policy_mod.PolicyController"] = None,
+        event_history: Optional[int] = None,
         _manager_client: Optional[ManagerClient] = None,
     ) -> None:
         self._comm = comm
@@ -284,6 +312,49 @@ class Manager:
                 "overlap_steps must be 0 (sync commit) or 1 (one-step "
                 f"deferred commit), got {overlap_steps!r}")
         self._overlap_steps = int(overlap_steps)
+        # --- adaptive FT policy (docs/design/adaptive_policy.md) ---------
+        # The FT knobs (overlap_steps / wire rung / DiLoCo / durable-
+        # checkpoint cadence) live in ONE hot-swappable FTPolicy. An
+        # explicit `policy=` wins over the legacy knob args; with only a
+        # controller, its ladder's rung 0 is the starting policy; with
+        # neither, a fixed policy is synthesized from the legacy knobs so
+        # every Manager reports a coherent policy_name (and stays
+        # switchable via set_policy). `_policy_aware` gates the parts
+        # with cross-version surface (state-dict policy fields, the
+        # "dynamic" rendezvous fingerprint): only managers explicitly
+        # opted into hot-swapping carry them.
+        self._controller = policy_controller
+        self._policy_aware = (policy is not None
+                              or policy_controller is not None)
+        if policy is None:
+            policy = (policy_controller.policy()
+                      if policy_controller is not None
+                      else policy_mod.from_knobs(self._overlap_steps,
+                                                 self._wire_dtype))
+        self._policy = policy
+        if self._policy_aware:
+            self._install_policy_knobs(policy)
+        if self._controller is not None:
+            rung = self._controller.rung_of(policy)
+            if rung is not None:
+                self._controller.sync_rung(rung)
+        # Decider-side staged proposal + latest published decision
+        # (step, rung, reason, signals), and the per-boundary counter
+        # snapshot the comm/compute signal derives from.
+        self._policy_pending: Optional[tuple] = None
+        self._policy_published: Optional[tuple] = None
+        self._policy_last_reason = "init"
+        self._policy_prev_counters: Optional[Dict[str, float]] = None
+        # Last quorum round's coordination facts (store address,
+        # replica/max world) — stamped by _async_quorum_inner, consumed
+        # by the commit-boundary hook.
+        self._policy_round: Optional[tuple] = None
+        # int8+error-feedback wire rung state: persistent per-chunk
+        # residual buffers, folded into the next contribution before
+        # quantization (cleared on any wire-rung change). Keyed by
+        # (schedule fingerprint, bucket, chunk); mutated only on the
+        # caller thread that runs the pipelines.
+        self._ef_residuals: Dict[tuple, np.ndarray] = {}
         self._shard_update = bool(shard_update)
         if heal_striped is None:
             heal_striped = os.environ.get(
@@ -428,8 +499,27 @@ class Manager:
             "publish_skipped": 0.0,
             "publish_ms_total": 0.0,
             "publish_last_generation": 0.0,
+            # Adaptive-policy observability
+            # (docs/design/adaptive_policy.md): the ladder rung in force
+            # (gauge; -1 = not on the attached controller's ladder /
+            # no controller), applied switches, refusals (mid-heal /
+            # errored / deferred — the switch analogue of
+            # ckpt_save_skipped), switches deferred because a heal was
+            # in flight somewhere in the quorum, the controller's
+            # windowed failure-rate estimate (gauge), and the int8
+            # rung's live error-feedback residual footprint (gauge).
+            # policy_name / policy_last_reason ride metrics() as string
+            # keys, like ckpt_last_error.
+            "policy_current": -1.0,
+            "policy_switches_total": 0.0,
+            "policy_switch_refusals": 0.0,
+            "policy_switch_deferrals": 0.0,
+            "failure_rate": 0.0,
+            "wire_quant_residual_bytes": 0.0,
         }
         self._metrics_lock = threading.Lock()
+        if self._controller is not None:
+            self._metrics["policy_current"] = float(self._controller.rung)
         # Quorum latency distribution (p50/p95/max in metrics()): bounded
         # reservoir, mutated under the metrics lock on the quorum thread.
         self._quorum_latency = _LatencyReservoir()
@@ -460,8 +550,14 @@ class Manager:
         # Recent membership/heal/abort events, served with the metrics at
         # the manager's GET /metrics.json (VERDICT r3 missing #3: the
         # reference dashboard answers "what step is everyone on"; this
-        # answers "what has this group been *doing*").
-        self._history: deque = deque(maxlen=64)
+        # answers "what has this group been *doing*"). Depth is
+        # configurable (`event_history=` / TORCHFT_EVENT_HISTORY): the
+        # old fixed 64 is too shallow a window for failure-rate
+        # estimation, and the policy controller's signals read it.
+        if event_history is None:
+            event_history = int(os.environ.get(
+                "TORCHFT_EVENT_HISTORY", 64))
+        self._history: deque = deque(maxlen=max(int(event_history), 1))
         # Fail-fast guard: N consecutive steps aborted by a control-plane
         # error (quorum raising) escalate to the caller instead of letting
         # the training loop spin forever voting False (VERDICT r1 weak #8).
@@ -685,6 +781,14 @@ class Manager:
                 f"replica_world_size={q.replica_world_size}); treating as "
                 "a failed quorum round")
 
+        # Coordination facts for the adaptive-policy commit hook: the
+        # quorum store the decision key rides on, and whether anyone in
+        # the quorum is healing this round (max_world < replica_world ⇒
+        # a member is behind max_step ⇒ the decider defers switches —
+        # the "refused mid-heal, retried next boundary" rule).
+        self._policy_round = (getattr(q, "store_address", "") or "",
+                              q.replica_world_size, q.max_world_size)
+
         with self._metrics_lock:  # pair with participant_slot() snapshots
             if self._use_async_quorum:
                 # Healers are not at max_step, so they sit out this step
@@ -760,14 +864,21 @@ class Manager:
             setter = getattr(self._comm, "set_allreduce_config_fingerprint",
                              None)
             if setter is not None:
-                # payload=wire-v2 marks the ring payload format (narrow
-                # wire-dtype segments, not upcast buffers): a mixed
+                # payload=wire-v3 marks the ring payload format (narrow
+                # wire-dtype segments + per-op format preamble): a mixed
                 # launch of pre/post-wire-ring builds must fail fast at
                 # rendezvous, not wedge mid-collective on mismatched
-                # byte counts.
+                # byte counts. Policy-aware managers advertise
+                # wire_dtype=dynamic — the rung can change between
+                # rendezvous, so the configure-time check can't pin it;
+                # per-step agreement is the policy coordination's job
+                # and any residual skew is caught by the wire-op
+                # preamble (backends/host.py).
+                wire_fp = ("dynamic" if self._policy_aware
+                           else str(self._wire_dtype))
                 setter(f"bucket_bytes={self._bucket_bytes};"
-                       f"wire_dtype={self._wire_dtype};"
-                       f"payload=wire-v2")
+                       f"wire_dtype={wire_fp};"
+                       f"payload=wire-v3")
             reconf_t0 = time.perf_counter()
             self._comm.configure(
                 store_prefixed, q.replica_rank, q.replica_world_size
@@ -949,11 +1060,11 @@ class Manager:
 
     # ------------------------------------------------- striped-heal donors
 
-    def _healset_client(self, q: Any) -> Optional[Any]:
+    def _store_client(self, addr: str) -> Optional[Any]:
         """StoreClient for the quorum's shared store (the same store the
-        ring rendezvous rides), cached per address. None when the native
-        client is unavailable (mocked control planes)."""
-        addr = q.store_address
+        ring rendezvous rides), cached per address — shared by the
+        healset advertisement and the policy decision key. None when the
+        native client is unavailable (mocked control planes)."""
         if not addr:
             return None
         if self._healset_store is not None \
@@ -964,6 +1075,9 @@ class Manager:
                              retry_stats=self._retry_stats)
         self._healset_store = (addr, client)
         return client
+
+    def _healset_client(self, q: Any) -> Optional[Any]:
+        return self._store_client(q.store_address)
 
     def _publish_healset(self, q: Any) -> None:
         """Advertise this participant's checkpoint address under the
@@ -1180,6 +1294,7 @@ class Manager:
         n = max(self.num_participants(), 1)
         participating = self.is_participating()
         ar_t0 = time.perf_counter()
+        self._set_wire_tag()
         sched = self._get_schedule(treedef, leaves)
         agg: Future = Future()
         out_leaves: list = [None] * len(leaves)
@@ -1296,6 +1411,7 @@ class Manager:
         window = _stage_ahead_window()
         staged: list = [None] * n_buckets
         next_to_stage = 0
+        int8 = self._policy.wire == policy_mod.WIRE_INT8
 
         def stage_through(hi: int) -> None:
             nonlocal next_to_stage
@@ -1309,20 +1425,87 @@ class Manager:
         # there, and in the same deterministic chunk order on every
         # rank) while the remaining buckets' DMA keeps flowing. Healers
         # and spares contribute zero wire buffers built from the shared
-        # metadata schedule (zeros are exact in any dtype).
+        # metadata schedule (zeros are exact in any dtype — including
+        # the int8 rung's affine format). Under the int8+EF rung, float
+        # chunks quantize HERE, host-side, with the persistent
+        # per-chunk residual folded into the contribution first
+        # (_int8_quantize_bucket).
         for b, chunks in enumerate(sched.chunks):
             if participating:
                 stage_through(n_buckets if window is None
                               else b + 1 + window)
                 bufs = self._wait_bucket(staged[b], leaves)
                 staged[b] = None  # release the packed copies
+                if int8:
+                    bufs = self._int8_quantize_bucket(sched, b, chunks,
+                                                      bufs)
             else:
-                bufs = [np.zeros(c.total, c.wire) for c in chunks]
+                bufs = [_zero_wire_chunk(c, int8) for c in chunks]
             self._comm.allreduce_wire(
                 bufs, [str(c.orig) for c in chunks], op="sum"
             ).add_done_callback(on_bucket(chunks, time.perf_counter()))
 
         return self.wrap_future(agg, default=tree)
+
+    def _set_wire_tag(self) -> None:
+        """Stamp the payload-kind tag into the ring's per-op preamble
+        (``Communicator.set_wire_tag``, synchronously before each
+        pipeline's ops): DiLoCo outer-round pseudo-gradients and
+        per-step gradients have IDENTICAL geometry, so a one-boundary
+        policy-adoption skew across a DiLoCo transition could otherwise
+        fold one into the other silently — the tag turns that into a
+        detected abort. getattr tolerates bare duck-typed comms."""
+        setter = getattr(self._comm, "set_wire_tag", None)
+        if setter is not None:
+            setter("diloco" if self._policy.diloco else "step")
+
+    def _int8_quantize_bucket(self, sched: "_AllreduceSchedule", b: int,
+                              chunks: list, bufs: list) -> list:
+        """The int8+error-feedback rung's quantization stage
+        (docs/design/adaptive_policy.md): fold the persistent residual
+        into this step's contribution, quantize per segment
+        (:class:`~torchft_tpu.communicator.Int8Wire`), and bank the new
+        residual ``contribution - dequant(q)`` for the next step — the
+        classic error-feedback loop that keeps repeated-average error
+        bounded instead of drifting. Non-float chunks (int leaves) ride
+        the exact ring unchanged. Residuals key on (schedule
+        fingerprint, bucket, chunk), so a grad-signature change starts
+        fresh; a wire-rung switch clears them (_install_policy)."""
+        # Bound the residual store to the CURRENT grad signature: a
+        # caller whose pytree signature changes (phased training) must
+        # not leak one model-sized f32 residual set per signature —
+        # the same shape-churn discipline as the schedule cache. EF
+        # restarts on a signature change, which is also semantically
+        # right (old residuals describe different chunk geometry).
+        if any(k[0] != sched.fingerprint for k in self._ef_residuals):
+            self._ef_residuals = {
+                k: v for k, v in self._ef_residuals.items()
+                if k[0] == sched.fingerprint}
+        out = []
+        for j, (c, buf) in enumerate(zip(chunks, bufs)):
+            if not np.issubdtype(c.orig, np.floating):
+                out.append(buf)
+                continue
+            key = (sched.fingerprint, b, j)
+            v = np.ravel(np.asarray(buf)).astype(np.float32, copy=False)
+            res = self._ef_residuals.get(key)
+            if res is not None and res.size == v.size:
+                v = v + res
+            w = Int8Wire.quantize(v)
+            res = v - w.dequantize(np.float32)
+            # A non-finite contribution (loss-spike inf/NaN) quantized
+            # to zero (Int8Wire.quantize); its residual would be
+            # non-finite — banking it would poison every later step.
+            # Zero it: the junk step is dropped from the EF ledger and
+            # the rank recovers on the next clean contribution.
+            if not np.isfinite(res).all():
+                res[~np.isfinite(res)] = 0.0
+            self._ef_residuals[key] = res
+            out.append(w)
+        total = sum(r.nbytes for r in self._ef_residuals.values())
+        with self._metrics_lock:  # gauge, not a counter
+            self._metrics["wire_quant_residual_bytes"] = float(total)
+        return out
 
     def _get_schedule(self, treedef: Any, leaves: list
                       ) -> "_AllreduceSchedule":
@@ -1490,6 +1673,7 @@ class Manager:
         world = max(self._comm.size(), 1)
         rank = self._comm.rank()
         ar_t0 = time.perf_counter()
+        self._set_wire_tag()
         sched = self._get_schedule(treedef, leaves)
         all_chunks = [c for cs in sched.chunks for c in cs]
         agg: Future = Future()
@@ -1550,6 +1734,7 @@ class Manager:
                     sched.chunks[next_to_stage], leaves)
                 next_to_stage += 1
 
+        int8 = self._policy.wire == policy_mod.WIRE_INT8
         base = 0
         for b, chunks in enumerate(sched.chunks):
             if participating:
@@ -1557,8 +1742,11 @@ class Manager:
                               else b + 1 + window)
                 bufs = self._wait_bucket(staged[b], leaves)
                 staged[b] = None
+                if int8:
+                    bufs = self._int8_quantize_bucket(sched, b, chunks,
+                                                      bufs)
             else:
-                bufs = [np.zeros(c.total, c.wire) for c in chunks]
+                bufs = [_zero_wire_chunk(c, int8) for c in chunks]
             self._comm.reduce_scatter_wire(
                 bufs, [str(c.orig) for c in chunks], op="sum"
             ).add_done_callback(
@@ -1749,6 +1937,253 @@ class Manager:
                         error=repr(self._errored) if self._errored
                         else None)
 
+    # ------------------------------------------------- adaptive policy
+    # Hot-swappable FT knobs (docs/design/adaptive_policy.md): the
+    # policy in force bundles overlap_steps / wire rung / DiLoCo /
+    # durable-checkpoint cadence, and switches land ONLY at the commit
+    # boundary — after prepare_commit drained every in-flight
+    # collective and applied any staged heal, before the next step's
+    # quorum — where every existing invariant already synchronizes.
+    # Cross-group lockstep: the quorum's participating rank 0 decides
+    # (from its controller's windowed failure-rate + comm/compute
+    # signals) and publishes {step}:{rung}:{reason} on the quorum store
+    # each boundary; every group adopts on read. The ring collective
+    # between consecutive boundaries orders each publication before
+    # every group's NEXT read, so adoption skew is bounded to one
+    # boundary; healers adopt the donor's policy with the manager
+    # metadata (state_dict), and any residual wire-format skew is
+    # DETECTED by the wire-op preamble (backends/host.py) — aborting
+    # the step instead of folding garbage — then repaired at the next
+    # boundary's read.
+
+    def policy(self) -> "policy_mod.FTPolicy":
+        """The FT policy in force. Always set — synthesized from the
+        legacy knob args when no ``policy=``/``policy_controller=`` was
+        given — so trainers can uniformly consult mode
+        (``policy().diloco`` / ``overlap_steps``) and durable-save
+        cadence (``policy().ckpt_every``), and bench rows stay
+        attributable to the policy that produced them."""
+        return self._policy
+
+    def policy_controller(self) -> Optional["policy_mod.PolicyController"]:
+        return self._controller
+
+    def _install_policy_knobs(self, p: "policy_mod.FTPolicy") -> None:
+        self._overlap_steps = int(p.overlap_steps)
+        wd = p.wire_dtype()
+        self._wire_dtype = np.dtype(wd) if wd is not None else None
+
+    def _install_policy(self, p: "policy_mod.FTPolicy", reason: str,
+                        event: str,
+                        signals: Optional[Any] = None) -> None:
+        """Unconditional install (callers hold the safety checks):
+        knobs, residual flush on a wire-rung change, controller rung
+        sync, counters, and the ``policy_switch``/``policy_adopt``
+        event with from/to/reason/signals."""
+        old = self._policy
+        wire_changed = old.wire != p.wire
+        self._policy = p
+        self._install_policy_knobs(p)
+        if wire_changed:
+            # Wire-rung transitions flush quantizer state: the int8
+            # rung's residuals belong to the outgoing format and must
+            # never fold into a different wire's contributions.
+            self._ef_residuals.clear()
+        rung = -1.0
+        if self._controller is not None:
+            r = self._controller.rung_of(p)
+            if r is not None:
+                self._controller.sync_rung(r)
+                rung = float(r)
+        self._policy_last_reason = str(reason)
+        with self._metrics_lock:
+            self._metrics["policy_switches_total"] += 1
+            self._metrics["policy_current"] = rung
+            if wire_changed:
+                self._metrics["wire_quant_residual_bytes"] = 0.0
+        sig = {}
+        if signals is not None:
+            sig = {"signals": signals.as_dict()
+                   if hasattr(signals, "as_dict") else signals}
+        self._log_event(event=event, step=self._step, reason=reason,
+                        **{"from": old.name, "to": p.name}, **sig)
+        logger.info("%s policy %s -> %s at step %d (%s)",
+                    self._replica_id, old.name, p.name, self._step,
+                    reason)
+
+    def set_policy(self, p: "policy_mod.FTPolicy", reason: str = "manual",
+                   signals: Optional[Any] = None,
+                   _force: bool = False) -> bool:
+        """Switch the FT policy at the current commit boundary.
+
+        Refused — returning False, counting ``policy_switch_refusals``
+        and stamping a ``policy_switch_refused`` event — while a heal is
+        in flight (exactly like ``save_durable``: the restored state and
+        the knob change must not interleave), while a deferred allreduce
+        is staged (wire/overlap transitions drain deferred state first —
+        flush via ``DelayedOptimizer.flush()``), or (unless the
+        coordinated-adoption path forces it) while an error is latched.
+        Callers retry at the next boundary; the controller hook does so
+        automatically."""
+        if p.knobs() == self._policy.knobs():
+            return True
+        with self._metrics_lock:
+            healing = self._healing
+        blocked = []
+        if healing:
+            blocked.append("healing")
+        if self._deferred is not None:
+            blocked.append("deferred in flight")
+        if not _force and self._errored is not None:
+            blocked.append("errored")
+        if blocked:
+            with self._metrics_lock:
+                self._metrics["policy_switch_refusals"] += 1
+            self._log_event(event="policy_switch_refused",
+                            step=self._step, to=p.name, reason=reason,
+                            why=",".join(blocked))
+            logger.warning("%s: policy switch to %s refused (%s); retry "
+                           "at the next boundary", self._replica_id,
+                           p.name, ",".join(blocked))
+            return False
+        self._install_policy(p, reason, "policy_switch", signals)
+        return True
+
+    def _policy_coordination(self) -> tuple:
+        """(store_addr, replica_world, max_world, coordinated) of the
+        current round; coordinated means a real quorum store exists and
+        the ring world is >1 (otherwise decisions apply locally)."""
+        rd = self._policy_round
+        if rd is None:
+            return "", 0, 0, False
+        addr, replica_world, max_world = rd
+        if not isinstance(addr, str):  # mocked control planes
+            addr = ""
+        coordinated = bool(addr) and self._comm.size() > 1
+        return addr, replica_world, max_world, coordinated
+
+    def _policy_pre_vote(self) -> None:
+        """Decider half of the commit-boundary hook: promote the staged
+        proposal to the published decision (unless a heal is in flight
+        anywhere in the quorum — deferred, retried next boundary, the
+        same refusal ``save_durable`` applies) and refresh the decision
+        key on the quorum store. The key always carries the CURRENT
+        agreed rung, so follower reads never block on an absent key and
+        a group that missed a boundary (failed read, late join) catches
+        up at its next one.
+
+        Adoption is immediate-on-read rather than gated on a future
+        step: commit-step clocks freeze under exactly the churn that
+        makes escalation urgent. The cost is a possible one-boundary
+        adoption skew when the publish races a same-boundary read —
+        which only matters for wire-rung switches, where the wire-op
+        preamble (backends/host.py) detects it and converts the one
+        skewed collective into a clean abort; every group is aligned by
+        the following boundary (its read is ordered after this publish
+        by the intervening ring collective)."""
+        addr, replica_world, max_world, coordinated = \
+            self._policy_coordination()
+        if self._participating_rank != 0 or not self.is_participating():
+            return
+        if self._policy_pending is not None:
+            if max_world < replica_world:
+                # A quorum member is healing: a switch would race its
+                # restore — refused, retried next boundary.
+                with self._metrics_lock:
+                    self._metrics["policy_switch_deferrals"] += 1
+                self._log_event(event="policy_switch_deferred",
+                                step=self._step,
+                                to=self._policy_pending[0],
+                                why="heal in flight")
+            else:
+                rung, reason, sig = self._policy_pending
+                self._policy_pending = None
+                self._policy_published = (self._step, rung, reason, sig)
+        if not coordinated:
+            return
+        pub = self._policy_published
+        if pub is None:
+            cur = self._controller.rung if self._controller else 0
+            value = f"{self._step}:{cur}:init"
+        else:
+            value = (f"{pub[0]}:{pub[1]}:"
+                     f"{str(pub[2]).replace(':', ';')}")
+        try:
+            store = self._store_client(addr)
+            if store is not None:
+                store.set(_POLICY_KEY, value.encode())
+        except Exception:  # noqa: BLE001 — retried next boundary
+            logger.debug("policy publication failed", exc_info=True)
+
+    def _policy_post_vote(self, decision: bool) -> None:
+        """All-groups half of the commit-boundary hook: adopt the
+        published rung when it differs from the one in force, then feed
+        this boundary's outcome to the controller (failure window,
+        comm/compute ratio) and stage any new proposal for the decider's
+        next pre-vote."""
+        addr, _rw, _mw, coordinated = self._policy_coordination()
+        ladder = (self._controller.ladder if self._controller
+                  else policy_mod.LADDER)
+        if coordinated:
+            raw = None
+            try:
+                store = self._store_client(addr)
+                if store is not None:
+                    raw = store.get(
+                        _POLICY_KEY,
+                        timeout_ms=min(self._timeout_ms, 2000)).decode()
+            except Exception:  # noqa: BLE001 — next boundary re-reads;
+                # a missed switch is DETECTED by the wire-op preamble
+                # (abort, not garbage) and repaired then.
+                logger.debug("policy decision read failed",
+                             exc_info=True)
+            if raw:
+                _seq, _, rest = raw.partition(":")
+                rung_s, _, reason = rest.partition(":")
+                try:
+                    rung = int(rung_s)
+                except ValueError:
+                    rung = -1
+                if 0 <= rung < len(ladder):
+                    target = ladder[rung]
+                    if target.knobs() != self._policy.knobs():
+                        self.set_policy(
+                            target, reason=f"coordinated: {reason}",
+                            _force=True)
+        else:
+            pub = self._policy_published
+            if pub is not None and 0 <= pub[1] < len(ladder):
+                target = ladder[pub[1]]
+                if target.knobs() == self._policy.knobs() or \
+                        self.set_policy(target, reason=pub[2],
+                                        signals=pub[3], _force=True):
+                    self._policy_published = None
+
+        if self._controller is None:
+            return
+        now = time.monotonic()
+        with self._metrics_lock:
+            rc = self._metrics["reconfigure_count"]
+            ar = self._metrics["allreduce_ms_total"]
+        prev = self._policy_prev_counters
+        reconfigured = prev is not None and rc > prev["rc"]
+        comm_frac = 0.0
+        if prev is not None:
+            wall_ms = (now - prev["t"]) * 1e3
+            if wall_ms > 0:
+                comm_frac = min(1.0, max(0.0, ar - prev["ar"]) / wall_ms)
+        self._policy_prev_counters = {"rc": rc, "ar": ar, "t": now}
+        proposal = self._controller.note_boundary(
+            decision, reconfigured=reconfigured, comm_frac=comm_frac)
+        with self._metrics_lock:  # gauge
+            self._metrics["failure_rate"] = \
+                self._controller.last_signals.failure_rate
+        decider = (self._participating_rank == 0
+                   and self.is_participating())
+        if decider and proposal is not None \
+                and self._policy_pending is None:
+            self._policy_pending = proposal
+
     # ---------------------------------------------------------------- commit
 
     def should_commit(self, timeout_ms: Optional[int] = None) -> bool:
@@ -1757,6 +2192,10 @@ class Manager:
         Drains in-flight collectives, applies staged heal state on the main
         thread, then votes: the step commits iff *every* rank of *every*
         participating group succeeded and the quorum was large enough.
+        With a policy controller attached, the commit boundary doubles as
+        the policy-switch boundary (see the adaptive-policy section
+        above): the decider publishes before its vote, every group adopts
+        after it — the only point in the step where nothing is in flight.
         """
         # The quorum must have resolved before we can vote (or heal): join
         # it here even if the caller never issued a collective this step.
@@ -1764,6 +2203,9 @@ class Manager:
         # already ran it before its allgather, in which case this re-run
         # only drains the allgather it tracked.)
         self.prepare_commit()
+
+        if self._controller is not None:
+            self._policy_pre_vote()
 
         enough = self._participating_world_size >= self._min_replica_size
         local_ok = self._errored is None and enough
@@ -1792,6 +2234,8 @@ class Manager:
                 event="abort", step=self._step, local_ok=local_ok,
                 error=repr(self._errored) if self._errored else None,
             )
+        if self._controller is not None:
+            self._policy_post_vote(decision)
         self._publish_status()
 
         # Shut the heal window before the caller mutates state (reference
@@ -1897,6 +2341,18 @@ class Manager:
         ring_bytes = getattr(self._comm, "ring_bytes_total", None)
         out["allreduce_ring_wire_bytes_total"] = (
             float(ring_bytes()) if ring_bytes is not None else 0.0)
+        # The int8+EF rung's slice of the ring bytes (payload + segment
+        # headers) — ~1/4 of the f32 bytes when the rung is in force,
+        # the observable the wire ladder's deepest float rung exists
+        # for. getattr tolerates bare duck-typed comms in tests.
+        int8_bytes = getattr(self._comm, "int8_ring_bytes_total", None)
+        out["allreduce_int8_ring_bytes_total"] = (
+            float(int8_bytes()) if int8_bytes is not None else 0.0)
+        # Active-policy identity (strings, like ckpt_last_error —
+        # outside the numeric-schema set): which policy produced these
+        # counters, and why the last switch happened.
+        out["policy_name"] = self._policy.name
+        out["policy_last_reason"] = self._policy_last_reason
         # Fetch-path health (process-wide — the jit caches are too):
         # pack-executable cache misses must stop growing after the first
         # step of each grad signature, and async-D2H fallbacks explain a
@@ -2102,16 +2558,36 @@ class Manager:
 
     def state_dict(self) -> Dict[str, int]:
         """Manager metadata that must ride along with user checkpoints to
-        keep step counters in sync (reference ``manager.py:460-482``)."""
-        return {
+        keep step counters in sync (reference ``manager.py:460-482``).
+        Policy-aware managers (explicit ``policy=``/``policy_controller=``)
+        also carry the active policy's numeric knob encoding, so a healer
+        or cold start adopts the JOB's current policy — a restarted group
+        defaulting to rung 0 while the fleet runs int8 would otherwise
+        skew the wire format for its first participating step."""
+        out = {
             "step": self._step,
             "batches_committed": self._batches_committed,
         }
+        if self._policy_aware:
+            out.update(self._policy.to_state())
+        return out
 
     def load_state_dict(self, state_dict: Dict[str, int]) -> None:
         with self._metrics_lock:  # pair with participant_slot() snapshots
             self._step = int(state_dict["step"])
             self._batches_committed = int(state_dict["batches_committed"])
+        # Adopt the donor's / snapshot's policy (policy-aware managers
+        # only; legacy state dicts simply lack the keys). Runs on the
+        # quorum thread BEFORE this step's collectives join the quorum
+        # future, so a healer's zero contribution is already in the
+        # fleet's wire format.
+        if self._policy_aware and "policy_wire" in state_dict:
+            ladder = (self._controller.ladder if self._controller
+                      else policy_mod.LADDER)
+            p = policy_mod.FTPolicy.from_state(state_dict, ladder=ladder)
+            if p.knobs() != self._policy.knobs():
+                self._install_policy(p, reason="adopted with restored "
+                                     "state", event="policy_adopt")
 
     # ------------------------------------------------------------- accessors
 
@@ -2567,6 +3043,16 @@ def _stripe_seed(replica_id: str) -> int:
     import zlib as _zlib
 
     return _zlib.crc32(replica_id.encode())
+
+
+def _zero_wire_chunk(c: "_ChunkPlan", int8: bool) -> Any:
+    """Healer/spare zero contribution for one ring chunk, in the wire
+    format the participants are using this step: the int8 rung's affine
+    zeros (exact, like zeros in any float dtype) for float chunks under
+    the int8 policy, plain zeros otherwise."""
+    if int8 and np.issubdtype(c.orig, np.floating):
+        return Int8Wire.zeros_like(c.total)
+    return np.zeros(c.total, c.wire)
 
 
 def _zero_like(leaf: Any) -> np.ndarray:

@@ -337,6 +337,20 @@ class DelayedOptimizer:
             # RuntimeError, not assert (must survive python -O):
             # overwriting the staged step would silently lose it.
             raise RuntimeError("settle the pending step first")
+        # Adaptive-policy transition guard (docs/design/
+        # adaptive_policy.md): when the manager's policy switched
+        # overlap OFF at the boundary this step's settle just crossed,
+        # staging another deferred step would violate the transition
+        # contract (stale in-flight grads are exactly what the
+        # escalation disabled). Drivers switch loops at the boundary
+        # (AdaptiveTrainer does); this catches the ones that missed it.
+        pol = getattr(self.manager, "policy", None)
+        if callable(pol) and getattr(pol(), "overlap_steps", 1) == 0:
+            raise RuntimeError(
+                "manager policy has cross-step overlap disabled; "
+                "staging a deferred step would violate the policy "
+                "transition contract — switch to the sync loop at the "
+                "commit boundary")
         self.manager.stage_deferred(fut)
         self._staged = (holder, on_commit)
 
