@@ -23,6 +23,7 @@ them at tiny scale and asserts the recovery guarantees (<1 step lost).
 from __future__ import annotations
 
 import json
+import os
 import statistics
 import sys
 import threading
@@ -46,23 +47,39 @@ _BENCH_SCHEMA = "tft-bench-2"
 _PROVENANCE: Dict[str, Any] = {}
 
 
+def _tracing_default() -> bool:
+    from torchft_tpu import tracing as _tracing
+
+    return _tracing.default_enabled()
+
+
 def _provenance() -> Dict[str, Any]:
     """Environment stamp carried by every emitted row, so BENCH_r* files
     are comparable across rigs: the jax platform actually used, the jax
-    version, and a schema tag readers can dispatch on (rows predating
-    the stamp are schema v1)."""
+    version, a schema tag readers can dispatch on (rows predating the
+    stamp are schema v1), the PROCESS-WIDE tracing default (rows whose
+    scenario overrides it per-run — e.g. the trace A/B's legs — carry
+    the truth in their own fields, which win over this stamp in
+    _emit), and the flight-recorder dump directory in force ("" =
+    flight recording off) so an incident row points at its postmortem
+    artifacts."""
     if not _PROVENANCE:
         _PROVENANCE.update({
             "platform": jax.devices()[0].platform,
             "device_kind": jax.devices()[0].device_kind,
             "jax": jax.__version__,
             "schema": _BENCH_SCHEMA,
+            "tracing_enabled": _tracing_default(),
+            "flight_dir": os.environ.get("TORCHFT_FLIGHT_DIR", ""),
         })
     return dict(_PROVENANCE)
 
 
 def _emit(obj: Dict[str, Any]) -> None:
-    print(json.dumps({**obj, **_provenance()}), file=sys.stderr)
+    # Provenance first: a row's OWN fields win, so scenarios that
+    # override an ambient knob per-run (tracing_enabled in the trace
+    # A/B) report what was actually measured.
+    print(json.dumps({**_provenance(), **obj}), file=sys.stderr)
 
 
 # Peak dense matmul throughput per chip, bf16 (f32 is ~half). Sources:
@@ -258,7 +275,9 @@ def bench_multigroup(n_groups: int = 2, steps: int = 20,
                      bucket_bytes: int = 4 << 20,
                      wire_dtype: Optional[Any] = None,
                      overlap_steps: int = 0,
-                     shard_update: bool = False) -> Dict[str, float]:
+                     shard_update: bool = False,
+                     tracing: Optional[bool] = None
+                     ) -> Dict[str, float]:
     """N replica groups as threads, real cross-group gradient traffic.
 
     backend="host": device_get -> HostCommunicator ring allreduce over
@@ -281,6 +300,10 @@ def bench_multigroup(n_groups: int = 2, steps: int = 20,
     ``drain_wait_ms_avg`` (comm wall hidden behind compute vs still
     blocked on at the settle), the attribution the sync-vs-overlap A/B
     needs.
+
+    ``tracing`` overrides the Manager's per-step span tracing (default:
+    the ``TORCHFT_TRACING`` env default, i.e. on) — the knob the
+    ``multigroup_8mb_trace_ab`` overhead A/B flips.
 
     ``shard_update=True`` runs the ZeRO-style sharded weight update
     (docs/design/sharded_update.md): reduce-scatter instead of
@@ -332,6 +355,7 @@ def bench_multigroup(n_groups: int = 2, steps: int = 20,
                 allreduce_wire_dtype=wire_dtype,
                 overlap_steps=overlap_steps,
                 shard_update=shard_update,
+                tracing=tracing,
             ),
         )
         # Stamp the policy in force so BENCH trajectories are
@@ -424,6 +448,11 @@ def bench_multigroup(n_groups: int = 2, steps: int = 20,
         "n_groups": n_groups,
         "backend": backend,
         "overlap_steps": overlap_steps,
+        # The RESOLVED tracing state of this run (the per-run override
+        # wins over the env default) — rows built from this result can
+        # stamp what was actually measured.
+        "tracing_enabled": (bool(tracing) if tracing is not None
+                            else _tracing_default()),
         "policy": next(iter(policy_box.values()), "unknown"),
         "steps_per_s": med["steps_per_s"],
         "allreduce_ms_avg": med["allreduce_ms_avg"],
@@ -1369,7 +1398,8 @@ def bench_policy_soak(policy: str = "adaptive",
                 "wall_s": wall,
                 "switches": mx["policy_switches_total"],
                 "aborted_steps": mx["aborted_steps"],
-                "policy_final": mx["policy_name"],
+                "policy_final":
+                    trainer.manager.metrics_info()["policy_name"],
                 "int8_ring_mbytes":
                     mx["allreduce_int8_ring_bytes_total"] / 1e6,
                 "events": [e for e in trainer.manager.history()
@@ -1711,6 +1741,27 @@ def main() -> None:
            "drain_wait_ms_avg": round(mov["drain_wait_ms_avg"], 1),
            "sync_stage_busy_frac": busy_frac(mb),
            "overlap_stage_busy_frac": busy_frac(mov)})
+
+    # Tracing-overhead A/B on the same comm-bound 8MB scenario
+    # (docs/design/observability.md): per-step span tracing defaults ON,
+    # so its cost must be a MEASURED row, not a promise — steps/s with
+    # the tracer recording every stage span vs. hard-off. Gate: < 2%
+    # overhead (overhead_frac = 1 - on/off); tiny negatives are rig
+    # noise.
+    mtr_on = bench_multigroup(bucket_bytes=2 << 20, tracing=True, **big)
+    mtr_off = bench_multigroup(bucket_bytes=2 << 20, tracing=False,
+                               **big)
+    _emit({"metric": "multigroup_8mb_trace_ab",
+           "policy": mtr_on["policy"],
+           "grad_mbytes": round(mtr_on["grad_mbytes"], 2),
+           "trace_on_steps_per_s": round(mtr_on["steps_per_s"], 3),
+           "trace_off_steps_per_s": round(mtr_off["steps_per_s"], 3),
+           "overhead_frac": round(
+               1.0 - mtr_on["steps_per_s"]
+               / max(mtr_off["steps_per_s"], 1e-9), 4),
+           "target_max_overhead_frac": 0.02,
+           "trace_on_stages_ms": stages(mtr_on),
+           "trace_off_stages_ms": stages(mtr_off)})
 
     # Allreduce vs ZeRO-style reduce-scatter+allgather A/B on the same
     # 8MB scenario (docs/design/sharded_update.md): the rs leg receives

@@ -34,6 +34,7 @@ MODULES = [
     ("torchft_tpu.checkpointing", "Live peer-to-peer healing transfer"),
     ("torchft_tpu.checkpoint_io", "Durable checkpoint save/load"),
     ("torchft_tpu.serving", "Live weight publication + relay fan-out"),
+    ("torchft_tpu.tracing", "Per-step tracing + flight recorder"),
     ("torchft_tpu.serialization", "Streaming pytree wire format"),
     ("torchft_tpu.optim", "Commit-gated optimizer wrappers"),
     ("torchft_tpu.policy", "Adaptive fault-tolerance policy"),

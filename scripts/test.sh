@@ -134,6 +134,24 @@ if [[ "${1:-}" == "policy" ]]; then
     exit 0
 fi
 
+# Obs tier: the observability tier's focused gate
+# (docs/design/observability.md) — span-ring bounds/context, the
+# flight recorder's triggers (vote abort, latched comm error, heal
+# failover, policy escalation, crash exit) and dump shape, the
+# /trace.json + /metrics endpoints over real HTTP, the Prometheus /
+# trace-event schema freezes, event-log monotonic ordering, and the
+# tracefleet merge. Tier-1 too (not marked slow); run this tier on
+# tracing/manager/checkpointing changes. The 2-group injected-ring-
+# reset chaos round (a flight dump must be produced, parseable, and
+# fleet-mergeable) is marked nightly+slow and rides the nightly tier.
+if [[ "${1:-}" == "obs" ]]; then
+    stage obs env JAX_PLATFORMS=cpu \
+        python -m pytest tests/test_tracing.py tests/test_metrics_schema.py \
+        -q -m "obs and not slow"
+    echo "== total: ${SECONDS}s"
+    exit 0
+fi
+
 # Cold-start tier: seeded kill-all → cold-restart soak — every round a
 # 2-group job checkpoints under disk chaos (torn writes, silent
 # bit-flips, ENOSPC), the whole fleet "dies", and recovery must come

@@ -1,0 +1,156 @@
+#!/usr/bin/env python
+"""Fleet-wide trace merger (docs/design/observability.md).
+
+Scrapes every group's ``GET /trace.json`` (the per-step span ring each
+Manager's CheckpointServer exports) and merges them into ONE
+Perfetto-loadable timeline, aligned on the step protocol's shared
+coordinates ``(quorum_id, epoch, step)`` — per-process monotonic clocks
+differ, but spans tagged with the same coordinates describe the same
+global round, so the quorum barrier aligns them
+(:func:`torchft_tpu.tracing.merge_traces`). This is the tool that makes
+"who stalled whom" answerable across hundreds of groups: load the
+output in https://ui.perfetto.dev and every group is a process row with
+one track per pipeline stage.
+
+Addresses come from either:
+
+* positional args — each group's checkpoint-server ``host:port`` (or a
+  full ``http://host:port`` base; a ``/checkpoint/N`` suffix is
+  stripped), e.g. what ``Manager.publish_address()`` / the lighthouse
+  dashboard shows; or
+* ``--store host:port --world N`` — resolve them from the quorum
+  store's healset advertisements (``torchft/healset/{rank}``), the SAME
+  way healers resolve striped-heal donors, so the fleet enumerates
+  itself with no extra registry. Requires the native store client.
+
+Usage:
+    python scripts/tracefleet.py g0-host:29531 g1-host:29544 \
+        --steps 64 --out fleet_trace.json
+    python scripts/tracefleet.py --store lh-host:29512 --world 16 \
+        --out fleet_trace.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import urllib.request
+from typing import List, Optional
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from torchft_tpu.tracing import merge_traces  # noqa: E402
+
+
+def _base_url(addr: str) -> str:
+    """Normalize an address to the server's base URL: bare host:port
+    gets a scheme, a heal/publish path suffix is stripped."""
+    url = addr if "://" in addr else f"http://{addr}"
+    for marker in ("/checkpoint/", "/publish"):
+        if marker in url:
+            url = url[:url.index(marker)]
+    return url.rstrip("/")
+
+
+def fetch_trace(addr: str, steps: Optional[int] = None,
+                auth_token: Optional[str] = None,
+                timeout: float = 10.0) -> dict:
+    """GET one group's ``/trace.json`` (Chrome trace-event object)."""
+    url = _base_url(addr) + "/trace.json"
+    if steps is not None:
+        url += f"?steps={int(steps)}"
+    req = urllib.request.Request(url)
+    if auth_token:
+        req.add_header("Authorization", f"Bearer {auth_token}")
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+def resolve_from_store(store_addr: str, world: int,
+                       timeout_ms: int = 2000) -> List[str]:
+    """Resolve the fleet's checkpoint-server addresses from the quorum
+    store's healset advertisements — the same ``torchft/healset/{rank}``
+    keys (value ``"{max_step}:{addr}"``) a striped healer reads to find
+    its donors. Ranks that never advertised are skipped."""
+    from torchft_tpu._native import StoreClient
+
+    store = StoreClient(store_addr, connect_timeout_ms=timeout_ms)
+    addrs: List[str] = []
+    for r in range(world):
+        try:
+            v = store.get(f"torchft/healset/{r}",
+                          timeout_ms=timeout_ms).decode()
+        except Exception:  # noqa: BLE001 — absent rank key
+            continue
+        _step, _, addr = v.partition(":")
+        if addr:
+            addrs.append(addr)
+    return addrs
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Merge every group's /trace.json into one "
+        "Perfetto-loadable fleet timeline aligned on "
+        "(quorum_id, epoch, step).")
+    ap.add_argument("addrs", nargs="*",
+                    help="group checkpoint-server addresses "
+                    "(host:port or http://host:port)")
+    ap.add_argument("--store", default=None,
+                    help="quorum store host:port — resolve addresses "
+                    "from its healset advertisements (like healers "
+                    "resolve donors)")
+    ap.add_argument("--world", type=int, default=64,
+                    help="ranks to probe on the store (default 64)")
+    ap.add_argument("--steps", type=int, default=None,
+                    help="last K steps per group (default: whole ring)")
+    ap.add_argument("--out", default="fleet_trace.json",
+                    help="merged output path (default fleet_trace.json)")
+    ap.add_argument("--auth-token",
+                    default=os.environ.get("TORCHFT_AUTH_TOKEN"),
+                    help="bearer token (default TORCHFT_AUTH_TOKEN)")
+    ap.add_argument("--timeout", type=float, default=10.0)
+    args = ap.parse_args(argv)
+
+    addrs = list(args.addrs)
+    if args.store:
+        try:
+            addrs += resolve_from_store(args.store, args.world)
+        except Exception as e:  # noqa: BLE001
+            print(f"tracefleet: store resolution failed ({e}); "
+                  "pass addresses explicitly", file=sys.stderr)
+    addrs = list(dict.fromkeys(addrs))
+    if not addrs:
+        ap.error("no group addresses (pass host:port args or --store)")
+
+    traces, names = [], []
+    for addr in addrs:
+        try:
+            traces.append(fetch_trace(addr, steps=args.steps,
+                                      auth_token=args.auth_token,
+                                      timeout=args.timeout))
+            names.append(addr)
+        except Exception as e:  # noqa: BLE001 — a dead group must not
+            # blank the rest of the fleet's timeline
+            print(f"tracefleet: {addr}: fetch failed ({e}); skipping",
+                  file=sys.stderr)
+    if not traces:
+        print("tracefleet: no group produced a trace", file=sys.stderr)
+        return 1
+
+    merged = merge_traces(traces, names=names)
+    with open(args.out, "w") as f:
+        json.dump(merged, f)
+    n_events = len(merged["traceEvents"])
+    print(f"tracefleet: merged {len(traces)}/{len(addrs)} group(s), "
+          f"{n_events} events -> {args.out} "
+          f"(load in https://ui.perfetto.dev)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -103,6 +103,16 @@ class TestAllreduceABSmoke:
         assert ov["drain_wait_ms_avg"] >= 0.0
         assert sync["hidden_ms_avg"] == 0.0  # sync mode never defers
 
+    def test_trace_ab_smoke(self):
+        """Tracing on/off A/B plumbing at tiny size: both runs
+        complete and the tracing=False leg really records nothing (the
+        <2% overhead assertion is the bench's multigroup_8mb_trace_ab
+        row — smoke sizes are dispatch-bound noise)."""
+        on = self._mg(steps=3, tracing=True)
+        off = self._mg(steps=3, tracing=False)
+        assert on["steps_per_s"] > 0
+        assert off["steps_per_s"] > 0
+
     def test_chaos_short_read_on_wire_ring(self):
         """A seeded short-read fault injected into the ring's data plane
         lands mid-collective in the wire path's segment upcast loop; the
@@ -163,3 +173,8 @@ class TestPublishFanoutSmoke:
         assert row["schema"] == bench._BENCH_SCHEMA
         assert row["platform"] == "cpu"
         assert "jax" in row and row["jax"]
+        # Observability provenance (docs/design/observability.md):
+        # whether tracing was on while the row was measured, and where
+        # the flight recorder would dump ("" = off).
+        assert isinstance(row["tracing_enabled"], bool)
+        assert "flight_dir" in row

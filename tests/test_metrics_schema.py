@@ -1,19 +1,32 @@
-"""Schema-stability snapshot of ``Manager.metrics()`` (tier-1).
+"""Schema-stability snapshots of the observability surfaces (tier-1).
 
-Every counter below is documented behavior: dashboards, the
-``/metrics.json`` endpoint, the pod runbook's diagnosis recipes, and the
-bench emitters all read these keys by name. A refactor that renames or
-drops one silently breaks them long after the refactor's own tests went
-green — this test is the tripwire: a key may be ADDED freely (add it
-here), but an existing key disappearing fails loudly.
+Every name below is documented behavior: dashboards, the
+``/metrics.json`` endpoint, the Prometheus ``/metrics`` exposition, the
+``/trace.json`` Chrome-trace export, the pod runbook's diagnosis
+recipes, and the bench emitters all read these by name. A refactor that
+renames or drops one silently breaks them long after the refactor's own
+tests went green — these tests are the tripwire: a key may be ADDED
+freely (add it here), but an existing key disappearing fails loudly.
+
+Three frozen surfaces:
+* ``Manager.metrics()`` — numeric-only (every value int/float, no
+  per-key carve-outs: string diagnostics moved to ``metrics_info()``);
+* the Prometheus text exposition rendered from it
+  (``torchft_<key>`` samples + one ``torchft_info`` label set);
+* the trace-event JSON schema (phases ``B``/``E``/``X`` (+``M``
+  metadata), required context tags on every span).
 """
 
 from unittest.mock import MagicMock
 
 import numpy as np
+import pytest
 
 from torchft_tpu import DummyCommunicator
 from torchft_tpu.manager import Manager
+from torchft_tpu import tracing
+
+pytestmark = pytest.mark.obs
 
 # The documented metrics() schema, by subsystem. Append when a PR adds a
 # counter; never remove without a deliberate deprecation (and a grep for
@@ -61,13 +74,22 @@ DOCUMENTED_KEYS = frozenset([
     "policy_switch_refusals", "policy_switch_deferrals",
     "failure_rate", "wire_quant_residual_bytes",
     "allreduce_int8_ring_bytes_total",
+    # observability tier (docs/design/observability.md)
+    "trace_spans_total", "trace_spans_dropped", "flight_dumps_total",
 ])
 
-# String-valued diagnostics (like ckpt_last_error): present in every
-# snapshot but outside the numeric schema above.
-DOCUMENTED_STRING_KEYS = frozenset([
-    "policy_name", "policy_last_reason",
+# String-valued diagnostics, SPLIT from the numeric dict at the source
+# (Manager.metrics_info): the Prometheus /metrics endpoint renders them
+# as one torchft_info label set and the numeric invariant below needs
+# no per-key carve-outs.
+DOCUMENTED_INFO_KEYS = frozenset([
+    "policy_name", "policy_last_reason", "ckpt_last_error",
+    "flight_last_path",
 ])
+
+# Span context tags every exported trace event must carry (the fleet
+# merger aligns on quorum_id/epoch/step; dashboards group by the rest).
+REQUIRED_TRACE_TAGS = frozenset(tracing.CONTEXT_TAGS)
 
 
 def make_manager():
@@ -97,30 +119,37 @@ class TestMetricsSchema:
         finally:
             m.shutdown()
 
-    def test_values_are_numeric(self):
-        """Every documented key must stay JSON-safe numeric — the
-        /metrics.json contract (string-valued diagnostics like
-        ckpt_last_error use their own keys, outside this set)."""
+    def test_all_values_are_numeric(self):
+        """EVERY metrics() value must be JSON-safe numeric — not just
+        the documented set, and with no per-key carve-outs: string
+        diagnostics live in metrics_info(), and the Prometheus
+        exposition renders metrics() samples unconditionally."""
         m = make_manager()
         try:
-            mx = m.metrics()
-            for key in DOCUMENTED_KEYS:
-                assert isinstance(mx[key], (int, float)), (
-                    f"{key} is {type(mx[key]).__name__}, expected "
-                    "int/float")
+            for key, val in m.metrics().items():
+                assert isinstance(val, (int, float)) and \
+                    not isinstance(val, bool), (
+                        f"{key} is {type(val).__name__}, expected "
+                        "int/float — string diagnostics belong in "
+                        "metrics_info()")
         finally:
             m.shutdown()
 
-    def test_string_diagnostics_present(self):
-        """The policy identity keys are strings by design (dashboards
-        show the policy NAME next to its counters); they must stay
-        present and non-numeric-schema."""
+    def test_info_split_from_numeric(self):
+        """metrics_info() carries the documented string diagnostics —
+        all str — and none of them leak back into metrics()."""
         m = make_manager()
         try:
-            mx = m.metrics()
-            for key in DOCUMENTED_STRING_KEYS:
-                assert isinstance(mx[key], str), key
-            assert mx["policy_name"]
+            info = m.metrics_info()
+            missing = DOCUMENTED_INFO_KEYS - set(info)
+            assert not missing, sorted(missing)
+            for key, val in info.items():
+                assert isinstance(val, str), key
+            assert info["policy_name"]
+            overlap = DOCUMENTED_INFO_KEYS & set(m.metrics())
+            assert not overlap, (
+                f"string diagnostic key(s) {sorted(overlap)} leaked "
+                "into the numeric metrics() dict")
         finally:
             m.shutdown()
 
@@ -144,3 +173,98 @@ class TestMetricsSchema:
             assert mx["publish_last_generation"] == 1
         finally:
             m.shutdown()
+
+
+class TestPrometheusExposition:
+    """Freeze the /metrics exposition names: every documented counter
+    renders as torchft_<key> with the repo's counter/gauge typing rule,
+    and the string diagnostics render as ONE torchft_info sample."""
+
+    def test_documented_names_render(self):
+        m = make_manager()
+        try:
+            text = tracing.prometheus_text(
+                m.metrics(), m.metrics_info(),
+                labels={"replica_id": m.replica_id()})
+        finally:
+            m.shutdown()
+        for key in DOCUMENTED_KEYS:
+            assert f"torchft_{key}{{" in text, (
+                f"/metrics lost sample torchft_{key}")
+        assert 'torchft_info{' in text
+        for key in DOCUMENTED_INFO_KEYS:
+            assert f'{key}="' in text, (
+                f"torchft_info lost label {key}")
+        assert 'replica_id="metrics-schema"' in text
+
+    def test_counter_vs_gauge_rule(self):
+        text = tracing.prometheus_text(
+            {"x_total": 1, "y_count": 2.0, "z_ms_last": 3.0})
+        assert "# TYPE torchft_x_total counter" in text
+        assert "# TYPE torchft_y_count counter" in text
+        assert "# TYPE torchft_z_ms_last gauge" in text
+
+    def test_large_counters_keep_full_precision(self):
+        """A %g-style 6-sig-digit render freezes counters past 1e6
+        (1000000 and 1000001 both print '1e+06'), zeroing Prometheus
+        rate() exactly where byte counters live — values must render
+        with full float precision."""
+        a = tracing.prometheus_text({"x_total": 1_000_000.0})
+        b = tracing.prometheus_text({"x_total": 1_000_001.0})
+        assert a != b
+        assert "1000001" in b
+
+    def test_label_escaping(self):
+        text = tracing.prometheus_text(
+            {"a": 1}, {"weird": 'x"y\\z\n'}, labels={"replica_id": "r"})
+        assert 'weird="x\\"y\\\\z\\n"' in text
+
+
+class TestTraceEventSchema:
+    """Freeze the /trace.json schema: Chrome trace-event JSON whose
+    span phases are X (complete) and B/E (still-open at export), plus M
+    metadata naming the process and one track per stage; every span
+    carries the alignment/context tags."""
+
+    def test_phases_and_required_tags(self):
+        tr = tracing.Tracer(steps=4, enabled=True)
+        tr.set_context(replica_id="g0", quorum_id=3, epoch=7, step=11,
+                       policy_name="sync-f32")
+        with tr.span("quorum", fast=True):
+            pass
+        with tr.span("vote", decision=True):
+            pass
+        open_span = tr.span("ring", kind="allreduce_wire")  # stays open
+        trace = tr.chrome_trace()
+        events = trace["traceEvents"]
+        assert events, "empty trace"
+        phases = {ev["ph"] for ev in events}
+        assert phases <= {"X", "B", "E", "M"}, phases
+        assert "X" in phases and "B" in phases and "E" in phases
+        spans = [ev for ev in events if ev["ph"] in ("X", "B")]
+        for ev in spans:
+            missing = REQUIRED_TRACE_TAGS - set(ev["args"])
+            assert not missing, (ev["name"], sorted(missing))
+            assert ev["args"]["step"] == 11
+            assert ev["args"]["quorum_id"] == 3
+            assert ev["args"]["epoch"] == 7
+        # One track per stage: distinct stages -> distinct tids, named
+        # by thread_name metadata.
+        tid_of = {ev["name"]: ev["tid"] for ev in spans}
+        assert len(set(tid_of.values())) == len(tid_of)
+        named = {ev["args"]["name"] for ev in events
+                 if ev["ph"] == "M" and ev["name"] == "thread_name"}
+        assert named == set(tid_of)
+        proc = [ev for ev in events
+                if ev["ph"] == "M" and ev["name"] == "process_name"]
+        assert proc and proc[0]["args"]["name"] == "g0"
+        open_span.__exit__(None, None, None)
+
+    def test_open_spans_marked(self):
+        tr = tracing.Tracer(steps=4, enabled=True)
+        sp = tr.span("heal", donor="d:1")
+        trace = tr.chrome_trace()
+        begins = [ev for ev in trace["traceEvents"] if ev["ph"] == "B"]
+        assert len(begins) == 1
+        assert begins[0]["args"]["open"] is True
+        sp.__exit__(None, None, None)

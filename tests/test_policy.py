@@ -505,7 +505,7 @@ class TestManagerPolicy:
         try:
             p = m.policy()
             assert p.overlap_steps == 1 and p.wire_name() == "bf16"
-            assert m.metrics()["policy_name"] == p.name
+            assert m.metrics_info()["policy_name"] == p.name
             # Legacy managers stay legacy: no policy fields in the
             # state dict (tests pin its exact shape).
             assert set(m.state_dict()) == {"step", "batches_committed"}
@@ -526,7 +526,7 @@ class TestManagerPolicy:
             assert str(m._wire_dtype) == "bfloat16"
             mx = m.metrics()
             assert mx["policy_switches_total"] == 2
-            assert mx["policy_name"] == "overlap-bf16"
+            assert m.metrics_info()["policy_name"] == "overlap-bf16"
             events = [e for e in m.history()
                       if e.get("event") == "policy_switch"]
             assert [(e["from"], e["to"]) for e in events] == [
@@ -553,7 +553,7 @@ class TestManagerPolicy:
             assert not m.set_policy(POLICIES["sync-int8"])
             mx = m.metrics()
             assert mx["policy_switch_refusals"] == 2
-            assert mx["policy_name"] == "sync-f32"
+            assert m.metrics_info()["policy_name"] == "sync-f32"
             whys = [e["why"] for e in m.history()
                     if e.get("event") == "policy_switch_refused"]
             assert whys == ["healing", "deferred in flight"]

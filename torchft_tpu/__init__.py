@@ -47,6 +47,7 @@ from torchft_tpu.communicator import Int8Wire
 from torchft_tpu.serving import (PublicationServer, StaleWeightsError,
                                  WeightPublisher, WeightRelay,
                                  WeightSubscriber)
+from torchft_tpu.tracing import FlightRecorder, Tracer
 
 __all__ = [
     "AdaptiveTrainer",
@@ -80,6 +81,7 @@ __all__ = [
     "diloco_outer_optimizer",
     "DummyCommunicator",
     "ErrorSwallowingCommunicator",
+    "FlightRecorder",
     "FTOptimizer",
     "HostCommunicator",
     "Lighthouse",
@@ -95,6 +97,7 @@ __all__ = [
     "StaleWeightsError",
     "Store",
     "StoreClient",
+    "Tracer",
     "WeightPublisher",
     "WeightRelay",
     "WeightSubscriber",

@@ -39,6 +39,7 @@ from torchft_tpu.communicator import (Communicator, CommunicatorError,
                                       Int8Wire, shard_bounds)
 from torchft_tpu.retry import RetryPolicy, RetryStats, call_with_retry
 from torchft_tpu.serialization import load_pytree, save_pytree
+from torchft_tpu.tracing import maybe_span
 from torchft_tpu.utils import advertise_host
 
 logger: logging.Logger = logging.getLogger(__name__)
@@ -436,6 +437,14 @@ class HostCommunicator(Communicator):
         logger.info("host communicator configured: rank=%d world=%d (%s)",
                     rank, world_size, prefix)
 
+    def _ring_span(self, kind: str) -> Any:
+        """A ``ring`` span from the Manager-installed tracer
+        (:meth:`Communicator.set_tracer`), or a no-op when none/disabled
+        — raw HostCommunicators in tests carry no tracer."""
+        return maybe_span(getattr(self, "tracer", None), "ring",
+                          kind=kind, world=self._world,
+                          rank=self._rank)
+
     def _drain_queue(self, reason: str) -> None:
         while True:
             try:
@@ -463,19 +472,26 @@ class HostCommunicator(Communicator):
                     ring = self._ring
                     if epoch != self._epoch:
                         raise CommunicatorError("aborted by reconfigure")
-                if kind == "allreduce":
-                    fut.set_result(self._do_allreduce(ring, *args))
-                elif kind == "allreduce_wire":
-                    fut.set_result(self._do_allreduce_wire(ring, *args))
-                elif kind == "reduce_scatter_wire":
-                    fut.set_result(
-                        self._do_reduce_scatter_wire(ring, *args))
-                elif kind == "broadcast":
-                    fut.set_result(self._do_broadcast(ring, *args))
-                elif kind == "allgather":
-                    fut.set_result(self._do_allgather(ring, *args))
-                else:
-                    raise CommunicatorError(f"unknown op {kind}")
+                # One `ring` span per op on the comm worker
+                # (docs/design/observability.md): send/recv of a whole
+                # wire op, queue wait excluded (the Manager's
+                # allreduce_ring_ms_total includes it — the two
+                # together attribute "slow ring" to wire vs backlog).
+                with self._ring_span(kind):
+                    if kind == "allreduce":
+                        fut.set_result(self._do_allreduce(ring, *args))
+                    elif kind == "allreduce_wire":
+                        fut.set_result(
+                            self._do_allreduce_wire(ring, *args))
+                    elif kind == "reduce_scatter_wire":
+                        fut.set_result(
+                            self._do_reduce_scatter_wire(ring, *args))
+                    elif kind == "broadcast":
+                        fut.set_result(self._do_broadcast(ring, *args))
+                    elif kind == "allgather":
+                        fut.set_result(self._do_allgather(ring, *args))
+                    else:
+                        raise CommunicatorError(f"unknown op {kind}")
             except Exception as e:  # noqa: BLE001
                 fut.set_exception(
                     e if isinstance(e, CommunicatorError)

@@ -852,6 +852,9 @@ class ChaosCommunicator(Communicator):
     def set_retry_policy(self, policy: Any, stats: Any = None) -> None:
         self._comm.set_retry_policy(policy, stats)
 
+    def set_tracer(self, tracer: Any) -> None:
+        self._comm.set_tracer(tracer)
+
     def set_wire_tag(self, tag: str) -> None:
         self._comm.set_wire_tag(tag)
 

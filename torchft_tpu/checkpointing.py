@@ -60,6 +60,7 @@ import numpy as np
 from torchft_tpu import chaos
 from torchft_tpu.retry import RetryError, RetryPolicy, RetryStats, \
     is_transient
+from torchft_tpu.tracing import maybe_span
 from torchft_tpu.utils import advertise_host
 from torchft_tpu.serialization import (
     _match_entries,
@@ -434,6 +435,14 @@ class _HealSession:
         # Persistent per-donor connections shared by every attempt of
         # this transfer: Range waves stop paying a TCP dial per span.
         self.pool = _ConnectionPool()
+        # Optional span tracer (torchft_tpu.tracing): each donor's
+        # Range fetch records a `heal_stripe` span, so a striped heal's
+        # per-donor concurrency and stragglers are visible on the
+        # step timeline.
+        self.tracer: Optional[Any] = None
+
+    def span(self, stage: str, **tags: Any) -> Any:
+        return maybe_span(self.tracer, stage, **tags)
 
     def adopt_manifest(self, mf: dict, expect_changes: bool = False
                        ) -> None:
@@ -641,6 +650,12 @@ class CheckpointServer:
         # snapshots are immutable, so they are NOT step-gated by the
         # heal serve window (a commit in progress never blocks them).
         self._publication: Optional[Any] = None
+        # Attached observability exports (torchft_tpu.tracing,
+        # docs/design/observability.md): GET /trace.json (Chrome trace
+        # events from the span ring) and GET /metrics (Prometheus text
+        # exposition) on the same socket + auth gate. Snapshot reads of
+        # immutable/locked state — like /publish, never step-gated.
+        self._obs: Optional[Dict[str, Any]] = None
 
         ckpt_server = self
 
@@ -656,6 +671,13 @@ class CheckpointServer:
 
             def do_GET(self) -> None:
                 if not _check_bearer_auth(self, ckpt_server._auth_token):
+                    return
+                if self.path.split("?", 1)[0].rstrip("/") in (
+                        "/trace.json", "/metrics"):
+                    if ckpt_server._shutdown:
+                        self.close_connection = True
+                        return
+                    ckpt_server._serve_observability(self)
                     return
                 if self.path.split("?", 1)[0].rstrip("/") == "/publish" \
                         or self.path.startswith("/publish/"):
@@ -800,6 +822,79 @@ class CheckpointServer:
             host = f"[{host}]"
         return f"http://{host}:{port}/checkpoint/{self._step}"
 
+    def attach_observability(self, tracer: Any = None,
+                             metrics_fn: Optional[Callable[[], Dict]]
+                             = None,
+                             info_fn: Optional[Callable[[], Dict]]
+                             = None,
+                             labels: Optional[Dict[str, str]]
+                             = None) -> None:
+        """Attach the observability exports
+        (docs/design/observability.md): ``tracer`` (a
+        :class:`torchft_tpu.tracing.Tracer`) backs ``GET
+        /trace.json?steps=K`` — the span ring of the last K steps in
+        Chrome trace-event format, Perfetto-loadable and the fleet
+        merger's input — and ``metrics_fn``/``info_fn`` (the Manager's
+        ``metrics``/``metrics_info``) back ``GET /metrics``, Prometheus
+        text exposition with ``labels`` on every sample. The Manager
+        attaches its own at construction."""
+        self._obs = {"tracer": tracer, "metrics_fn": metrics_fn,
+                     "info_fn": info_fn, "labels": dict(labels or {})}
+
+    def _serve_observability(self, handler: Any) -> None:
+        """Serve one /trace.json or /metrics GET (auth already
+        checked). Snapshot reads only — never step-gated, never blocks
+        a commit."""
+        from torchft_tpu import tracing as tracing_mod
+
+        obs = self._obs
+        path, _, query = handler.path.partition("?")
+        path = path.rstrip("/")
+        try:
+            if path == "/trace.json":
+                tracer = obs.get("tracer") if obs else None
+                if tracer is None:
+                    handler.send_error(404, "no tracer attached")
+                    return
+                qs = urllib.parse.parse_qs(query)
+                steps = None
+                if "steps" in qs:
+                    # 400 only for the client's parse error — a
+                    # ValueError from deeper (a metrics/trace snapshot
+                    # racing shutdown) must stay a logged 500, not be
+                    # misattributed to the request.
+                    try:
+                        steps = max(int(qs["steps"][0]), 1)
+                    except ValueError:
+                        handler.send_error(400, "bad steps parameter")
+                        return
+                # default=str: span tags are open-ended; an exotic tag
+                # value degrades to its repr instead of a 500.
+                body = json.dumps(tracer.chrome_trace(steps),
+                                  default=str).encode()
+                ctype = "application/json"
+            else:  # /metrics
+                metrics_fn = obs.get("metrics_fn") if obs else None
+                if metrics_fn is None:
+                    handler.send_error(404, "no metrics attached")
+                    return
+                info_fn = obs.get("info_fn")
+                body = tracing_mod.prometheus_text(
+                    metrics_fn(),
+                    info_fn() if info_fn is not None else None,
+                    obs.get("labels")).encode()
+                ctype = "text/plain; version=0.0.4; charset=utf-8"
+        except Exception as e:  # noqa: BLE001 — surface, keep serving
+            logger.exception("observability endpoint failed")
+            handler.send_error(500, str(e))
+            return
+        handler.send_response(200)
+        handler.send_header("Content-Type", ctype)
+        handler.send_header("Content-Length", str(len(body)))
+        handler.end_headers()
+        handler.connection.settimeout(self._send_timeout_sec)
+        handler.wfile.write(body)
+
     def attach_publication(self, publication: Any) -> None:
         """Attach a live-publication store
         (:class:`torchft_tpu.serving.WeightPublisher`): its generations
@@ -863,7 +958,8 @@ class CheckpointServer:
                           donor_addrs: Optional[List[str]] = None,
                           stripe_seed: Optional[int] = None,
                           progress_cb: Optional[Callable[[int, int], None]]
-                          = None) -> T:
+                          = None,
+                          tracer: Optional[Any] = None) -> T:
         """Fetch a peer's live checkpoint and restore it into ``target``'s
         structure (and shardings, when ``device_put``). Streams: each leaf
         is read off the socket into a preallocated buffer, digest-verified
@@ -934,6 +1030,7 @@ class CheckpointServer:
                     if pol.overall_deadline_ms > 0 else None)
         dput = device_put_like if device_put else None
         session = _HealSession(target, dput)
+        session.tracer = tracer
         # Striped donor set: seed-shuffled so concurrent healers spread
         # their first streams; the quorum's primary rides along
         # (deduped) as one donor among equals.
@@ -1172,8 +1269,8 @@ class CheckpointServer:
                 f"invalid checkpoint manifest format {mf.get('format')!r}")
         return mf
 
-    @staticmethod
-    def _fetch_span(addr: str, session: "_HealSession", span: list,
+    @classmethod
+    def _fetch_span(cls, addr: str, session: "_HealSession", span: list,
                     stall: float, auth_token: Optional[str],
                     endpoint: str,
                     progress_cb: Optional[Callable[[int, int], None]]
@@ -1184,7 +1281,22 @@ class CheckpointServer:
         and :class:`HealCorruptError` when a leaf keeps mismatching.
         Requests ride the session's persistent per-donor connection
         pool, so a multi-span wave pays one TCP dial per donor, not one
-        per span."""
+        per span. Each span fetch records a ``heal_stripe`` trace span
+        tagged with its donor (a failing fetch's span carries the error
+        — the timeline's attribution of WHICH donor stalled/corrupted
+        a heal)."""
+        a, b, idxs = span
+        with session.span("heal_stripe", donor=addr, leaves=len(idxs),
+                          bytes=b - a):
+            cls._fetch_span_body(addr, session, span, stall, auth_token,
+                                 endpoint, progress_cb)
+
+    @staticmethod
+    def _fetch_span_body(addr: str, session: "_HealSession", span: list,
+                         stall: float, auth_token: Optional[str],
+                         endpoint: str,
+                         progress_cb: Optional[Callable[[int, int], None]]
+                         ) -> None:
         a, b, idxs = span
         tok = chaos.begin(endpoint, "fetch")
         resp = _open_url(addr, stall, auth_token,

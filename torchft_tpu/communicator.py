@@ -363,6 +363,16 @@ class Communicator(ABC):
         self.retry_policy = policy
         self.retry_stats = stats
 
+    def set_tracer(self, tracer: Any) -> None:
+        """Install the owning Manager's span tracer
+        (:class:`torchft_tpu.tracing.Tracer`): byte-counted transports
+        record a ``ring`` span per wire op on the comm worker thread,
+        giving the per-step timeline its ring track
+        (docs/design/observability.md). Default stores the attribute;
+        wrappers MUST forward inward — a tracer stranded on a wrapper
+        silently blanks the ring track."""
+        self.tracer = tracer
+
     def shutdown(self) -> None:  # noqa: B027
         pass
 
@@ -558,6 +568,9 @@ class ErrorSwallowingCommunicator(Communicator):
     def set_retry_policy(self, policy: Any, stats: Any = None) -> None:
         self._comm.set_retry_policy(policy, stats)
 
+    def set_tracer(self, tracer: Any) -> None:
+        self._comm.set_tracer(tracer)
+
     def set_wire_tag(self, tag: str) -> None:
         self._comm.set_wire_tag(tag)
 
@@ -684,6 +697,9 @@ class ManagedCommunicator(Communicator):
 
     def set_retry_policy(self, policy: Any, stats: Any = None) -> None:
         self._comm.set_retry_policy(policy, stats)
+
+    def set_tracer(self, tracer: Any) -> None:
+        self._comm.set_tracer(tracer)
 
     def set_wire_tag(self, tag: str) -> None:
         self._comm.set_wire_tag(tag)

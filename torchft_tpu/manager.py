@@ -38,6 +38,7 @@ import json
 import logging
 import os
 import socket
+import sys
 import threading
 import time
 import uuid
@@ -52,6 +53,7 @@ import jax
 import jax.numpy as jnp
 
 from torchft_tpu import policy as policy_mod
+from torchft_tpu import tracing as tracing_mod
 from torchft_tpu._native import ManagerClient, ManagerServer, Store, StoreClient
 from torchft_tpu.checkpointing import CheckpointServer
 from torchft_tpu.communicator import (Communicator, CommunicatorError,
@@ -265,6 +267,21 @@ class Manager:
             ``/metrics.json`` (env ``TORCHFT_EVENT_HISTORY``, default
             64) — the controller's failure-rate window reads it, and
             64 events is shallow for that at high churn.
+        tracing: per-step span tracing
+            (:mod:`torchft_tpu.tracing`, docs/design/observability.md).
+            Default on (env ``TORCHFT_TRACING=0`` disables): every hot
+            stage — quorum, per-bucket fetch dispatch/wait, ring ops,
+            unpack/put, drain/vote, heal stripes per donor, durable
+            saves, publishes — records a monotonic span tagged with
+            ``replica_id/quorum_id/epoch/step/policy_name`` into a
+            bounded ring of the last ``trace_steps`` steps, exported
+            at ``GET /trace.json`` (Chrome trace-event format) and
+            dumped by the flight recorder (``TORCHFT_FLIGHT_DIR``) on
+            vote abort / latched comm error / heal failover / policy
+            escalation / crash exit. Measured overhead < 2% of host
+            steps/s (bench ``multigroup_8mb_trace_ab``).
+        trace_steps: span-ring depth in steps (env
+            ``TORCHFT_TRACE_STEPS``, default 64).
     """
 
     def __init__(
@@ -299,9 +316,18 @@ class Manager:
         policy: Optional["policy_mod.FTPolicy"] = None,
         policy_controller: Optional["policy_mod.PolicyController"] = None,
         event_history: Optional[int] = None,
+        tracing: Optional[bool] = None,
+        trace_steps: Optional[int] = None,
         _manager_client: Optional[ManagerClient] = None,
     ) -> None:
         self._comm = comm
+        # Per-step span tracer (docs/design/observability.md): created
+        # first so every later init step can already be spanned; the
+        # flight recorder and the export endpoints attach once the
+        # replica id is known (_init_observability).
+        self._tracer = tracing_mod.Tracer(steps=trace_steps,
+                                          enabled=tracing)
+        self._flight: Optional[tracing_mod.FlightRecorder] = None
         self._bucket_bytes = max(int(allreduce_bucket_bytes), 1)
         self._wire_dtype = (
             np.dtype(allreduce_wire_dtype)
@@ -508,8 +534,9 @@ class Manager:
             # in flight somewhere in the quorum, the controller's
             # windowed failure-rate estimate (gauge), and the int8
             # rung's live error-feedback residual footprint (gauge).
-            # policy_name / policy_last_reason ride metrics() as string
-            # keys, like ckpt_last_error.
+            # policy_name / policy_last_reason are strings and live in
+            # metrics_info() with ckpt_last_error (the numeric/string
+            # split, docs/design/observability.md).
             "policy_current": -1.0,
             "policy_switches_total": 0.0,
             "policy_switch_refusals": 0.0,
@@ -547,6 +574,12 @@ class Manager:
         set_rp = getattr(comm, "set_retry_policy", None)
         if set_rp is not None:
             set_rp(self._retry_policy, self._retry_stats)
+        # Hand the tracer to the communicator too: the host backend's
+        # ring ops span themselves on the comm worker thread (same
+        # getattr tolerance for bare duck-typed comms).
+        set_tr = getattr(comm, "set_tracer", None)
+        if set_tr is not None:
+            set_tr(self._tracer)
         # Recent membership/heal/abort events, served with the metrics at
         # the manager's GET /metrics.json (VERDICT r3 missing #3: the
         # reference dashboard answers "what step is everyone on"; this
@@ -558,6 +591,14 @@ class Manager:
             event_history = int(os.environ.get(
                 "TORCHFT_EVENT_HISTORY", 64))
         self._history: deque = deque(maxlen=max(int(event_history), 1))
+        # Per-manager monotonic event sequence (satellite of the
+        # observability tier): `t` is wall-clock and can STEP (ntp), and
+        # events are appended from multiple threads (quorum loop vs
+        # caller), so cross-thread/cross-group ordering needs a
+        # step-proof pair — `t_mono_ns` (this process's monotonic clock)
+        # and `seq` (total order of THIS manager's events). Both ride
+        # every event in /metrics.json.
+        self._event_seq = 0
         # Fail-fast guard: N consecutive steps aborted by a control-plane
         # error (quorum raising) escalate to the caller instead of letting
         # the training loop spin forever voting False (VERDICT r1 weak #8).
@@ -622,6 +663,7 @@ class Manager:
             self._manager_server: Optional[ManagerServer] = None
             self._client = _manager_client
             self._replica_id = replica_id or "test"
+            self._init_observability()
             return
 
         # --- bootstrap: store rendezvous + manager server ----------------
@@ -665,6 +707,35 @@ class Manager:
         self._client = ManagerClient(addr, connect_timeout_ms=timeout_ms,
                                      retry_policy=self._retry_policy,
                                      retry_stats=self._retry_stats)
+        self._init_observability()
+
+    def _init_observability(self) -> None:
+        """Finish the observability wiring once the replica id exists:
+        stamp the tracer's alignment context, create the flight
+        recorder (``TORCHFT_FLIGHT_DIR``; registers for the
+        atexit-after-exception dump), and attach the trace/metrics
+        export endpoints to the checkpoint server (``GET /trace.json``
+        and ``GET /metrics`` ride the same socket + auth gate as the
+        heal endpoints). getattr tolerates duck-typed checkpoint
+        transports in tests."""
+        self._tracer.set_context(replica_id=self._replica_id,
+                                 step=self._step,
+                                 policy_name=self._policy.name)
+        self._flight = tracing_mod.FlightRecorder(
+            self._tracer, replica_id=self._replica_id,
+            metrics_fn=self.metrics, info_fn=self.metrics_info,
+            history_fn=self.history)
+        attach = getattr(self._ckpt_server, "attach_observability", None)
+        if attach is not None:
+            attach(tracer=self._tracer, metrics_fn=self.metrics,
+                   info_fn=self.metrics_info,
+                   labels={"replica_id": self._replica_id})
+
+    def _flight_dump(self, reason: str, **extra: Any) -> None:
+        """Trigger a flight-recorder dump (no-op without
+        ``TORCHFT_FLIGHT_DIR``; never raises)."""
+        if self._flight is not None:
+            self._flight.dump(reason, extra=extra or None)
 
     # ------------------------------------------------------------------ step
 
@@ -717,6 +788,11 @@ class Manager:
             self._healing = False
         self._pending_state_dict = None
         self._ckpt_server.allow_checkpoint(self._step)
+        # Fresh step coordinates for every span recorded this step
+        # (quorum_id/epoch refresh on the quorum thread once the round
+        # resolves).
+        self._tracer.set_context(step=self._step,
+                                 policy_name=self._policy.name)
 
         self._quorum_future = self._executor.submit(self._async_quorum)
         if not self._use_async_quorum:
@@ -746,12 +822,15 @@ class Manager:
 
     def _async_quorum_inner(self) -> None:
         t0 = time.perf_counter()
-        q = self._client.quorum(
-            rank=self._rank,
-            step=self._step,
-            checkpoint_server_addr=self._ckpt_server.address(),
-            timeout_ms=self._quorum_timeout_ms,
-        )
+        with self._tracer.span("quorum") as sp:
+            q = self._client.quorum(
+                rank=self._rank,
+                step=self._step,
+                checkpoint_server_addr=self._ckpt_server.address(),
+                timeout_ms=self._quorum_timeout_ms,
+            )
+            sp.set(fast=bool(getattr(q, "fast_path", False) is True),
+                   quorum_id=q.quorum_id)
         quorum_ms = (time.perf_counter() - t0) * 1e3
         # getattr: duck-typed/mocked clients in tests predate the
         # fast_path/epoch fields.
@@ -780,6 +859,13 @@ class Manager:
                 f"replica_rank={q.replica_rank}, "
                 f"replica_world_size={q.replica_world_size}); treating as "
                 "a failed quorum round")
+
+        # Alignment coordinates for every span recorded after this
+        # round resolved (the fleet merger keys on them) — set only
+        # once the response validated.
+        self._tracer.set_context(
+            quorum_id=q.quorum_id,
+            epoch=epoch if isinstance(epoch, int) else 0)
 
         # Coordination facts for the adaptive-policy commit hook: the
         # quorum store the decision key rides on, and whether anyone in
@@ -916,6 +1002,9 @@ class Manager:
             )
             heal_t0 = time.perf_counter()
             heal_stats: Dict[str, float] = {}
+            heal_span = self._tracer.span(
+                "heal", source=q.recover_manager_address,
+                max_step=q.max_step)
             try:
                 primary = ManagerClient(
                     q.recover_manager_address,
@@ -945,13 +1034,19 @@ class Manager:
                             self._heal_max_donor_failovers),
                         donor_addrs=donor_addrs,
                         stripe_seed=_stripe_seed(self._replica_id),
-                        progress_cb=self._heal_progress),
+                        progress_cb=self._heal_progress,
+                        tracer=self._tracer),
                 )
             finally:
                 # Failed heals count too: without this, an aborted fetch's
                 # seconds leak into whatever the caller's "unattributed"
                 # bucket is — the exact misattribution heal_ms_total exists
                 # to prevent.
+                heal_span.set(
+                    bytes=heal_stats.get("bytes", 0.0),
+                    donors=heal_stats.get("donors_used", 1.0),
+                    failovers=heal_stats.get("donor_failovers", 0.0),
+                ).__exit__(*sys.exc_info())
                 heal_ms = (time.perf_counter() - heal_t0) * 1e3
                 self._record(
                     heal_ms_total=heal_ms,
@@ -1048,6 +1143,8 @@ class Manager:
             self._log_event(
                 event="heal_failover", step=self._step,
                 n=failover_idx + 1, donor=q2.recover_manager_address)
+            self._flight_dump("heal_failover", n=failover_idx + 1,
+                              donor=q2.recover_manager_address)
             logger.info(
                 "%s: heal failing over to donor %s (#%d)",
                 self._replica_id, q2.recover_manager_address,
@@ -1316,44 +1413,9 @@ class Manager:
         def finish_bucket(chunks: list, reduced: list) -> None:
             try:
                 put_t0 = time.perf_counter()
-                scaled: Dict[int, Any] = {}
-                for c, arr in zip(chunks, reduced):
-                    if c.total and all(isinstance(leaves[i], jax.Array)
-                                       for i in c.idx):
-                        # All-device chunk: ONE H2D transfer of the
-                        # reduced buffer, then the schedule's cached
-                        # jitted 1/n-scale + split + reshape runs on
-                        # device — the put stage stays off the Python
-                        # float path entirely (no host div, no per-leaf
-                        # np.split copies). n is traced, so membership
-                        # changes don't retrace.
-                        outs = _unpack_scale(c)(np.ascontiguousarray(arr),
-                                                n)
-                        placed = jax.device_put(
-                            list(outs),
-                            [leaves[i].sharding for i in c.idx])
-                        for i, a in zip(c.idx, placed):
-                            scaled[i] = a
-                        continue
-                    # Host / mixed / empty chunk: host-side scale+split,
-                    # device leaves restored in one batched put.
-                    arr = div_by_count(np.asarray(arr), n)
-                    parts = np.split(arr, np.cumsum(c.sizes)[:-1])
-                    put_idx: list = []
-                    put_vals: list = []
-                    for i, shape, part in zip(c.idx, c.shapes, parts):
-                        val = part.reshape(shape)
-                        if isinstance(leaves[i], jax.Array):
-                            put_idx.append(i)
-                            put_vals.append(val)
-                        else:
-                            scaled[i] = val
-                    if put_idx:
-                        placed = jax.device_put(
-                            put_vals,
-                            [leaves[i].sharding for i in put_idx])
-                        for i, a in zip(put_idx, placed):
-                            scaled[i] = a
+                with self._tracer.span("put", chunks=len(chunks)):
+                    scaled = self._put_bucket_chunks(chunks, reduced,
+                                                     leaves, n)
                 self._record(allreduce_put_ms_total=(
                     time.perf_counter() - put_t0) * 1e3)
                 with lock:
@@ -1417,7 +1479,8 @@ class Manager:
             nonlocal next_to_stage
             while next_to_stage < min(hi, n_buckets):
                 staged[next_to_stage] = self._stage_bucket(
-                    sched.chunks[next_to_stage], leaves)
+                    sched.chunks[next_to_stage], leaves,
+                    bucket=next_to_stage)
                 next_to_stage += 1
 
         # Stage 2: per bucket, in order — wait for its wire buffers and
@@ -1434,7 +1497,7 @@ class Manager:
             if participating:
                 stage_through(n_buckets if window is None
                               else b + 1 + window)
-                bufs = self._wait_bucket(staged[b], leaves)
+                bufs = self._wait_bucket(staged[b], leaves, bucket=b)
                 staged[b] = None  # release the packed copies
                 if int8:
                     bufs = self._int8_quantize_bucket(sched, b, chunks,
@@ -1446,6 +1509,48 @@ class Manager:
             ).add_done_callback(on_bucket(chunks, time.perf_counter()))
 
         return self.wrap_future(agg, default=tree)
+
+    def _put_bucket_chunks(self, chunks: list, reduced: list,
+                           leaves: list, n: int) -> Dict[int, Any]:
+        """Put stage of one bucket: 1/n-scale each reduced chunk and
+        place the leaves back (device leaves via the cached jitted
+        unpack + one batched ``device_put``; host leaves scale on
+        host). Returns ``{flat leaf index: placed leaf}``."""
+        scaled: Dict[int, Any] = {}
+        for c, arr in zip(chunks, reduced):
+            if c.total and all(isinstance(leaves[i], jax.Array)
+                               for i in c.idx):
+                # All-device chunk: ONE H2D transfer of the reduced
+                # buffer, then the schedule's cached jitted 1/n-scale +
+                # split + reshape runs on device — the put stage stays
+                # off the Python float path entirely (no host div, no
+                # per-leaf np.split copies). n is traced, so membership
+                # changes don't retrace.
+                outs = _unpack_scale(c)(np.ascontiguousarray(arr), n)
+                placed = jax.device_put(
+                    list(outs), [leaves[i].sharding for i in c.idx])
+                for i, a in zip(c.idx, placed):
+                    scaled[i] = a
+                continue
+            # Host / mixed / empty chunk: host-side scale+split, device
+            # leaves restored in one batched put.
+            arr = div_by_count(np.asarray(arr), n)
+            parts = np.split(arr, np.cumsum(c.sizes)[:-1])
+            put_idx: list = []
+            put_vals: list = []
+            for i, shape, part in zip(c.idx, c.shapes, parts):
+                val = part.reshape(shape)
+                if isinstance(leaves[i], jax.Array):
+                    put_idx.append(i)
+                    put_vals.append(val)
+                else:
+                    scaled[i] = val
+            if put_idx:
+                placed = jax.device_put(
+                    put_vals, [leaves[i].sharding for i in put_idx])
+                for i, a in zip(put_idx, placed):
+                    scaled[i] = a
+        return scaled
 
     def _set_wire_tag(self) -> None:
         """Stamp the payload-kind tag into the ring's per-op preamble
@@ -1531,27 +1636,31 @@ class Manager:
             self._sched_cache[key] = sched
         return sched
 
-    def _stage_bucket(self, chunks: list, leaves: list) -> list:
+    def _stage_bucket(self, chunks: list, leaves: list,
+                      bucket: int = -1) -> list:
         """Fetch stage 1 (dispatch): kick off one bucket's cached jitted
         packs and start each packed buffer's D2H copy immediately —
         without blocking — so DMA overlaps the ring. Returns the
         bucket's staging records for :meth:`_wait_bucket`."""
         t0 = time.perf_counter()
-        recs = []
-        for c in chunks:
-            dev = [(j, leaves[i]) for j, i in enumerate(c.idx)
-                   if isinstance(leaves[i], jax.Array)]
-            packed = None
-            if dev:
-                packed = _pack_leaves([x for _, x in dev], str(c.wire))
-                _start_copy_to_host(packed)
-            recs.append((c, dev, packed))
+        with self._tracer.span("fetch_dispatch", bucket=bucket):
+            recs = []
+            for c in chunks:
+                dev = [(j, leaves[i]) for j, i in enumerate(c.idx)
+                       if isinstance(leaves[i], jax.Array)]
+                packed = None
+                if dev:
+                    packed = _pack_leaves([x for _, x in dev],
+                                          str(c.wire))
+                    _start_copy_to_host(packed)
+                recs.append((c, dev, packed))
         ms = (time.perf_counter() - t0) * 1e3
         self._record(allreduce_fetch_dispatch_ms_total=ms,
                      allreduce_fetch_ms_total=ms)
         return recs
 
-    def _wait_bucket(self, recs: list, leaves: list) -> list:
+    def _wait_bucket(self, recs: list, leaves: list,
+                     bucket: int = -1) -> list:
         """Fetch stage 2 (wait): block until this bucket's packed wire
         buffers are on host — one batched ``device_get``, which merely
         collects when the async copies already landed — and assemble the
@@ -1561,6 +1670,19 @@ class Manager:
         host leaves full-precision but upcast the whole payload before
         the ring, which is why bf16 only ever thinned the D2H leg)."""
         t0 = time.perf_counter()
+        with self._tracer.span("fetch_wait", bucket=bucket) as wait_span:
+            bufs, d2h = self._wait_bucket_inner(recs, leaves)
+            wait_span.set(bytes=d2h)
+        ms = (time.perf_counter() - t0) * 1e3
+        self._record(
+            allreduce_fetch_wait_ms_total=ms,
+            allreduce_fetch_ms_total=ms,
+            # Bytes that actually crossed D2H (host-native leaves never
+            # do; rank-local accounting, no cross-rank constraint).
+            allreduce_wire_bytes_total=float(d2h))
+        return bufs
+
+    def _wait_bucket_inner(self, recs: list, leaves: list) -> tuple:
         got = iter(jax.device_get(
             [p for _, _, p in recs if p is not None]))
         bufs = []
@@ -1597,14 +1719,7 @@ class Manager:
                     seg[:] = np.ravel(np.asarray(leaves[i])).astype(
                         c.wire, copy=False)
             bufs.append(buf)
-        ms = (time.perf_counter() - t0) * 1e3
-        self._record(
-            allreduce_fetch_wait_ms_total=ms,
-            allreduce_fetch_ms_total=ms,
-            # Bytes that actually crossed D2H (host-native leaves never
-            # do; rank-local accounting, no cross-rank constraint).
-            allreduce_wire_bytes_total=float(d2h))
-        return bufs
+        return bufs, d2h
 
     # alias matching the reference's gradient-specific spelling
     allreduce_grad = allreduce
@@ -1698,8 +1813,9 @@ class Manager:
                     return
                 try:
                     put_t0 = time.perf_counter()
-                    shards = [div_by_count(np.asarray(s), n)
-                              for s in f.result()]
+                    with self._tracer.span("put", chunks=len(chunks)):
+                        shards = [div_by_count(np.asarray(s), n)
+                                  for s in f.result()]
                     self._record(allreduce_put_ms_total=(
                         time.perf_counter() - put_t0) * 1e3)
                     with lock:
@@ -1731,7 +1847,8 @@ class Manager:
             nonlocal next_to_stage
             while next_to_stage < min(hi, n_buckets):
                 staged[next_to_stage] = self._stage_bucket(
-                    sched.chunks[next_to_stage], leaves)
+                    sched.chunks[next_to_stage], leaves,
+                    bucket=next_to_stage)
                 next_to_stage += 1
 
         int8 = self._policy.wire == policy_mod.WIRE_INT8
@@ -1740,7 +1857,7 @@ class Manager:
             if participating:
                 stage_through(n_buckets if window is None
                               else b + 1 + window)
-                bufs = self._wait_bucket(staged[b], leaves)
+                bufs = self._wait_bucket(staged[b], leaves, bucket=b)
                 staged[b] = None
                 if int8:
                     bufs = self._int8_quantize_bucket(sched, b, chunks,
@@ -1791,13 +1908,14 @@ class Manager:
         restored params; the vote must still cover the allgather that
         follows). Idempotent; :meth:`should_commit` re-runs it as a
         no-op."""
-        if self._quorum_future is not None:
-            self.wait_quorum()
-        for work in self._pending_work:
-            work.result()  # errors already swallowed into defaults
-        self._pending_work = []
-        if self._healing and self._pending_state_dict is not None:
-            self._apply_pending_state_dict()
+        with self._tracer.span("drain", pending=len(self._pending_work)):
+            if self._quorum_future is not None:
+                self.wait_quorum()
+            for work in self._pending_work:
+                work.result()  # errors already swallowed into defaults
+            self._pending_work = []
+            if self._healing and self._pending_state_dict is not None:
+                self._apply_pending_state_dict()
 
     def record_update(self, ms: float, shard_state_bytes: float,
                       resets: int = 0) -> None:
@@ -1915,7 +2033,8 @@ class Manager:
         fut, box, _step = self._deferred
         t_drain = time.perf_counter()
         try:
-            res = fut.result()
+            with self._tracer.span("overlap_drain", deferred_step=_step):
+                res = fut.result()
         finally:
             self._deferred = None
         t_done = box["done"]
@@ -1981,9 +2100,12 @@ class Manager:
         sync, counters, and the ``policy_switch``/``policy_adopt``
         event with from/to/reason/signals."""
         old = self._policy
+        old_rung = (self._controller.rung_of(old)
+                    if self._controller is not None else None)
         wire_changed = old.wire != p.wire
         self._policy = p
         self._install_policy_knobs(p)
+        self._tracer.set_context(policy_name=p.name)
         if wire_changed:
             # Wire-rung transitions flush quantizer state: the int8
             # rung's residuals belong to the outgoing format and must
@@ -2007,6 +2129,13 @@ class Manager:
                    if hasattr(signals, "as_dict") else signals}
         self._log_event(event=event, step=self._step, reason=reason,
                         **{"from": old.name, "to": p.name}, **sig)
+        if old_rung is not None and rung > old_rung:
+            # An escalation means the failure regime just got worse —
+            # exactly the moment a postmortem wants the span ring and
+            # event window that DROVE the controller's decision.
+            self._flight_dump("policy_escalation",
+                              **{"from": old.name, "to": p.name,
+                                 "why": reason})
         logger.info("%s policy %s -> %s at step %d (%s)",
                     self._replica_id, old.name, p.name, self._step,
                     reason)
@@ -2211,12 +2340,14 @@ class Manager:
         local_ok = self._errored is None and enough
 
         commit_t0 = time.perf_counter()
-        decision = self._client.should_commit(
-            rank=self._rank,
-            step=self._step,
-            should_commit=local_ok,
-            timeout_ms=timeout_ms or self._timeout_ms,
-        )
+        with self._tracer.span("vote", local_ok=local_ok) as vote_span:
+            decision = self._client.should_commit(
+                rank=self._rank,
+                step=self._step,
+                should_commit=local_ok,
+                timeout_ms=timeout_ms or self._timeout_ms,
+            )
+            vote_span.set(decision=bool(decision))
         self._record(
             commit_count=1,
             commit_ms_total=(time.perf_counter() - commit_t0) * 1e3,
@@ -2234,6 +2365,9 @@ class Manager:
                 event="abort", step=self._step, local_ok=local_ok,
                 error=repr(self._errored) if self._errored else None,
             )
+            self._flight_dump(
+                "vote_abort", local_ok=local_ok,
+                error=repr(self._errored) if self._errored else None)
         if self._controller is not None:
             self._policy_post_vote(decision)
         self._publish_status()
@@ -2257,10 +2391,17 @@ class Manager:
         (quorum timeouts, heal failures) leave the ring alone — forcing a
         lone group into a rebuild its peers don't know about would stall
         it against their healthy ring."""
+        latched_comm = (isinstance(e, CommunicatorError)
+                        and not self._comm_poisoned)
         if isinstance(e, CommunicatorError):
             self._comm_poisoned = True
         if self._errored is None:
             self._errored = e
+        if latched_comm:
+            # Crash-time attribution: the ring just died under us; the
+            # dump's span ring shows exactly which collective, bucket,
+            # and step the reset landed in.
+            self._flight_dump("comm_error", error=repr(e))
 
     def errored(self) -> Optional[Exception]:
         return self._errored
@@ -2274,7 +2415,17 @@ class Manager:
 
     def _log_event(self, **event: Any) -> None:
         event["t"] = time.time()
+        # Clock-step-proof ordering (see _event_seq in __init__): the
+        # monotonic stamp orders this process's events under wall-clock
+        # steps; seq breaks monotonic ties from interleaved threads and
+        # gives downstream mergers a per-manager total order. Stamped
+        # UNDER the lock, with the seq, so the two can never contradict
+        # (a pre-lock stamp could lose the race and pair an older
+        # monotonic with a newer seq).
         with self._metrics_lock:
+            event["t_mono_ns"] = time.monotonic_ns()
+            self._event_seq += 1
+            event["seq"] = self._event_seq
             self._history.append(event)
 
     def history(self) -> list:
@@ -2300,6 +2451,10 @@ class Manager:
                     "step": self._step,
                     "quorum_id": self._quorum_id,
                     "metrics": mx,
+                    # String diagnostics ride beside the numeric dict
+                    # (metrics_info — the /metrics.json spelling of the
+                    # numeric/string split).
+                    "info": self.metrics_info(),
                     "history": self.history(),
                 }),
                 int(mx["heal_count"]),
@@ -2348,11 +2503,11 @@ class Manager:
         int8_bytes = getattr(self._comm, "int8_ring_bytes_total", None)
         out["allreduce_int8_ring_bytes_total"] = (
             float(int8_bytes()) if int8_bytes is not None else 0.0)
-        # Active-policy identity (strings, like ckpt_last_error —
-        # outside the numeric-schema set): which policy produced these
-        # counters, and why the last switch happened.
-        out["policy_name"] = self._policy.name
-        out["policy_last_reason"] = self._policy_last_reason
+        # Observability-tier health: span ring volume/drops and flight-
+        # recorder dump count (docs/design/observability.md).
+        out.update(self._tracer.metrics())
+        out.update(self._flight.metrics() if self._flight is not None
+                   else {"flight_dumps_total": 0.0})
         # Fetch-path health (process-wide — the jit caches are too):
         # pack-executable cache misses must stop growing after the first
         # step of each grad signature, and async-D2H fallbacks explain a
@@ -2367,9 +2522,6 @@ class Manager:
         # it.
         if self._ckpt_writer is not None:
             out.update(self._ckpt_writer.metrics())
-            last = self._ckpt_writer.last_error()
-            if last:
-                out["ckpt_last_error"] = last
         # Live-publication counters (generations, delta bytes/ratio,
         # serve volume) from the attached WeightPublisher, so
         # /metrics.json shows what the serving tier is doing next to
@@ -2377,6 +2529,32 @@ class Manager:
         if self._publisher is not None:
             out.update(self._publisher.metrics())
         return out
+
+    def metrics_info(self) -> Dict[str, str]:
+        """String-valued diagnostics, SPLIT from the numeric
+        :meth:`metrics` dict at the source: the Prometheus exposition
+        renders :meth:`metrics` as gauges/counters and this dict as one
+        ``torchft_info`` label set, and the numeric dict's
+        values-are-numeric invariant (tests/test_metrics_schema.py)
+        holds with no per-key carve-outs. Served next to the counters
+        in ``/metrics.json`` (``info``) and stamped into flight-
+        recorder dumps.
+
+        Keys: ``policy_name`` / ``policy_last_reason`` (the active
+        FT policy and why it was last switched), ``ckpt_last_error``
+        (the attached durable writer's sticky last failure, ``""`` when
+        clean), and ``flight_last_path`` (newest flight-recorder dump,
+        ``""`` before the first)."""
+        last_err = ""
+        if self._ckpt_writer is not None:
+            last_err = self._ckpt_writer.last_error() or ""
+        return {
+            "policy_name": self._policy.name,
+            "policy_last_reason": self._policy_last_reason,
+            "ckpt_last_error": last_err,
+            "flight_last_path": (self._flight.last_path
+                                 if self._flight is not None else ""),
+        }
 
     # ------------------------------------------------- durable checkpoints
 
@@ -2437,7 +2615,11 @@ class Manager:
         path = os.path.join(directory, f"{prefix}{self._step}")
         state = (user_state if user_state is not None
                  else self._user_state_dict())
-        fut = writer.save_async(path, state, self.state_dict(), meta=meta)
+        # Spans the DISPATCH (snapshot + enqueue); the write itself runs
+        # on the writer's save thread and is timed by its own metrics.
+        with self._tracer.span("ckpt_save", path=path):
+            fut = writer.save_async(path, state, self.state_dict(),
+                                    meta=meta)
         self._log_event(event="ckpt_save", step=self._step, path=path)
         return fut
 
@@ -2492,7 +2674,9 @@ class Manager:
         t0 = time.perf_counter()
         state = (user_state if user_state is not None
                  else self._user_state_dict())
-        gen = publisher.publish(state, step=self._step)
+        with self._tracer.span("publish") as pub_span:
+            gen = publisher.publish(state, step=self._step)
+            pub_span.set(generation=gen)
         self._record(publish_count=1,
                      publish_ms_total=(time.perf_counter() - t0) * 1e3)
         with self._metrics_lock:  # gauge, not a counter
@@ -2661,6 +2845,16 @@ class Manager:
     def replica_id(self) -> str:
         return self._replica_id
 
+    def tracer(self) -> "tracing_mod.Tracer":
+        """This manager's span tracer (docs/design/observability.md):
+        the ring behind ``GET /trace.json`` and the flight recorder."""
+        return self._tracer
+
+    def flight_recorder(self) -> Optional["tracing_mod.FlightRecorder"]:
+        """The attached flight recorder (None only before init
+        completes); disabled unless ``TORCHFT_FLIGHT_DIR`` is set."""
+        return self._flight
+
     def store_address(self) -> str:
         return getattr(self, "_store_addr", "")
 
@@ -2678,6 +2872,8 @@ class Manager:
                 "FTTrainer.flush() before shutdown to apply them)",
                 self._replica_id)
             self._deferred = None
+        if self._flight is not None:
+            self._flight.close()  # off the atexit crash-dump registry
         self._ckpt_server.shutdown()
         self._executor.shutdown(wait=False, cancel_futures=True)
         # No cancel_futures here: a queued finish_bucket must still run (it
