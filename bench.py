@@ -477,6 +477,125 @@ def bench_multigroup(n_groups: int = 2, steps: int = 20,
     }
 
 
+# --------------------------------------------------------------- scenario 1a
+
+def bench_degraded_goodput(n_groups: int = 2, steps: int = 12,
+                           hidden: int = 256, depth: int = 2,
+                           batch_size: int = 32,
+                           degrade_fraction: float = 0.5
+                           ) -> Dict[str, float]:
+    """Degraded-mode goodput A/B (docs/design/degraded_mode.md): N
+    host-backend groups train with ElasticSampler-driven batches and
+    the weighted canonical fold armed (``degraded_mode=True``); after a
+    healthy phase, the LAST group "loses half its chips" — a capacity
+    degrade to ``degrade_fraction``, the same transition the
+    DegradedModeDriver lands on real device loss — and the run keeps
+    going at nonuniform capacity.
+
+    The metric is committed-samples/sec: the cluster's goodput should
+    settle near ``1 - (1 - fraction)/n`` of the healthy baseline
+    (~87.5% at 2 groups / half capacity with equal step walls, and
+    never below the ~75% sample-rate floor), where whole-group
+    eviction costs a full ``1/n`` (~50% at 2 groups). The nightly soak
+    gates ``degraded_ratio >= 0.70``."""
+    from torchft_tpu import HostCommunicator, Lighthouse, Manager
+    from torchft_tpu.data import ElasticSampler
+    from torchft_tpu.models import MLP
+    from torchft_tpu.parallel import FTTrainer
+
+    lh = Lighthouse(bind="127.0.0.1:0", min_replicas=n_groups,
+                    join_timeout_ms=2000, quorum_tick_ms=10)
+    model = MLP(features=(hidden,) * depth, num_classes=10)
+    rng = np.random.default_rng(0)
+    n_rows = batch_size * 8
+    x = jnp.asarray(rng.normal(size=(n_rows, 64)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 10, size=(n_rows,)), jnp.int32)
+
+    def loss_fn(params, batch):
+        logits = model.apply(params, batch["x"])
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, batch["y"]).mean()
+
+    params0 = model.init(jax.random.key(0), x[:1])
+    phase_gate = threading.Barrier(n_groups)
+    lock = threading.Lock()
+    samples = {"healthy": 0, "degraded": 0}
+    walls: Dict[str, list] = {"healthy": [], "degraded": []}
+    caps: Dict[str, float] = {}
+
+    def worker(gid: int) -> None:
+        trainer = FTTrainer(
+            loss_fn=loss_fn, tx=optax.sgd(0.05), params=params0,
+            manager_factory=lambda load, save: Manager(
+                comm=HostCommunicator(timeout_sec=30),
+                load_state_dict=load, state_dict=save,
+                min_replica_size=n_groups, replica_id=f"dg{gid}",
+                lighthouse_addr=lh.address(), rank=0, world_size=1,
+                quorum_timeout_ms=30_000, degraded_mode=True))
+        sampler = ElasticSampler(n_rows, trainer.manager,
+                                 batch_size=batch_size, seed=0)
+        drawn = {"k": 0}
+
+        def batch():
+            idx = sampler.next_indices()
+            drawn["k"] = len(idx)
+            return {"x": x[idx], "y": y[idx]}
+
+        trainer.train_step(batch)  # compile + join + first reconfigure
+        for phase in ("healthy", "degraded"):
+            phase_gate.wait(timeout=120)
+            if phase == "degraded" and gid == n_groups - 1:
+                # The chip loss: landed at a commit boundary, nothing
+                # in flight — exactly what DegradedModeDriver.tick does
+                # after surviving_submesh on real device loss. A
+                # refusal here is a harness bug (nothing can be
+                # mid-heal/deferred at this barrier): fail loudly, not
+                # via a -O-strippable assert that would let both
+                # phases silently run healthy.
+                if not trainer.manager.request_degrade(
+                        degrade_fraction):
+                    raise RuntimeError(
+                        "degrade refused at an idle phase boundary")
+            phase_gate.wait(timeout=120)
+            trainer.train_step(batch)  # recompile off the clock
+            t0 = time.perf_counter()
+            done = 0
+            got = 0
+            while done < steps:
+                _, committed = trainer.train_step(batch)
+                if committed:
+                    done += 1
+                    got += drawn["k"]
+            dt = time.perf_counter() - t0
+            with lock:
+                samples[phase] += got
+                walls[phase].append(dt)
+        caps[f"g{gid}"] = trainer.manager.metrics()[
+            "degraded_capacity_fraction"]
+        trainer.shutdown()
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(n_groups)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=600)
+    lh.shutdown()
+
+    healthy = samples["healthy"] / max(max(walls["healthy"]), 1e-9)
+    degraded = samples["degraded"] / max(max(walls["degraded"]), 1e-9)
+    return {
+        "n_groups": n_groups,
+        "degrade_fraction": degrade_fraction,
+        "healthy_samples_per_s": healthy,
+        "degraded_samples_per_s": degraded,
+        "degraded_ratio": degraded / max(healthy, 1e-9),
+        # What whole-group eviction of the wounded group would leave.
+        "eviction_ratio": (n_groups - 1) / n_groups,
+        "capacity_fractions": dict(caps),
+    }
+
+
 # --------------------------------------------------------------- scenario 1b
 
 def bench_transformer(steps: int = 6, batch: Optional[int] = None,
@@ -1790,6 +1909,22 @@ def main() -> None:
            "allreduce_opt_state_mbytes":
                round(mb["opt_state_mbytes"], 2),
            "rs_opt_state_mbytes": round(mrs["opt_state_mbytes"], 2)})
+
+    # Degraded-mode goodput A/B (docs/design/degraded_mode.md): one
+    # group loses half its capacity mid-run and keeps contributing at
+    # nonuniform parallelism — committed-samples/sec should settle well
+    # above the ~50% whole-group-eviction floor (nightly gate >= 70%).
+    dg = bench_degraded_goodput()
+    _emit({"metric": "degraded_goodput_ab",
+           "n_groups": dg["n_groups"],
+           "degrade_fraction": dg["degrade_fraction"],
+           "healthy_samples_per_s": round(
+               dg["healthy_samples_per_s"], 1),
+           "degraded_samples_per_s": round(
+               dg["degraded_samples_per_s"], 1),
+           "degraded_ratio": round(dg["degraded_ratio"], 3),
+           "eviction_ratio": dg["eviction_ratio"],
+           "capacity_fractions": dg["capacity_fractions"]})
 
     # Striped-heal A/B: 1 vs 3 donors at a fixed per-donor egress cap
     # (the donor-uplink-bound regime); wall should drop toward 1/3.

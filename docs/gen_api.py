@@ -39,6 +39,7 @@ MODULES = [
     ("torchft_tpu.optim", "Commit-gated optimizer wrappers"),
     ("torchft_tpu.policy", "Adaptive fault-tolerance policy"),
     ("torchft_tpu.data", "Replica-group data sharding"),
+    ("torchft_tpu.degraded", "Degraded-mode groups (partial chip loss)"),
     ("torchft_tpu.local_sgd", "DiLoCo-style local SGD"),
     ("torchft_tpu.parallel.step", "Fault-tolerant training step"),
     ("torchft_tpu.parallel.mesh", "Device mesh construction"),

@@ -134,6 +134,25 @@ if [[ "${1:-}" == "policy" ]]; then
     exit 0
 fi
 
+# Degrade tier: the degraded-mode groups' focused gate
+# (docs/design/degraded_mode.md) — surviving-submesh derivation +
+# sharding fallback re-derivation, the weighted canonical-order fold
+# over socketpair rings (bitwise vs the numpy oracle at worlds 2/3,
+# int8 rung, reduce-scatter stripes, weight-mode/geometry skew aborts),
+# the chaos `device` channel, the Manager's degrade/restore lifecycle
+# (boundary refusals, flight dumps, the atomic capacity-bearing
+# participant_slot snapshot), ElasticSampler capacity draws, and the
+# DegradedModeDriver re-pjit lifecycle. Tier-1 too (not marked slow);
+# run this tier on degraded/manager/host/data/parallel changes. The
+# 2-group chip-loss goodput soak (>= 70%-of-healthy gate, bench row
+# degraded_goodput_ab) is nightly+slow and rides the nightly tier.
+if [[ "${1:-}" == "degrade" ]]; then
+    stage degrade env JAX_PLATFORMS=cpu \
+        python -m pytest tests/test_degraded.py -q -m "degrade and not slow"
+    echo "== total: ${SECONDS}s"
+    exit 0
+fi
+
 # Obs tier: the observability tier's focused gate
 # (docs/design/observability.md) — span-ring bounds/context, the
 # flight recorder's triggers (vote abort, latched comm error, heal

@@ -304,7 +304,9 @@ class TestElasticLoader:
                 # assert the predictor mostly wins on a steady stream.
                 while time.monotonic() < deadline:
                     with loader._lock:
-                        if m.bc in loader._cache:
+                        # Cache keys are (slot, capacity_fraction) since
+                        # degraded-mode draws (docs/design/degraded_mode.md).
+                        if (m.bc, 1.0) in loader._cache:
                             break
                     time.sleep(0.01)
                 batch = loader()

@@ -69,6 +69,9 @@ DOCUMENTED_KEYS = frozenset([
     "publish_last_generation",
     # transport retries
     "retry_count", "retry_ms_total", "retry_giveups",
+    # degraded-mode groups (docs/design/degraded_mode.md)
+    "degraded_capacity_fraction", "degrade_events_total",
+    "restore_events_total",
     # adaptive FT policy (docs/design/adaptive_policy.md)
     "policy_current", "policy_switches_total",
     "policy_switch_refusals", "policy_switch_deferrals",

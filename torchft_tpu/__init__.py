@@ -35,6 +35,7 @@ from torchft_tpu.backends.mesh import MeshCommunicator, MeshWorld
 from torchft_tpu.data import (BatchIterator, DistributedSampler,
                               ElasticBatchIterator, ElasticLoader,
                               ElasticSampler)
+from torchft_tpu.degraded import DegradedModeDriver, live_devices
 from torchft_tpu.local_sgd import (DiLoCoTrainer, StreamingDiLoCoTrainer,
                                    diloco_outer_optimizer)
 from torchft_tpu.manager import Manager, WorldSizeMode
@@ -71,8 +72,10 @@ __all__ = [
     "is_transient",
     "Communicator",
     "CommunicatorError",
+    "DegradedModeDriver",
     "DelayedOptimizer",
     "DiLoCoTrainer",
+    "live_devices",
     "StreamingDiLoCoTrainer",
     "DistributedSampler",
     "ElasticBatchIterator",

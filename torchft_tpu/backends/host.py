@@ -517,13 +517,17 @@ class HostCommunicator(Communicator):
                        op: str = "sum") -> Future:
         origs = [np.dtype(d) for d in orig_dtypes]
         if self._world == 1:
+            # World-1 weighted average of one contributor is the
+            # contributor itself (w*x/w = x), so the unweighted local
+            # resolution is correct in both modes.
             return self._immediate([
                 self._local_wire(b, d) for b, d in zip(buffers, origs)])
-        # The payload-kind tag (set_wire_tag) is captured HERE, on the
-        # caller thread, so each queued op carries the tag in force
-        # when it was issued.
+        # The payload-kind tag (set_wire_tag) and the fold weight
+        # (set_wire_weight) are captured HERE, on the caller thread, so
+        # each queued op carries the values in force when it was issued.
         return self._submit("allreduce_wire", list(buffers), origs, op,
-                            getattr(self, "wire_tag", ""))
+                            getattr(self, "wire_tag", ""),
+                            int(getattr(self, "wire_weight", -1)))
 
     def reduce_scatter_wire(self, buffers: Sequence[Any],
                             orig_dtypes: Sequence[Any],
@@ -534,7 +538,8 @@ class HostCommunicator(Communicator):
             return self._immediate([
                 self._local_wire(b, d) for b, d in zip(buffers, origs)])
         return self._submit("reduce_scatter_wire", list(buffers), origs,
-                            op, getattr(self, "wire_tag", ""))
+                            op, getattr(self, "wire_tag", ""),
+                            int(getattr(self, "wire_weight", -1)))
 
     def broadcast(self, tree: Any, root: int = 0) -> Future:
         if self._world == 1:
@@ -689,11 +694,12 @@ class HostCommunicator(Communicator):
         return np.array(acc[bounds[rank]:bounds[rank + 1]])
 
     def _wire_preamble(self, ring: _Ring, op: str, buffers: List[Any],
-                       origs: List[np.dtype], tag: str = "") -> None:
-        """Per-wire-op format handshake: each rank streams a 16-byte
-        preamble (magic + a hash of the op kind and every buffer's wire
-        format/size) to its successor and checks its predecessor's
-        against its own.
+                       origs: List[np.dtype], tag: str = "",
+                       weight: int = -1) -> Optional[List[int]]:
+        """Per-wire-op format handshake: each rank ring-allgathers a
+        24-byte preamble (magic + a hash of the op kind and every
+        buffer's wire format/size + this rank's fold weight) and checks
+        every peer's format hash against its own.
 
         This is the skew DETECTOR the adaptive-policy layer relies on
         (docs/design/adaptive_policy.md): policies switch between steps
@@ -704,9 +710,26 @@ class HostCommunicator(Communicator):
         turns any residual skew — e.g. a policy publication read lost to
         chaos at the exact switch boundary — into a clean
         :class:`CommunicatorError`, which aborts the step via the commit
-        vote and re-syncs at the next boundary. Cost: 16 bytes + one
-        segment latency per wire op, excluded from the ring byte
-        counters (it is protocol, not payload)."""
+        vote and re-syncs at the next boundary.
+
+        The weight slot carries the degraded-mode fold weight
+        (docs/design/degraded_mode.md): ``-1`` = unweighted (the
+        classic uniform fold; returns ``None``), ``>= 0`` = the samples
+        this rank contributes this step. Weight VALUES legitimately
+        differ across ranks — that is nonuniform capacity — but weight
+        MODE may not: one rank folding weighted while a peer folds
+        uniform would silently disagree on every collective's values,
+        so mode mixing aborts on the FIRST hop exactly like a format
+        mismatch (pairwise detection is transitive around a cycle; the
+        configure-time ``degraded=`` fingerprint blocks mixed launches
+        before a ring even forms). Unweighted ops stop after that one
+        hop — the classic preamble cost; weighted ops keep forwarding
+        for the remaining world-2 hops so every rank learns every
+        rank's weight. Returns the weights in rank order when
+        weighted. Cost: 24 bytes + one segment latency per op
+        unweighted, 24*(world-1) + (world-1) weighted — excluded from
+        the ring byte counters (protocol, not payload)."""
+        n, rank = self._world, self._rank
         desc = [op, tag]
         for b, orig in zip(buffers, origs):
             if isinstance(b, Int8Wire):
@@ -715,23 +738,65 @@ class HostCommunicator(Communicator):
                 a = np.asarray(b)
                 desc.append(f"{a.dtype}:{a.size}:{orig}")
         key = epoch_key("|".join(desc))
-        fut = ring.send_async(struct.pack("<qq", _WIRE_MAGIC, key))
-        magic, got = struct.unpack(
-            "<qq", bytes(_recv_exact(ring.prev_sock, 16)))
-        fut.result()
-        if magic != _WIRE_MAGIC or got != key:
-            raise CommunicatorError(
-                "wire format skew: predecessor announced a different "
-                f"wire-op format (got {got:#x}, expected {key:#x}) — "
-                "policy/wire-dtype mismatch across groups; aborting the "
-                "collective before folding garbage")
+        weight = int(weight)
+
+        def skew(gkey: int) -> CommunicatorError:
+            return CommunicatorError(
+                "wire format skew: a peer announced a different "
+                f"wire-op format (got {gkey:#x}, expected {key:#x})"
+                " — policy/wire-dtype mismatch across groups; "
+                "aborting the collective before folding garbage")
+
+        weights = [0] * n
+        weights[rank] = weight
+        payload: Any = struct.pack("<qqq", _WIRE_MAGIC, key, weight)
+        for step in range(n - 1):
+            fut = ring.send_async(payload)
+            got = bytes(_recv_exact(ring.prev_sock, 24))
+            fut.result()
+            magic, gkey, gw = struct.unpack("<qqq", got)
+            if magic != _WIRE_MAGIC or gkey != key:
+                raise skew(gkey)
+            if (gw < 0) != (weight < 0):
+                raise CommunicatorError(
+                    "wire weight skew: this op mixes weighted and "
+                    f"unweighted ranks (mine {weight}, a peer's {gw}) "
+                    "— degraded mode (weighted folding) must be "
+                    "enabled on EVERY group or none; aborting the "
+                    "collective before folding garbage")
+            if weight < 0:
+                # Unweighted op: one pairwise hop proved format + mode
+                # agreement (transitively, around the cycle) — the
+                # classic preamble cost, no weight collection needed.
+                return None
+            weights[(rank - step - 1) % n] = gw
+            payload = got  # forward the received record along the ring
+        return weights if weight >= 0 else None
 
     def _do_allreduce_wire(self, ring: Optional[_Ring],
                            buffers: List[Any], origs: List[np.dtype],
-                           op: str, tag: str = "") -> List[np.ndarray]:
+                           op: str, tag: str = "",
+                           weight: int = -1) -> List[np.ndarray]:
         if ring is None:
             raise CommunicatorError("communicator not configured")
-        self._wire_preamble(ring, "ar", buffers, origs, tag)
+        weights = self._wire_preamble(ring, "ar", buffers, origs, tag,
+                                      weight)
+        if weights is not None:
+            # Degraded-mode weighted fold: resolves to the weighted
+            # AVERAGE (normalized by total weight inside the fold — the
+            # Manager skips its 1/n), via the canonical-rank-order raw
+            # allgather for every chunk kind.
+            if op == "mean":
+                raise CommunicatorError(
+                    "op='mean' is not supported with weighted folding "
+                    "(the weighted fold already normalizes)")
+            return [
+                self._ring_allreduce_int8(ring, buf, orig,
+                                          weights=weights)
+                if isinstance(buf, Int8Wire)
+                else self._ring_allreduce_weighted(ring, buf, orig,
+                                                   weights)
+                for buf, orig in zip(buffers, origs)]
         out: List[np.ndarray] = []
         for buf, orig in zip(buffers, origs):
             if isinstance(buf, Int8Wire):
@@ -834,8 +899,96 @@ class HostCommunicator(Communicator):
             acc += b.astype(orig)
         return acc
 
+    def _ring_allgather_raw(self, ring: _Ring,
+                            wire_buf: np.ndarray) -> List[np.ndarray]:
+        """Ring-allgather of every rank's RAW wire buffer (each step
+        forwards the previously received one), returned in rank order —
+        the shared transport of the degraded-mode weighted folds (same
+        loop shape as the int8 rung's :meth:`_ring_allgather_int8`)."""
+        n, rank = self._world, self._rank
+        a = np.ravel(np.asarray(wire_buf))
+        if not a.flags.c_contiguous:
+            a = np.ascontiguousarray(a)
+        size, wdt = a.size, a.dtype
+        nbytes = size * wdt.itemsize
+        bufs: List[Optional[np.ndarray]] = [None] * n
+        bufs[rank] = a
+        send_view = _as_bytes(a)
+        for step in range(n - 1):
+            self._ring_bytes += nbytes
+            fut = ring.send_async(send_view)
+            recv = np.empty(size, wdt)
+            _recv_exact_into(ring.prev_sock, _as_bytes(recv))
+            fut.result()
+            bufs[(rank - step - 1) % n] = recv
+            send_view = _as_bytes(recv)
+        return bufs  # type: ignore[return-value]
+
+    @staticmethod
+    def _weighted_fold(bufs: Any, orig: np.dtype,
+                       weights: List[int], lo: int,
+                       hi: int) -> np.ndarray:
+        """The ONE spelling of the weighted canonical-order fold
+        (docs/design/degraded_mode.md): ``acc = sum_r(w_r * x_r)`` in
+        rank order 0..n-1 — each product in the accumulator dtype —
+        then normalized by the total weight (true-divide for floats,
+        floor-divide for ints, the ``div_by_count`` dtype rule).
+        Zero-weight contributions are EXCLUDED from the fold, not
+        multiplied by zero: a healer's junk buffer with weight 0 (an
+        inf/NaN element times 0.0 is NaN) must never poison the
+        average. ``[lo, hi)`` restricts the fold to a stripe, which
+        slice-commutes elementwise — the reduce-scatter stripe is
+        bitwise the same slice of the allreduce result. ``bufs`` may
+        be any iterable — the int8 paths feed a dequantize GENERATOR
+        so only one full-size buffer is live at a time."""
+        acc = np.zeros(hi - lo, orig)
+        scalar = orig.type
+        for w, b in zip(weights, bufs):
+            if w:
+                acc += np.ravel(b)[lo:hi].astype(orig) * scalar(w)
+        total = sum(weights)
+        if total:
+            if np.issubdtype(orig, np.floating):
+                acc /= scalar(total)
+            else:
+                acc //= total
+        return acc
+
+    def _ring_allreduce_weighted(self, ring: _Ring,
+                                 wire_buf: np.ndarray, orig: np.dtype,
+                                 weights: List[int]) -> np.ndarray:
+        """Weighted wire allreduce (degraded-mode groups): ring-allgather
+        every rank's RAW wire contribution — never partial sums — and
+        run the weighted canonical fold. Identical raw bytes folded in
+        identical order make the result bitwise identical across ranks
+        AND equal to the single-process numpy oracle. Raw forwarding
+        costs (world-1)*wire bytes per rank — more than the exact
+        ring's 2(n-1)/n past world 2 — accepted: weighting partial sums
+        would smear each rank's weight across fold boundaries (and
+        break the one-quantization contract for narrow wires), and
+        degraded mode is a robustness regime, not a bandwidth one."""
+        bufs = self._ring_allgather_raw(ring, wire_buf)
+        return self._weighted_fold(bufs, orig, weights, 0,
+                                   bufs[0].size)
+
+    def _ring_reduce_scatter_weighted(self, ring: _Ring,
+                                      wire_buf: np.ndarray,
+                                      orig: np.dtype,
+                                      weights: List[int]) -> np.ndarray:
+        """Reduce-scatter sibling: identical raw allgather transport,
+        weighted fold restricted to this rank's canonical stripe —
+        concat of every rank's stripe is bitwise the
+        :meth:`_ring_allreduce_weighted` result."""
+        bufs = self._ring_allgather_raw(ring, wire_buf)
+        bounds = shard_bounds(bufs[0].size, self._world)
+        return self._weighted_fold(
+            bufs, orig, weights, int(bounds[self._rank]),
+            int(bounds[self._rank + 1]))
+
     def _ring_allreduce_int8(self, ring: _Ring, w: Int8Wire,
-                             orig: np.dtype) -> np.ndarray:
+                             orig: np.dtype,
+                             weights: Optional[List[int]] = None
+                             ) -> np.ndarray:
         """int8 + error-feedback wire allreduce (the new rung of the
         wire ladder, ISSUE 10): ring-allgather every rank's RAW
         quantized contribution — ``(scales, zeros, q)`` per
@@ -851,8 +1004,19 @@ class HostCommunicator(Communicator):
         f32 exact ring at world 2, and cheaper than upcasting through
         world*1 <= 2*orig.itemsize*... in practice any realistic world
         (the 4x itemsize ratio pushes the raw-forwarding crossover to
-        world 32 for f32), so there is no crossover fallback here."""
+        world 32 for f32), so there is no crossover fallback here.
+
+        ``weights`` (degraded-mode groups) switches the fold to the
+        weighted canonical fold over the dequantized contributions —
+        normalized by the total weight, zero-weight ranks excluded
+        (:meth:`_weighted_fold`'s contract). Dequantization is fed
+        lazily, so the weighted fold keeps the unweighted path's
+        one-full-buffer-at-a-time peak memory."""
         bufs = self._ring_allgather_int8(ring, w)
+        if weights is not None:
+            return self._weighted_fold(
+                (wb.dequantize(orig) for wb in bufs), orig, weights,
+                0, w.size)
         acc = np.zeros(w.size, orig)
         for wb in bufs:
             acc += wb.dequantize(orig)
@@ -886,17 +1050,27 @@ class HostCommunicator(Communicator):
                 for b in raw]
 
     def _ring_reduce_scatter_int8(self, ring: _Ring, w: Int8Wire,
-                                  orig: np.dtype) -> np.ndarray:
+                                  orig: np.dtype,
+                                  weights: Optional[List[int]] = None
+                                  ) -> np.ndarray:
         """Reduce-scatter sibling: identical raw allgather transport
         (quantization segments span stripe boundaries, so stripes can't
         ride alone without re-quantizing — which would break the
         one-quantization-per-contribution contract), but the canonical
         fold runs only over this rank's stripe: concat of every rank's
-        stripe is bitwise the :meth:`_ring_allreduce_int8` result."""
+        stripe is bitwise the :meth:`_ring_allreduce_int8` result
+        (weighted folds included — the stripe restriction
+        slice-commutes)."""
         n, rank = self._world, self._rank
         bufs = self._ring_allgather_int8(ring, w)
         bounds = shard_bounds(w.size, n)
         lo, hi = int(bounds[rank]), int(bounds[rank + 1])
+        if weights is not None:
+            # Lazy dequantize: one full buffer live at a time, like
+            # the unweighted loop below.
+            return self._weighted_fold(
+                (wb.dequantize(orig) for wb in bufs), orig, weights,
+                lo, hi)
         acc = np.zeros(hi - lo, orig)
         for wb in bufs:
             acc += wb.dequantize(orig)[lo:hi]
@@ -904,10 +1078,24 @@ class HostCommunicator(Communicator):
 
     def _do_reduce_scatter_wire(self, ring: Optional[_Ring],
                                 buffers: List[Any], origs: List[np.dtype],
-                                op: str, tag: str = "") -> List[np.ndarray]:
+                                op: str, tag: str = "",
+                                weight: int = -1) -> List[np.ndarray]:
         if ring is None:
             raise CommunicatorError("communicator not configured")
-        self._wire_preamble(ring, "rs", buffers, origs, tag)
+        weights = self._wire_preamble(ring, "rs", buffers, origs, tag,
+                                      weight)
+        if weights is not None:
+            if op == "mean":
+                raise CommunicatorError(
+                    "op='mean' is not supported with weighted folding "
+                    "(the weighted fold already normalizes)")
+            return [
+                self._ring_reduce_scatter_int8(ring, buf, orig,
+                                               weights=weights)
+                if isinstance(buf, Int8Wire)
+                else self._ring_reduce_scatter_weighted(ring, buf, orig,
+                                                        weights)
+                for buf, orig in zip(buffers, origs)]
         out: List[np.ndarray] = []
         for buf, orig in zip(buffers, origs):
             if isinstance(buf, Int8Wire):

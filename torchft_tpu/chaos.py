@@ -85,6 +85,7 @@ __all__ = [
     "begin",
     "end",
     "disk_fault",
+    "device_fault",
 ]
 
 
@@ -125,6 +126,19 @@ class EndpointChaos:
     torn_rate: float = 0.0
     flip_rate: float = 0.0
     enospc_rate: float = 0.0
+    # Device faults (the ``device`` channel, honored by
+    # :func:`device_fault` — the degraded-mode soak's injection point,
+    # docs/design/degraded_mode.md): per-decision probability of one
+    # chip dying (``chip_loss_rate``) or one previously-lost chip
+    # coming back (``chip_return_rate``) on the endpoint
+    # ``device:<replica_id>``. The lost-chip SET is schedule state
+    # (:meth:`ChaosSchedule.lost_chips`); which chip is picked derives
+    # from the decision's own frac draw, so the event sequence stays a
+    # pure function of (seed, channel, n). Appended LAST in the
+    # fault-band order (the determinism contract: existing channels'
+    # traces are unchanged while these rates are 0).
+    chip_loss_rate: float = 0.0
+    chip_return_rate: float = 0.0
     max_faults: int = -1         # cap on hard faults per channel (-1 = inf)
 
 
@@ -197,6 +211,11 @@ class ChaosSchedule:
         # streamed-byte counters for the kill_after_bytes trigger.
         self._dead: Dict[str, bool] = {}
         self._bytes: Dict[str, int] = {}
+        # Device-fault state (channel ``device``): per-endpoint set of
+        # lost chip indices, mutated by chip_loss/chip_return decisions
+        # (device_fault) or deterministically by tests
+        # (lose_chip/return_chip).
+        self._lost_chips: Dict[str, set] = {}
 
     # ------------------------------------------------------------- config
 
@@ -259,7 +278,9 @@ class ChaosSchedule:
                                (cfg.kill_rate, "kill"),
                                (cfg.torn_rate, "torn"),
                                (cfg.flip_rate, "flip"),
-                               (cfg.enospc_rate, "enospc")):
+                               (cfg.enospc_rate, "enospc"),
+                               (cfg.chip_loss_rate, "chip_loss"),
+                               (cfg.chip_return_rate, "chip_return")):
                 acc += rate * scale
                 if u < acc:
                     fault = kind
@@ -318,6 +339,25 @@ class ChaosSchedule:
     def dead_endpoints(self) -> List[str]:
         with self._lock:
             return [e for e, d in self._dead.items() if d]
+
+    # ---------------------------------------------------- device faults
+
+    def lost_chips(self, endpoint: str) -> frozenset:
+        """Current lost chip indices of a ``device:*`` endpoint."""
+        with self._lock:
+            return frozenset(self._lost_chips.get(endpoint, ()))
+
+    def lose_chip(self, endpoint: str, idx: int) -> None:
+        """Latch one chip lost (tests use this for a deterministic
+        chip loss at an exact moment; the ``chip_loss_rate`` fault
+        calls it internally via :func:`device_fault`)."""
+        with self._lock:
+            self._lost_chips.setdefault(endpoint, set()).add(int(idx))
+
+    def return_chip(self, endpoint: str, idx: int) -> None:
+        """Clear one lost-chip latch (the chip "came back")."""
+        with self._lock:
+            self._lost_chips.get(endpoint, set()).discard(int(idx))
 
     def kill_allowance(self, endpoint: str) -> Optional[int]:
         """Bytes this endpoint may still stream before its
@@ -544,6 +584,53 @@ def disk_fault(endpoint: str, op: str = "save",
             errno.EIO,
             f"[chaos] {endpoint}/{op}#{d.n}: input/output error")
     return d
+
+
+# --------------------------------------------------------- device faults
+
+
+def device_fault(endpoint: str, n_devices: int,
+                 schedule: Optional[ChaosSchedule] = None) -> frozenset:
+    """Per-boundary device-fault hook (channel ``device``; the
+    degraded-mode driver polls it once per commit boundary with
+    endpoint ``device:<replica_id>``).
+
+    Draws one decision for the endpoint; a ``chip_loss`` fault latches
+    one more chip lost, a ``chip_return`` fault revives one previously
+    lost chip. The chip index derives from the decision's own ``frac``
+    draw, so the whole event sequence is a pure function of
+    ``(seed, channel, n)`` — replayable like every other channel — and
+    both rates scale with the live intensity, so
+    :class:`~torchft_tpu.policy.PhasedChaos` drives chip churn through
+    stable -> storm -> stable phases unmodified. A loss that would kill
+    the LAST chip is skipped: a group with zero devices is whole-group
+    death, which is the eviction path's job, not this channel's.
+
+    Returns the endpoint's CURRENT lost chip indices (empty when no
+    chaos targets it)."""
+    sched = schedule if schedule is not None else active()
+    if sched is None:
+        return frozenset()
+    if sched.config_for(endpoint) is None:
+        # No rates configured: no decision draw (stream purity), but a
+        # deterministically latched lost set (lose_chip/return_chip —
+        # the tests' exact-moment injection) still applies.
+        return sched.lost_chips(endpoint)
+    n_devices = max(int(n_devices), 1)
+    d = sched.decide(endpoint, "device")
+    if d is not None and d.fault == "chip_loss":
+        lost = sched.lost_chips(endpoint)
+        if len(lost) < n_devices - 1:
+            # Deterministic pick among the still-live chips.
+            live = [i for i in range(n_devices) if i not in lost]
+            sched.lose_chip(endpoint,
+                            live[int(d.frac * len(live)) % len(live)])
+    elif d is not None and d.fault == "chip_return":
+        lost = sorted(sched.lost_chips(endpoint))
+        if lost:
+            sched.return_chip(endpoint,
+                              lost[int(d.frac * len(lost)) % len(lost)])
+    return sched.lost_chips(endpoint)
 
 
 # ------------------------------------------------------------- sockets
@@ -857,6 +944,9 @@ class ChaosCommunicator(Communicator):
 
     def set_wire_tag(self, tag: str) -> None:
         self._comm.set_wire_tag(tag)
+
+    def set_wire_weight(self, weight: int) -> None:
+        self._comm.set_wire_weight(weight)
 
     def ring_bytes_total(self) -> float:
         return self._comm.ring_bytes_total()

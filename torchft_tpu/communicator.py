@@ -353,6 +353,24 @@ class Communicator(ABC):
         abort)."""
         self.wire_tag = tag
 
+    def set_wire_weight(self, weight: int) -> None:
+        """Declare this rank's fold WEIGHT for subsequent wire ops — the
+        samples this group actually contributes this step (degraded-mode
+        groups, docs/design/degraded_mode.md). ``-1`` (the default when
+        never set) means unweighted: the classic uniform fold.
+
+        Byte-counted transports carry the weight in the per-op format
+        preamble's ring allgather, so every rank learns every rank's
+        weight and folds ``sum_r(w_r * x_r) / sum_r(w_r)`` in canonical
+        rank order — identical bytes, identical order, bitwise identical
+        across ranks. Weight-mode skew (one rank weighted, a peer not)
+        is DETECTED by the preamble and aborts the op cleanly; the
+        per-rank weight VALUES legitimately differ (that is the point of
+        nonuniform capacity). Like the tag, the weight is captured per
+        op on the caller thread. Wrappers MUST forward inward — a weight
+        stranded on a wrapper silently degrades the fold to uniform."""
+        self.wire_weight = int(weight)
+
     def set_retry_policy(self, policy: Any, stats: Any = None) -> None:
         """Install the owning Manager's transient-error retry policy and
         shared :class:`~torchft_tpu.retry.RetryStats`, so the backend's
@@ -574,6 +592,9 @@ class ErrorSwallowingCommunicator(Communicator):
     def set_wire_tag(self, tag: str) -> None:
         self._comm.set_wire_tag(tag)
 
+    def set_wire_weight(self, weight: int) -> None:
+        self._comm.set_wire_weight(weight)
+
     def ring_bytes_total(self) -> float:
         return self._comm.ring_bytes_total()
 
@@ -703,6 +724,9 @@ class ManagedCommunicator(Communicator):
 
     def set_wire_tag(self, tag: str) -> None:
         self._comm.set_wire_tag(tag)
+
+    def set_wire_weight(self, weight: int) -> None:
+        self._comm.set_wire_weight(weight)
 
     def ring_bytes_total(self) -> float:
         return self._comm.ring_bytes_total()

@@ -329,6 +329,18 @@ class StatefulLoader:
         self._q = None
 
 
+def _reports_samples(manager: Any) -> bool:
+    """True when a draw should report its sample count as the
+    degraded-mode fold weight: the manager accepts reports AND is in
+    degraded mode (the only mode the weight is read). Duck-typed
+    managers exposing ``set_step_samples`` without the mode probe
+    (test doubles) report unconditionally."""
+    if getattr(manager, "set_step_samples", None) is None:
+        return False
+    dm = getattr(manager, "degraded_mode", None)
+    return dm is None or bool(dm())
+
+
 class ElasticSampler:
     """Membership-elastic index batches: data sharding that follows the
     quorum instead of a static group count.
@@ -397,35 +409,68 @@ class ElasticSampler:
             self._perm_cache[epoch] = perm
         return perm
 
+    def _snapshot(self) -> tuple:
+        """``(rank, batches_committed, capacity_fraction)`` — one atomic
+        ``Manager.participant_slot`` read (which also joins the step's
+        in-flight quorum, so the rank is never the previous
+        membership's). Duck-typed managers (test doubles) may return a
+        2-tuple (capacity defaults to 1.0) or lack the API entirely
+        (the legacy two-read path)."""
+        snap = getattr(self.manager, "participant_slot", None)
+        if snap is not None:
+            got = snap()
+            if len(got) >= 3:
+                return got[0], got[1], float(got[2])
+            return got[0], got[1], 1.0
+        return (self.manager.participant_rank(),
+                self.manager.batches_committed(), 1.0)
+
     def current_slot(self) -> int:
         """This group's slot of the current step (live quorum state).
 
-        Reads ``(participant_rank, batches_committed)`` as one atomic
-        snapshot (``Manager.participant_slot``, taken under the manager's
-        metrics lock) rather than two separate calls: the async quorum
-        thread installs a new rank concurrently with ``step()`` advancing
-        the commit counter, and a torn pair — new rank with the old
-        counter, or vice versa — would silently draw a wrong slot.
-        Duck-typed managers without the snapshot API (test doubles) fall
-        back to the two-read path."""
-        snap = getattr(self.manager, "participant_slot", None)
-        if snap is not None:
-            rank, committed = snap()
-        else:
-            rank = self.manager.participant_rank()
-            committed = self.manager.batches_committed()
+        Reads the slot through the atomic ``Manager.participant_slot``
+        snapshot (taken under the manager's metrics lock, after joining
+        any in-flight quorum round) rather than separate calls: the
+        async quorum thread installs a new rank concurrently with
+        ``step()`` advancing the commit counter, and a torn pair —
+        new rank with the old counter, or vice versa — would silently
+        draw a wrong slot. Duck-typed managers without the snapshot API
+        (test doubles) fall back to the two-read path."""
+        rank, committed, _frac = self._snapshot()
         return int(committed) + (rank or 0)
 
-    def indices_for_slot(self, slot: int) -> np.ndarray:
-        """Deterministic index batch for any slot of the global stream."""
+    def indices_for_slot(self, slot: int,
+                         capacity_fraction: float = 1.0) -> np.ndarray:
+        """Deterministic index batch for any slot of the global stream.
+
+        ``capacity_fraction`` < 1 (a degraded group,
+        docs/design/degraded_mode.md) draws only the first
+        ``round(batch_size * fraction)`` indices of the slot — the
+        group contributes fewer samples and its gradient is weighted
+        accordingly; the slot's tail goes unvisited this epoch (the
+        same lossy contract as a static sampler's dead shard, but
+        bounded to the degraded remainder instead of a whole shard)."""
         epoch, pos = divmod(int(slot), self.batches_per_epoch)
         perm = self._perm(int(epoch))
         lo = pos * self.batch_size
-        return perm[lo:lo + self.batch_size]
+        k = self.batch_size
+        if capacity_fraction < 1.0:
+            k = max(1, int(round(self.batch_size * capacity_fraction)))
+        return perm[lo:lo + k]
 
     def next_indices(self) -> np.ndarray:
-        """Index batch for this group's slot of the current step."""
-        return self.indices_for_slot(self.current_slot())
+        """Index batch for this group's slot of the current step, sized
+        by the capacity fraction riding the same atomic snapshot. In
+        degraded mode the draw size is reported back to the manager
+        (``Manager.set_step_samples``) so the fold weight is exactly
+        the samples this batch contributes; outside it (and for
+        duck-typed managers without the mode probe) the report is
+        skipped — the weight is never read."""
+        rank, committed, frac = self._snapshot()
+        idx = self.indices_for_slot(int(committed) + (rank or 0), frac)
+        if _reports_samples(self.manager):
+            self.manager.set_step_samples(len(idx))
+        return idx
 
     def epoch(self) -> int:
         return int(self.manager.batches_committed()
@@ -479,17 +524,14 @@ class ElasticLoader:
     ``manager.batches_committed()``, already part of the manager state a
     healer restores, and slot->indices is a pure function of it.
 
-    Residual race window: the slot snapshot is atomic
-    (``Manager.participant_slot`` — no torn rank/counter pair), but it
-    reflects the *last resolved* quorum. A draw taken between
-    ``manager.step()`` and that step's async quorum resolving can use the
-    previous membership's rank; the draw then lands on a slot another
-    group may also draw, or skips one — bounded to AT MOST the one step
-    around a membership change (the same one-step slot-reuse the class
-    docstring's abort semantics already allow, and exactly why
-    ``FTTrainer`` draws the batch *after* joining the quorum). Exactness
-    of resume is unaffected: committed positions derive only from
-    committed counters.
+    The once-documented residual race window — a draw between
+    ``manager.step()`` and that step's async quorum resolving using the
+    previous membership's rank — is CLOSED: ``participant_slot`` now
+    joins the in-flight quorum round before snapshotting (see its
+    docstring), so every draw reflects the step's resolved membership
+    and capacity fraction. The join is what the step's collective would
+    have blocked on anyway; duck-typed managers without the snapshot
+    API are unaffected.
     """
 
     def __init__(self, dataset: Any, sampler: ElasticSampler,
@@ -497,11 +539,12 @@ class ElasticLoader:
         self.dataset = dataset
         self.sampler = sampler
         self.prefetch = max(int(prefetch), 0)
-        self._cache: Dict[int, Any] = {}   # slot -> batch (LRU by insert)
+        # (slot, capacity_fraction) -> batch (LRU by insert)
+        self._cache: Dict[tuple, Any] = {}
         self._cache_cap = 2 * self.prefetch + 2
         self._lock = threading.Lock()
         self._inflight: set = set()
-        self._req: "queue.Queue[Optional[int]]" = queue.Queue()
+        self._req: "queue.Queue[Optional[tuple]]" = queue.Queue()
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self.prefetch_hits = 0
@@ -530,51 +573,75 @@ class ElasticLoader:
 
     def _prefetch_loop(self) -> None:
         while True:
-            slot = self._req.get()
+            key = self._req.get()
             # Stop flag checked before every storage read: shutdown must
             # not wait behind a queue of full synchronous dataset reads
             # (cf. StatefulLoader._halt's contract).
-            if slot is None or self._stop.is_set():
+            if key is None or self._stop.is_set():
                 return
+            slot, frac = key
             try:
-                batch = self.dataset[self.sampler.indices_for_slot(slot)]
+                batch = self.dataset[
+                    self.sampler.indices_for_slot(slot, frac)]
             except Exception:  # noqa: BLE001 — drop; the draw re-reads
                 with self._lock:
-                    self._inflight.discard(slot)
+                    self._inflight.discard(key)
                 continue
             with self._lock:
-                self._inflight.discard(slot)
-                self._store(slot, batch)
+                self._inflight.discard(key)
+                self._store(key, batch)
 
-    def _store(self, slot: int, batch: Any) -> None:
-        self._cache[slot] = batch
+    def _store(self, key: tuple, batch: Any) -> None:
+        self._cache[key] = batch
         while len(self._cache) > self._cache_cap:
             self._cache.pop(next(iter(self._cache)))
 
     def __call__(self) -> Any:
-        """Draw the current step's batch (call AFTER ``manager.step()``)."""
-        slot = self.sampler.current_slot()
+        """Draw the current step's batch (call AFTER ``manager.step()``).
+
+        Cache/prefetch keys are ``(slot, capacity_fraction)`` — a
+        degraded group's shrunken draw (docs/design/degraded_mode.md)
+        can never be served a full-capacity prefetch of the same slot,
+        and a capacity transition simply costs one prediction miss."""
+        rank, committed, frac = self.sampler._snapshot()
+        slot = int(committed) + (rank or 0)
+        key = (slot, frac)
         with self._lock:
-            batch = self._cache.get(slot)
+            batch = self._cache.get(key)
         if batch is None:
-            # Prediction miss (first step, membership change, or abort of
-            # a never-predicted slot): one synchronous storage read.
+            # Prediction miss (first step, membership change, capacity
+            # transition, or abort of a never-predicted slot): one
+            # synchronous storage read.
             self.prefetch_misses += 1
-            batch = self.dataset[self.sampler.indices_for_slot(slot)]
+            batch = self.dataset[self.sampler.indices_for_slot(slot,
+                                                               frac)]
             with self._lock:
-                self._store(slot, batch)  # kept: an abort redraws it
+                self._store(key, batch)  # kept: an abort redraws it
         else:
             self.prefetch_hits += 1
+        # The served draw IS the contribution: in degraded mode, report
+        # its size as the fold weight (same contract as
+        # ElasticSampler.next_indices; guarded so the non-degraded hot
+        # path pays no tree flatten for a weight never read). The
+        # sample count is the leading dim of the batch's first LEAF —
+        # a tuple/list batch's len() would be its field count, not its
+        # rows.
+        if _reports_samples(self.sampler.manager):
+            import jax
+
+            leaves = jax.tree_util.tree_leaves(batch)
+            if leaves:
+                self.sampler.manager.set_step_samples(len(leaves[0]))
         if self.prefetch > 0:
             self._ensure_thread()
             n = max(int(getattr(self.sampler.manager, "num_participants",
                                 lambda: 1)() or 1), 1)
             with self._lock:
                 for ahead in range(1, self.prefetch + 1):
-                    s = slot + ahead * n
-                    if s not in self._cache and s not in self._inflight:
-                        self._inflight.add(s)
-                        self._req.put(s)
+                    k = (slot + ahead * n, frac)
+                    if k not in self._cache and k not in self._inflight:
+                        self._inflight.add(k)
+                        self._req.put(k)
         return batch
 
     def shutdown(self) -> None:

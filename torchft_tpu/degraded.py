@@ -1,0 +1,203 @@
+"""Degraded-mode groups: survive partial chip loss with nonuniform
+parallelism instead of whole-group eviction
+(docs/design/degraded_mode.md).
+
+Today's baseline behavior — a replica group that loses one chip dies
+wholesale and its work redistributes in whole-group quanta — wastes the
+group's surviving capacity. Per *Nonuniform-Tensor-Parallelism* (arxiv
+2504.06095) a wounded group should rejoin the quorum at reduced
+capacity and keep contributing; per the 100k-GPU HSDP paper (arxiv
+2602.00277) partial-capacity operation is the dominant production
+regime, not the exception.
+
+The pieces, each living where its layer lives:
+
+* :func:`torchft_tpu.parallel.mesh.surviving_submesh` — largest usable
+  submesh over the live-device set (the data axis shrinks, TP/SP axes
+  survive intact) plus the capacity fraction;
+* :func:`torchft_tpu.parallel.sharding.degraded_shardings` — param
+  layout re-derivation that falls back to replication where the
+  shrunken axis no longer divides;
+* :meth:`torchft_tpu.manager.Manager.request_degrade` /
+  ``request_restore`` — the capacity transition itself, landing only at
+  commit boundaries and refused mid-heal/mid-deferred like
+  ``save_durable``;
+* the **weighted canonical-order fold** in the host ring
+  (``backends/host.py``) — every group's gradient weighted by samples
+  actually contributed, the weight riding the per-op wire preamble so
+  weight/geometry skew aborts cleanly;
+* :class:`~torchft_tpu.data.ElasticSampler` — the per-group batch
+  shrinks with the capacity fraction riding the same atomic
+  ``participant_slot`` snapshot as the slot itself.
+
+This module is the per-group GLUE: :class:`DegradedModeDriver` polls
+the live-device set once per commit boundary (the chaos ``device``
+channel is the test/soak injection point — :func:`live_devices`), and
+on a change walks the full degrade -> rejoin -> restore lifecycle.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+from torchft_tpu import chaos
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["DegradedModeDriver", "live_devices"]
+
+
+def live_devices(replica_id: str,
+                 devices: Optional[Sequence[Any]] = None,
+                 schedule: Optional["chaos.ChaosSchedule"] = None) -> list:
+    """The group's current live-device list: ``devices`` (default
+    ``jax.devices()``) minus the chaos ``device`` channel's lost-chip
+    set for endpoint ``device:<replica_id>`` — one ``device_fault``
+    decision is drawn per call, so polling this once per commit
+    boundary IS the seeded chip-loss/chip-return event stream the
+    degraded-mode soak drives (optionally through
+    :class:`~torchft_tpu.policy.PhasedChaos` intensity phases). With no
+    chaos installed it returns the real device list unchanged — the
+    production spelling, where a lost TPU chip simply vanishes from the
+    runtime's view."""
+    if devices is None:
+        import jax
+
+        devices = jax.devices()
+    devices = list(devices)
+    lost = chaos.device_fault(f"device:{replica_id}", len(devices),
+                              schedule)
+    if not lost:
+        return devices
+    return [d for i, d in enumerate(devices) if i not in lost]
+
+
+class DegradedModeDriver:
+    """Per-group degrade -> rejoin -> restore driver.
+
+    Owns one group's full mesh and layout inputs; :meth:`tick` — called
+    once per commit boundary, after the step's vote settled — probes
+    the live-device set and, when the surviving capacity changed,
+    lands the transition end to end:
+
+    1. derive the surviving submesh + capacity fraction
+       (:func:`~torchft_tpu.parallel.mesh.surviving_submesh`);
+    2. land it on the manager (:meth:`Manager.request_degrade` /
+       ``request_restore`` — refused mid-heal/mid-deferred and simply
+       retried at the next tick);
+    3. re-derive shardings for the target mesh
+       (:func:`~torchft_tpu.parallel.sharding.degraded_shardings`) and
+       re-place the trainer's pytrees
+       (:meth:`FTTrainer.set_placement` — the re-``pjit``: jit
+       re-specializes on the new placement at the next step).
+
+    The per-group batch shrink needs no driver action: the capacity
+    fraction rides the manager's atomic ``participant_slot`` snapshot,
+    so the group's :class:`~torchft_tpu.data.ElasticSampler` draws the
+    shrunken batch (and reports its exact size as the fold weight) on
+    the very next step. Restore is the same walk back onto the full
+    mesh — the params re-heal onto it by re-placement (their values
+    never left lockstep; only their layout was wounded).
+
+    Args:
+        trainer: the group's :class:`~torchft_tpu.parallel.FTTrainer`
+            (anything with ``manager`` + ``set_placement`` works).
+        mesh: the FULL mesh the group was launched on.
+        rules: TP partition rules, as given to ``combined_shardings``.
+        fsdp_axis / min_size: FSDP inference knobs, ditto.
+        batch_axes: data axes of the batch spec.
+        shrink_axis: mesh axis chip loss shrinks (default: first).
+        probe: zero-arg callable returning the current live-device
+            list; defaults to :func:`live_devices` over the manager's
+            replica id and the full mesh's devices (the chaos-drivable
+            spelling).
+    """
+
+    def __init__(self, trainer: Any, mesh: Any, rules: Sequence = (),
+                 fsdp_axis: str = "fsdp", min_size: int = 1024,
+                 batch_axes: Tuple[str, ...] = ("dp", "fsdp"),
+                 shrink_axis: Optional[str] = None,
+                 probe: Optional[Callable[[], Sequence[Any]]] = None
+                 ) -> None:
+        self.trainer = trainer
+        self.mesh = mesh
+        self.rules = tuple(rules)
+        self.fsdp_axis = fsdp_axis
+        self.min_size = min_size
+        self.batch_axes = tuple(batch_axes)
+        self.shrink_axis = shrink_axis
+        self._probe = probe
+        self._fraction = 1.0  # capacity the trainer's layout reflects
+
+    @property
+    def manager(self) -> Any:
+        return self.trainer.manager
+
+    def fraction(self) -> float:
+        """Capacity the trainer's CURRENT layout reflects (the
+        manager's own fraction can briefly differ only between a landed
+        transition and this driver's re-placement, which happen in one
+        tick)."""
+        return self._fraction
+
+    def _live(self) -> list:
+        if self._probe is not None:
+            return list(self._probe())
+        return live_devices(self.manager.replica_id(),
+                            list(self.mesh.devices.flat))
+
+    def _place(self, target_mesh: Any) -> None:
+        from jax.sharding import NamedSharding
+
+        from torchft_tpu.parallel.sharding import (batch_spec,
+                                                   degraded_shardings)
+
+        shardings = degraded_shardings(
+            self.trainer.params, target_mesh, rules=self.rules,
+            fsdp_axis=self.fsdp_axis, min_size=self.min_size)
+        self.trainer.set_placement(
+            param_shardings=shardings,
+            batch_sharding=NamedSharding(
+                target_mesh, batch_spec(target_mesh, self.batch_axes)))
+
+    def tick(self) -> bool:
+        """One boundary's poll; returns True when a capacity transition
+        landed (manager + placement). Call between steps, after the
+        vote — never with a collective in flight.
+
+        The manager transition and the re-placement are independently
+        idempotent: the manager half keys on ``capacity_fraction()``,
+        the placement half on this driver's own ``fraction()``. A
+        ``_place`` failure (e.g. transient OOM replicating a fallback
+        leaf) therefore propagates WITHOUT desyncing — the next tick
+        sees the manager already at the target fraction (no duplicate
+        degrade event/flight dump) and retries only the placement."""
+        from torchft_tpu.parallel.mesh import surviving_submesh
+
+        try:
+            submesh, frac = surviving_submesh(
+                self.mesh, self._live(), self.shrink_axis)
+        except ValueError:
+            # No slice survives: the group is effectively dead. Leave
+            # the layout alone — the quorum's liveness machinery (lapsed
+            # heartbeats, eviction) owns this case.
+            logger.warning("%s: no usable submesh survives the device "
+                           "loss; leaving degraded-mode state unchanged "
+                           "(whole-group eviction path takes over)",
+                           self.manager.replica_id())
+            return False
+        if frac == self._fraction \
+                and frac == self.manager.capacity_fraction():
+            return False
+        if frac != self.manager.capacity_fraction():
+            if frac < 1.0:
+                landed = self.manager.request_degrade(frac)
+            else:
+                landed = self.manager.request_restore()
+            if not landed:
+                return False  # refused (mid-heal/deferred); retry next tick
+        if frac != self._fraction:
+            self._place(submesh if frac < 1.0 else self.mesh)
+            self._fraction = frac
+        return True

@@ -68,6 +68,12 @@ MANAGER_ADDR_KEY: str = "manager/addr"
 # like the healset keys: the store has no delete/TTL, so a per-step key
 # would leak one entry per boundary for the life of the job).
 _POLICY_KEY: str = "torchft/policy"
+# Fold-weight encoding of a capacity fraction when the caller never
+# reports exact per-step sample counts (degraded-mode groups,
+# docs/design/degraded_mode.md): weight = round(fraction * SCALE).
+# Only RATIOS between groups matter, so any shared scale works; 10_000
+# keeps three decimal places of fraction resolution in integer weights.
+_CAPACITY_WEIGHT_SCALE = 10_000
 T = TypeVar("T")
 
 
@@ -234,6 +240,22 @@ class Manager:
             canonical-order f32 fold is shared). The flag is the opt-in
             contract read by the trainer wiring; the collective calls
             themselves work on any Manager.
+        degraded_mode: opt-in degraded-mode groups (env
+            ``TORCHFT_DEGRADED``, docs/design/degraded_mode.md): a
+            group that loses part of its devices survives at reduced
+            capacity instead of dying wholesale — it re-``pjit``s onto
+            the surviving submesh, shrinks its per-group batch, and
+            rejoins the quorum advertising a capacity fraction
+            (:meth:`request_degrade` / :meth:`request_restore`, landing
+            only at commit boundaries, refused mid-heal/mid-deferred
+            like :meth:`save_durable`). When True, every host-ring wire
+            op carries this group's fold weight — the samples actually
+            contributed this step — and the ring runs the **weighted
+            canonical-order fold** (``sum_r(w_r·g_r) / sum_r(w_r)``,
+            bitwise identical across ranks); the per-op preamble turns
+            any weight-mode or geometry skew into a clean abort. Must
+            be enabled on EVERY group or none (enforced at rendezvous
+            via the config fingerprint and per-op via the preamble).
         heal_striped: stripe a heal transfer across ALL live donors
             concurrently (docs/design/sharded_update.md; env
             ``TORCHFT_HEAL_STRIPED``, default on). Participants publish
@@ -307,6 +329,7 @@ class Manager:
         allreduce_wire_dtype: Optional[Any] = None,
         overlap_steps: int = 0,
         shard_update: bool = False,
+        degraded_mode: Optional[bool] = None,
         heal_striped: Optional[bool] = None,
         auth_token: Optional[str] = None,
         checkpoint_bind_host: Optional[str] = None,
@@ -382,6 +405,27 @@ class Manager:
         # caller thread that runs the pipelines.
         self._ef_residuals: Dict[tuple, np.ndarray] = {}
         self._shard_update = bool(shard_update)
+        # --- degraded-mode groups (docs/design/degraded_mode.md) ---------
+        # Weighted folding is a CLUSTER-WIDE wire-format property (every
+        # group weighted or none — mode mixing is a per-op preamble
+        # abort), so it is a launch flag like shard_update, not a live
+        # knob; the per-group capacity fraction IS live
+        # (request_degrade/request_restore, landing only at commit
+        # boundaries). _step_samples, when reported (set_step_samples /
+        # an ElasticSampler draw), is the exact fold weight; otherwise
+        # the weight derives from the capacity fraction at a fixed
+        # scale, so groups sharing a batch config stay proportional.
+        if degraded_mode is None:
+            degraded_mode = os.environ.get(
+                "TORCHFT_DEGRADED", "0").strip() in ("1", "true")
+        self._degraded = bool(degraded_mode)
+        if self._degraded and getattr(comm, "wants_device_arrays", False):
+            raise ValueError(
+                "degraded_mode requires a host-path communicator: the "
+                "weighted fold lives in the host ring's wire ops, which "
+                "on-device backends never issue")
+        self._capacity_fraction = 1.0
+        self._step_samples: Optional[int] = None
         if heal_striped is None:
             heal_striped = os.environ.get(
                 "TORCHFT_HEAL_STRIPED", "1").strip() not in ("0", "false")
@@ -537,6 +581,13 @@ class Manager:
             # policy_name / policy_last_reason are strings and live in
             # metrics_info() with ckpt_last_error (the numeric/string
             # split, docs/design/observability.md).
+            # Degraded-mode groups (docs/design/degraded_mode.md): the
+            # capacity fraction in force (gauge, 1.0 = full capacity),
+            # and the count of degrade / restore transitions that
+            # actually landed (refusals ride the event log).
+            "degraded_capacity_fraction": 1.0,
+            "degrade_events_total": 0.0,
+            "restore_events_total": 0.0,
             "policy_current": -1.0,
             "policy_switches_total": 0.0,
             "policy_switch_refusals": 0.0,
@@ -950,8 +1001,10 @@ class Manager:
             setter = getattr(self._comm, "set_allreduce_config_fingerprint",
                              None)
             if setter is not None:
-                # payload=wire-v3 marks the ring payload format (narrow
-                # wire-dtype segments + per-op format preamble): a mixed
+                # payload=wire-v4 marks the ring payload format (narrow
+                # wire-dtype segments + the per-op format preamble,
+                # grown in v4 to a ring-allgathered 24-byte record
+                # carrying the degraded-mode fold weight): a mixed
                 # launch of pre/post-wire-ring builds must fail fast at
                 # rendezvous, not wedge mid-collective on mismatched
                 # byte counts. Policy-aware managers advertise
@@ -959,12 +1012,15 @@ class Manager:
                 # rendezvous, so the configure-time check can't pin it;
                 # per-step agreement is the policy coordination's job
                 # and any residual skew is caught by the wire-op
-                # preamble (backends/host.py).
+                # preamble (backends/host.py). degraded= pins the
+                # weighted-fold mode cluster-wide at rendezvous; the
+                # preamble's weight-mode check is the per-op backstop.
                 wire_fp = ("dynamic" if self._policy_aware
                            else str(self._wire_dtype))
                 setter(f"bucket_bytes={self._bucket_bytes};"
                        f"wire_dtype={wire_fp};"
-                       f"payload=wire-v3")
+                       f"degraded={int(self._degraded)};"
+                       f"payload=wire-v4")
             reconf_t0 = time.perf_counter()
             self._comm.configure(
                 store_prefixed, q.replica_rank, q.replica_world_size
@@ -990,6 +1046,7 @@ class Manager:
             # the native client (tests) or a flaky set must never fail a
             # training step.
             self._publish_healset(q)
+            self._publish_capacity(q)
         else:
             # We are lagging (or a fresh step-1 non-primary): fetch the
             # primary's live weights (reference manager.py:380-396).
@@ -1388,7 +1445,10 @@ class Manager:
         ``allreduce_ring_wire_bytes_total`` (TCP ring, counted by the
         backend).
         """
-        n = max(self.num_participants(), 1)
+        # Degraded mode: the weighted ring fold already normalized by
+        # the total weight (backends/host.py), so the put stage's 1/n
+        # must not divide again.
+        n = 1 if self._degraded else max(self.num_participants(), 1)
         participating = self.is_participating()
         ar_t0 = time.perf_counter()
         self._set_wire_tag()
@@ -1553,16 +1613,46 @@ class Manager:
         return scaled
 
     def _set_wire_tag(self) -> None:
-        """Stamp the payload-kind tag into the ring's per-op preamble
-        (``Communicator.set_wire_tag``, synchronously before each
-        pipeline's ops): DiLoCo outer-round pseudo-gradients and
-        per-step gradients have IDENTICAL geometry, so a one-boundary
-        policy-adoption skew across a DiLoCo transition could otherwise
-        fold one into the other silently — the tag turns that into a
-        detected abort. getattr tolerates bare duck-typed comms."""
+        """Stamp the payload-kind tag AND the degraded-mode fold weight
+        into the ring's per-op preamble (``Communicator.set_wire_tag``/
+        ``set_wire_weight``, synchronously before each pipeline's ops):
+        DiLoCo outer-round pseudo-gradients and per-step gradients have
+        IDENTICAL geometry, so a one-boundary policy-adoption skew
+        across a DiLoCo transition could otherwise fold one into the
+        other silently — the tag turns that into a detected abort.
+        getattr tolerates bare duck-typed comms."""
         setter = getattr(self._comm, "set_wire_tag", None)
         if setter is not None:
             setter("diloco" if self._policy.diloco else "step")
+        wsetter = getattr(self._comm, "set_wire_weight", None)
+        if wsetter is not None:
+            wsetter(self._wire_weight() if self._degraded else -1)
+
+    def _wire_weight(self) -> int:
+        """This step's fold weight (degraded mode): 0 while healing or
+        benched (the zero contribution must carry zero weight), else
+        the samples the caller reported via :meth:`set_step_samples`
+        (an :class:`~torchft_tpu.data.ElasticSampler` draw reports
+        automatically), else a fixed-scale encoding of the capacity
+        fraction — so groups that share a batch config stay
+        PROPORTIONAL whether or not they report exact counts, as long
+        as every group uses the same convention."""
+        if not self.is_participating():
+            return 0
+        with self._metrics_lock:
+            samples = self._step_samples
+            frac = self._capacity_fraction
+        if samples is not None:
+            return max(int(samples), 0)
+        return max(1, int(round(frac * _CAPACITY_WEIGHT_SCALE)))
+
+    def set_step_samples(self, samples: Optional[int]) -> None:
+        """Report the samples this group actually contributes this step
+        (the degraded-mode fold weight). ``None`` reverts to the
+        capacity-fraction-derived weight. No-op outside degraded mode."""
+        with self._metrics_lock:
+            self._step_samples = (None if samples is None
+                                  else int(samples))
 
     def _int8_quantize_bucket(self, sched: "_AllreduceSchedule", b: int,
                               chunks: list, bufs: list) -> list:
@@ -1783,7 +1873,9 @@ class Manager:
         1/n of the local stripe (~1/world of the allreduce's put bytes —
         there is no full-tree result to place; the updated params come
         back via the optimizer's allgather instead)."""
-        n = max(self.num_participants(), 1)
+        # Degraded mode: the weighted fold normalizes in the backend —
+        # same rule as _host_allreduce_pipelined's put stage.
+        n = 1 if self._degraded else max(self.num_participants(), 1)
         participating = self.is_participating()
         world = max(self._comm.size(), 1)
         rank = self._comm.rank()
@@ -2055,6 +2147,132 @@ class Manager:
         self._log_event(event="overlap_drop", step=self._step,
                         error=repr(self._errored) if self._errored
                         else None)
+
+    # ------------------------------------------- degraded-mode groups
+    # Partial-chip-loss survival (docs/design/degraded_mode.md): instead
+    # of dying wholesale when a chip drops, a group lands a capacity
+    # transition at the commit boundary — the trainer re-pjits onto the
+    # surviving submesh and shrinks its batch (DegradedModeDriver), the
+    # manager advertises the fraction on the quorum store and weights
+    # this group's fold contribution by samples actually contributed.
+    # Transitions are refused mid-heal/mid-deferred/errored, the
+    # save_durable refusal discipline — minus its not-committed rule,
+    # DELIBERATELY: an aborted step applied nothing (there is no state
+    # to mix), and the dominant degrade trigger IS a chip loss that
+    # keeps aborting the vote — refusing on aborted boundaries would
+    # deadlock exactly the recovery this path exists for.
+
+    def degraded_mode(self) -> bool:
+        """True when this Manager was built with ``degraded_mode=True``
+        (weighted folding enabled cluster-wide)."""
+        return self._degraded
+
+    def capacity_fraction(self) -> float:
+        """The capacity fraction in force (1.0 = full capacity)."""
+        with self._metrics_lock:
+            return self._capacity_fraction
+
+    def _capacity_blocked(self) -> list:
+        with self._metrics_lock:
+            healing = self._healing
+        blocked = []
+        if healing:
+            blocked.append("healing")
+        if self._deferred is not None:
+            blocked.append("deferred in flight")
+        if self._errored is not None:
+            blocked.append("errored")
+        return blocked
+
+    def _land_capacity(self, fraction: float, samples: Optional[int],
+                       event: str, counter: str, reason: str) -> bool:
+        blocked = self._capacity_blocked()
+        if blocked:
+            self._log_event(event=f"{event}_refused", step=self._step,
+                            fraction=fraction, why=",".join(blocked))
+            logger.warning(
+                "%s: %s to capacity %.3f refused (%s); retry at the "
+                "next boundary", self._replica_id, event, fraction,
+                ",".join(blocked))
+            return False
+        with self._metrics_lock:
+            prev = self._capacity_fraction
+            self._capacity_fraction = float(fraction)
+            self._step_samples = (None if samples is None
+                                  else int(samples))
+            self._metrics["degraded_capacity_fraction"] = float(fraction)
+            self._metrics[counter] += 1
+        self._log_event(event=event, step=self._step, reason=reason,
+                        **{"from": prev, "to": fraction})
+        # Every capacity transition leaves a Perfetto-loadable dump:
+        # the span ring around a degrade is exactly what the "why did
+        # this group shrink" postmortem wants.
+        self._flight_dump(event, **{"from": prev, "to": fraction,
+                                    "why": reason})
+        logger.info("%s capacity %.3f -> %.3f at step %d (%s)",
+                    self._replica_id, prev, fraction, self._step, reason)
+        return True
+
+    def request_degrade(self, fraction: float,
+                        samples: Optional[int] = None,
+                        reason: str = "device_loss") -> bool:
+        """Land a capacity degrade at the current commit boundary: this
+        group keeps training on its surviving submesh, contributing
+        ``fraction`` of its nominal batch, its gradient weighted by
+        samples actually contributed. Refused — returning False and
+        stamping a ``degrade_refused`` event — mid-heal, mid-deferred,
+        or errored, exactly like :meth:`save_durable`; callers retry at
+        the next boundary (:class:`~torchft_tpu.degraded.
+        DegradedModeDriver` does). ``samples`` optionally pins the
+        exact per-step sample count the fold weight uses. Under a
+        DiLoCo policy call this only at outer-round boundaries (where
+        the driver's tick naturally lands): the round's pseudo-gradient
+        is weighted by the per-step rate, which represents the round
+        only while capacity is constant across it."""
+        if not self._degraded:
+            raise RuntimeError(
+                f"{self._replica_id}: request_degrade needs "
+                "Manager(degraded_mode=True) — the weighted fold must "
+                "be armed cluster-wide at launch")
+        if not 0.0 < fraction <= 1.0:
+            raise ValueError(
+                f"capacity fraction must be in (0, 1], got {fraction!r}"
+                " — a group at fraction 0 is dead, which is the "
+                "whole-group eviction path's job")
+        return self._land_capacity(fraction, samples, "degrade",
+                                   "degrade_events_total", reason)
+
+    def request_restore(self, reason: str = "devices_returned") -> bool:
+        """Land the restore back to full capacity (devices returned /
+        replaced): the inverse of :meth:`request_degrade`, with the
+        same boundary discipline and refusal rules."""
+        if not self._degraded:
+            raise RuntimeError(
+                f"{self._replica_id}: request_restore needs "
+                "Manager(degraded_mode=True)")
+        return self._land_capacity(1.0, None, "restore",
+                                   "restore_events_total", reason)
+
+    def _publish_capacity(self, q: Any) -> None:
+        """Advertise this group's capacity fraction under the fixed
+        per-rank key ``torchft/capacity/{replica_rank}`` on the quorum
+        store, value ``"{step}:{fraction}"`` — the fleet-visibility
+        half of "rejoins the quorum advertising a capacity fraction"
+        (the fold itself learns weights from the wire preamble, which
+        is authoritative). Best-effort, like the healset keys, and the
+        key is fixed per rank for the same no-TTL-store reason."""
+        if not self._degraded:
+            return
+        try:
+            store = self._healset_client(q)
+            if store is None:
+                return
+            with self._metrics_lock:
+                frac = self._capacity_fraction
+            store.set(f"torchft/capacity/{q.replica_rank}",
+                      f"{self._step}:{frac}".encode())
+        except Exception:  # noqa: BLE001 — advertisement is best-effort
+            logger.debug("capacity publication failed", exc_info=True)
 
     # ------------------------------------------------- adaptive policy
     # Hot-swappable FT knobs (docs/design/adaptive_policy.md): the
@@ -2795,23 +3013,41 @@ class Manager:
         return self._participating_rank
 
     def participant_slot(self) -> tuple:
-        """Atomic ``(participant_rank, batches_committed)`` snapshot.
+        """Atomic ``(participant_rank, batches_committed,
+        capacity_fraction)`` snapshot.
 
-        Both halves are written under the metrics lock (``step()`` bumps
-        the commit counter, the quorum thread installs the new rank), so
-        unlike calling :meth:`participant_rank` and
-        :meth:`batches_committed` back to back, this can never observe a
-        torn pair — e.g. the new rank with the previous step's counter —
-        which would make :class:`~torchft_tpu.data.ElasticSampler` draw a
-        wrong slot. The snapshot is still only as current as the last
-        quorum the async thread resolved (see ElasticSampler's
-        membership-change note)."""
+        All three are written under the metrics lock (``step()`` bumps
+        the commit counter, the quorum thread installs the new rank,
+        :meth:`request_degrade`/:meth:`request_restore` move the
+        capacity), so unlike separate accessor calls this can never
+        observe a torn combination — e.g. the new rank with the
+        previous step's counter, or a fresh capacity with a stale rank
+        — which would make :class:`~torchft_tpu.data.ElasticSampler`
+        draw a wrong slot or a wrong-sized batch.
+
+        The snapshot also JOINS the current step's in-flight quorum
+        round first (when one is pending), closing the residual torn
+        window PR 1 documented: a draw taken between ``step()`` and
+        the async quorum resolving could previously use the previous
+        membership's rank, double-drawing or skipping one slot around
+        every membership change. The join is what the caller's
+        collective would have blocked on anyway; in steady state the
+        fast-path quorum resolves in ~ms, and a quorum FAILURE is
+        swallowed here (the step aborts through the normal
+        wait_quorum/vote path — the stale-but-consistent snapshot is
+        the right draw for a step that won't commit)."""
+        fut = self._quorum_future
+        if fut is not None and not fut.done():
+            try:
+                fut.result()
+            except Exception:  # noqa: BLE001 — latches via wait_quorum
+                pass
         with self._metrics_lock:
             if self._participating_rank is None or self._healing:
                 rank: Optional[int] = None
             else:
                 rank = self._participating_rank
-            return rank, self._batches_committed
+            return rank, self._batches_committed, self._capacity_fraction
 
     def is_participating(self) -> bool:
         """False while healing (async) or benched as a spare (reference

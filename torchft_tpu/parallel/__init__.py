@@ -1,8 +1,9 @@
-from torchft_tpu.parallel.mesh import make_mesh
+from torchft_tpu.parallel.mesh import make_mesh, surviving_submesh
 from torchft_tpu.parallel.sharding import (
     apply_rules,
     batch_spec,
     combined_shardings,
+    degraded_shardings,
     infer_fsdp_sharding,
     list_shardings,
     replicated,
@@ -30,6 +31,8 @@ __all__ = [
     "infer_fsdp_sharding",
     "list_shardings",
     "make_mesh",
+    "surviving_submesh",
+    "degraded_shardings",
     "replicated",
     "shard_tree",
 ]

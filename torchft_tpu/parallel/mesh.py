@@ -67,6 +67,50 @@ def make_mesh(
     return Mesh(arr, tuple(sizes.keys()))
 
 
+def surviving_submesh(
+    mesh: Mesh,
+    live_devices: Sequence[jax.Device],
+    shrink_axis: Optional[str] = None,
+) -> tuple:
+    """Largest usable submesh of ``mesh`` over only ``live_devices``
+    (degraded-mode groups, docs/design/degraded_mode.md).
+
+    A lost chip wounds exactly the slices of ``shrink_axis`` (default:
+    the first — outermost, data-ish — axis) that contain it: those
+    slices are dropped wholesale and the surviving full slices form the
+    submesh, so every OTHER axis keeps its size — TP/SP layouts stay
+    valid unmodified, only the data axis shrinks. This is the
+    nonuniform-parallelism shape (arxiv 2504.06095): the group keeps
+    its model parallelism and gives up batch throughput proportional to
+    the chips lost.
+
+    Returns ``(submesh, capacity_fraction)`` where the fraction is
+    ``surviving_slices / total_slices`` — what the group advertises to
+    the quorum (:meth:`torchft_tpu.manager.Manager.request_degrade`).
+    Returns ``(mesh, 1.0)`` unchanged when every device is live; raises
+    when no slice survives (that group IS dead — whole-group eviction
+    is the right path then, not degraded mode)."""
+    live = set(live_devices)
+    axis = shrink_axis if shrink_axis is not None else mesh.axis_names[0]
+    if axis not in mesh.axis_names:
+        raise ValueError(f"axis {axis!r} not in mesh {mesh.axis_names}")
+    ax = list(mesh.axis_names).index(axis)
+    devs = np.moveaxis(np.asarray(mesh.devices), ax, 0)
+    flat = devs.reshape(devs.shape[0], -1)  # slice -> its member chips
+    keep = [i for i in range(devs.shape[0])
+            if all(d in live for d in flat[i])]
+    if len(keep) == devs.shape[0]:
+        return mesh, 1.0
+    if not keep:
+        raise ValueError(
+            f"no full slice of axis {axis!r} survives the device loss "
+            "— the group cannot run degraded (whole-group eviction is "
+            "the remaining path)")
+    sub = np.moveaxis(devs[keep], 0, ax)
+    return (Mesh(sub, tuple(mesh.axis_names)),
+            len(keep) / devs.shape[0])
+
+
 def local_device_count() -> int:
     return jax.local_device_count()
 

@@ -123,19 +123,30 @@ def combined_shardings(
     rules: Rules = (),
     fsdp_axis: str = "fsdp",
     min_size: int = 1024,
+    strict: bool = True,
 ) -> Any:
     """TP rules where they match, automatic FSDP everywhere else — the
     standard 3D (dp × fsdp × tp) parameter layout. A leaf matched by a rule
     keeps the rule's spec; unmatched leaves get
     :func:`infer_fsdp_sharding`'s placement (or replication when the mesh
-    has no ``fsdp`` axis)."""
+    has no ``fsdp`` axis).
+
+    ``strict=False`` (the degraded-mode re-derivation,
+    :func:`degraded_shardings`): a rule whose axes no longer divide a
+    dim FALLS BACK to the unmatched path (inferred FSDP, which itself
+    replicates non-divisible leaves) instead of raising."""
     unmatched = object()  # sentinel (None would vanish from the pytree)
 
     def mark(path, leaf):
         p = path_str(path)
         for pat, spec in rules:
             if re.search(pat, p):
-                _check_divisible(leaf, mesh, spec, p)
+                try:
+                    _check_divisible(leaf, mesh, spec, p)
+                except ValueError:
+                    if strict:
+                        raise
+                    return unmatched  # rule no longer fits: fall back
                 return NamedSharding(mesh, spec)
         return unmatched
 
@@ -147,6 +158,28 @@ def combined_shardings(
             lambda _: NamedSharding(mesh, PartitionSpec()), tree)
     return jax.tree_util.tree_map(
         lambda r, f: f if r is unmatched else r, ruled, fsdp)
+
+
+def degraded_shardings(
+    tree: Any,
+    submesh: Mesh,
+    rules: Rules = (),
+    fsdp_axis: str = "fsdp",
+    min_size: int = 1024,
+) -> Any:
+    """Re-derive the parameter layout for a shrunken submesh
+    (degraded-mode groups, docs/design/degraded_mode.md): exactly
+    :func:`combined_shardings` in non-strict mode — a rule or FSDP
+    axis that no longer divides a dim on the shrunken mesh FALLS BACK
+    (rule -> inferred FSDP -> replicated) instead of raising, because
+    partial chip loss must never be fatal when the surviving submesh
+    can still hold the leaf replicated. The fallback costs memory,
+    never correctness: ``device_put`` onto these shardings is the
+    degrade path's re-``pjit`` (jit re-specializes on the new
+    placement at the next step)."""
+    return combined_shardings(tree, submesh, rules=rules,
+                              fsdp_axis=fsdp_axis, min_size=min_size,
+                              strict=False)
 
 
 def batch_spec(mesh: Mesh, data_axes: Sequence[str] = ("dp", "fsdp"),

@@ -427,6 +427,34 @@ class FTTrainer:
             "total": t4 - t0}
         return loss, committed_prev
 
+    def set_placement(self, param_shardings: Any = None,
+                      batch_sharding: Any = None) -> None:
+        """Re-place the live pytrees onto new shardings — the
+        re-``pjit`` of a degraded-mode capacity transition
+        (docs/design/degraded_mode.md): the
+        :class:`~torchft_tpu.degraded.DegradedModeDriver` calls this at
+        the commit boundary with shardings derived for the surviving
+        submesh (degrade) or the full mesh (restore). ``jax.jit``
+        specializes on input shardings, so the next ``train_step``
+        compiles for the new layout with no trainer surgery; optimizer
+        state rides :func:`_on_mesh` (leaves off the target mesh are
+        re-placed replicated — a memory cost, never a correctness one).
+        Call only between steps with nothing in flight (the driver's
+        boundary discipline guarantees it)."""
+        if param_shardings is not None:
+            self.params = jax.device_put(self.params, param_shardings)
+            if self.opt_state is not None:
+                self.opt_state = _on_mesh(self.opt_state,
+                                          param_shardings)
+            if self._has_state:
+                self.model_state = _on_mesh(self.model_state,
+                                            param_shardings)
+            # The fused-vs-split predictor's cached answer predates the
+            # new placement; re-learn it next step.
+            self._predict_single = None
+        if batch_sharding is not None:
+            self._batch_sharding = batch_sharding
+
     def flush(self) -> Optional[bool]:
         """Settle the deferred in-flight step, if any (overlap mode):
         drains its allreduce, casts its vote, applies or drops. Call
