@@ -276,7 +276,10 @@ def bench_multigroup(n_groups: int = 2, steps: int = 20,
                      wire_dtype: Optional[Any] = None,
                      overlap_steps: int = 0,
                      shard_update: bool = False,
-                     tracing: Optional[bool] = None
+                     tracing: Optional[bool] = None,
+                     device_quantize: Optional[bool] = None,
+                     policy: Optional[Any] = None,
+                     hier_hosts: Optional[int] = None
                      ) -> Dict[str, float]:
     """N replica groups as threads, real cross-group gradient traffic.
 
@@ -312,7 +315,17 @@ def bench_multigroup(n_groups: int = 2, steps: int = 20,
     update+allgather+reassembly wall from Manager.metrics()) and
     ``opt_state_mbytes`` shrinks to ~1/n_groups; ``commit_ms_avg``
     (the trainer's commit bucket, covering the optimizer apply + vote
-    in BOTH modes) is the comparable update-stage wall for the A/B."""
+    in BOTH modes) is the comparable update-stage wall for the A/B.
+
+    ``device_quantize`` / ``policy`` thread straight through to the
+    Manager — the ``multigroup_8mb_devquant_ab`` row flips the former
+    and pins the int8 rung with the latter. ``hier_hosts=H`` simulates
+    an H-host deployment on one machine: group i advertises host id
+    ``bh{i % H}``, so the host backend detects co-location and builds
+    the two-level ring (docs/design/hier_transport.md); the result's
+    ``ring_topology`` records what was actually built and
+    ``fetch_mbytes_per_step`` the ACTUAL D2H traffic (wire bytes under
+    device-side quantization, not grad bytes)."""
     from torchft_tpu import (HostCommunicator, Lighthouse, Manager,
                              MeshCommunicator, MeshWorld)
     from torchft_tpu.models import MLP
@@ -322,10 +335,14 @@ def bench_multigroup(n_groups: int = 2, steps: int = 20,
                     join_timeout_ms=2000, quorum_tick_ms=10)
     mesh_world = MeshWorld(num_groups=n_groups, timeout_sec=60)
 
-    def make_comm():
+    def make_comm(i: int):
         if backend == "mesh":
             return MeshCommunicator(mesh_world)
-        return HostCommunicator(timeout_sec=30)
+        if hier_hosts:
+            return HostCommunicator(timeout_sec=30,
+                                    host_id=f"bh{i % hier_hosts}",
+                                    hier=True)
+        return HostCommunicator(timeout_sec=30, hier=False)
     model = MLP(features=(hidden,) * depth, num_classes=10)
     rng = np.random.default_rng(0)
     x = jnp.asarray(rng.normal(size=(64, 64)), jnp.float32)
@@ -342,12 +359,14 @@ def bench_multigroup(n_groups: int = 2, steps: int = 20,
     results: Dict[str, Dict[str, float]] = {}
 
     policy_box: Dict[str, str] = {}
+    topo_box: Dict[str, str] = {}
 
     def worker(gid: str) -> None:
+        gidx = int(gid[1:])
         trainer = FTTrainer(
             loss_fn=loss_fn, tx=optax.sgd(0.05), params=params0,
             manager_factory=lambda load, save: Manager(
-                comm=make_comm(), load_state_dict=load,
+                comm=make_comm(gidx), load_state_dict=load,
                 state_dict=save, min_replica_size=n_groups, replica_id=gid,
                 lighthouse_addr=lh.address(), rank=0, world_size=1,
                 quorum_timeout_ms=30_000,
@@ -356,6 +375,8 @@ def bench_multigroup(n_groups: int = 2, steps: int = 20,
                 overlap_steps=overlap_steps,
                 shard_update=shard_update,
                 tracing=tracing,
+                device_quantize=device_quantize,
+                policy=policy,
             ),
         )
         # Stamp the policy in force so BENCH trajectories are
@@ -364,6 +385,9 @@ def bench_multigroup(n_groups: int = 2, steps: int = 20,
         b = {"x": x, "y": y}
         trainer.train_step(b)  # compile + join + first reconfigure
         m0 = trainer.manager.metrics()
+        lb_fn = getattr(trainer.manager._comm,
+                        "hier_leader_bytes_total", None)
+        lb0 = float(lb_fn()) if lb_fn is not None else 0.0
         t0 = time.perf_counter()
         done = 0
         commit_s = 0.0
@@ -379,6 +403,14 @@ def bench_multigroup(n_groups: int = 2, steps: int = 20,
         _materialize(trainer.params)
         dt = time.perf_counter() - t0
         mx = trainer.manager.metrics()
+        # What the transport ACTUALLY built (resolved at configure,
+        # after co-location detection) — stamped into every row.
+        topo_box[gid] = trainer.manager.metrics_info().get(
+            "ring_topology", "flat")
+        # Leader-ring bytes come straight from the comm (leaders only;
+        # members report 0) — the hier A/B sums them across groups.
+        leader_bytes = ((float(lb_fn()) - lb0)
+                        if lb_fn is not None else 0.0)
 
         def avg_ms(key: str) -> float:
             cnt = max(mx["allreduce_count"] - m0["allreduce_count"], 1)
@@ -398,11 +430,24 @@ def bench_multigroup(n_groups: int = 2, steps: int = 20,
             "put_ms_avg": avg_ms("allreduce_put_ms_total"),
             "wire_mbytes_per_step": avg_ms("allreduce_wire_bytes_total")
             / 1e6,
+            # ACTUAL D2H fetch traffic per step (wire bytes under
+            # device-side quantization — not grad bytes): the number
+            # the fetch-wall optimization is judged by.
+            "fetch_mbytes_per_step":
+                avg_ms("allreduce_d2h_wire_bytes_total") / 1e6,
             # Bytes that crossed the TCP ring (vs D2H above): halved by
             # bf16 wire at 2 groups now that the narrow dtype rides
             # end-to-end.
             "ring_wire_mbytes_per_step":
                 avg_ms("allreduce_ring_wire_bytes_total") / 1e6,
+            # Hierarchical legs (0 on flat): loopback star traffic and
+            # this group's cross-host leader-ring sends. Summed across
+            # groups by the caller — per-group medians would hide that
+            # only leaders carry the cross-host leg.
+            "hier_intra_mbytes_per_step":
+                avg_ms("hier_intra_bytes_total") / 1e6,
+            "hier_leader_mbytes_per_step": leader_bytes / 1e6
+            / max(mx["allreduce_count"] - m0["allreduce_count"], 1),
             # Overlap attribution (0 in sync mode): comm wall hidden
             # behind the next step's compute vs still blocked on at the
             # settle boundary.
@@ -454,6 +499,7 @@ def bench_multigroup(n_groups: int = 2, steps: int = 20,
         "tracing_enabled": (bool(tracing) if tracing is not None
                             else _tracing_default()),
         "policy": next(iter(policy_box.values()), "unknown"),
+        "ring_topology": next(iter(topo_box.values()), "flat"),
         "steps_per_s": med["steps_per_s"],
         "allreduce_ms_avg": med["allreduce_ms_avg"],
         "grad_mbytes": n_params * 4 / 1e6,
@@ -465,7 +511,18 @@ def bench_multigroup(n_groups: int = 2, steps: int = 20,
             "put": med["put_ms_avg"],
         },
         "wire_mbytes_per_step": med["wire_mbytes_per_step"],
+        "fetch_mbytes_per_step": med["fetch_mbytes_per_step"],
         "ring_wire_mbytes_per_step": med["ring_wire_mbytes_per_step"],
+        # Cluster-wide sums (not medians): the hier byte-scaling A/B
+        # compares TOTAL cross-host traffic, and only leaders carry
+        # the leader leg — a median would average leaders with
+        # members' zeros.
+        "ring_wire_mbytes_per_step_total": sum(
+            r["ring_wire_mbytes_per_step"] for r in results.values()),
+        "hier_intra_mbytes_per_step": sum(
+            r["hier_intra_mbytes_per_step"] for r in results.values()),
+        "hier_leader_mbytes_per_step": sum(
+            r["hier_leader_mbytes_per_step"] for r in results.values()),
         "hidden_ms_avg": med["hidden_ms_avg"],
         "drain_wait_ms_avg": med["drain_wait_ms_avg"],
         "commit_ms_avg": med["commit_ms_avg"],
@@ -1782,11 +1839,19 @@ def main() -> None:
     def stages(r: Dict[str, Any]) -> Dict[str, float]:
         return {k: round(v, 1) for k, v in r["stages_ms"].items()}
 
+    def mgrow(r: Dict[str, Any]) -> Dict[str, Any]:
+        """Fields stamped into EVERY multigroup row: the actual D2H
+        fetch bytes (wire bytes, not grad bytes) and the transport
+        topology the run resolved to."""
+        return {"fetch_mbytes_per_step":
+                    round(r["fetch_mbytes_per_step"], 3),
+                "ring_topology": r["ring_topology"]}
+
     mg = bench_multigroup()
     _emit({"metric": "multigroup_steps_per_s",
            "value": round(mg["steps_per_s"], 2), "unit": "steps/s",
            "n_groups": mg["n_groups"], "backend": "host",
-           "policy": mg["policy"],
+           "policy": mg["policy"], **mgrow(mg),
            "allreduce_ms_avg": round(mg["allreduce_ms_avg"], 2),
            "grad_mbytes": round(mg["grad_mbytes"], 2),
            "quorum_ms_p50": round(mg["quorum_ms_p50"], 2),
@@ -1798,7 +1863,7 @@ def main() -> None:
     _emit({"metric": "multigroup_bf16_wire_steps_per_s",
            "value": round(mw["steps_per_s"], 2), "unit": "steps/s",
            "n_groups": mw["n_groups"], "backend": "host+bf16wire",
-           "policy": mw["policy"],
+           "policy": mw["policy"], **mgrow(mw),
            "allreduce_ms_avg": round(mw["allreduce_ms_avg"], 2),
            "speedup_vs_exact": round(mw["steps_per_s"]
                                      / max(mg["steps_per_s"], 1e-9), 2),
@@ -1814,7 +1879,7 @@ def main() -> None:
     m1 = bench_multigroup(bucket_bytes=1 << 40, **big)  # single-shot
     mb = bench_multigroup(bucket_bytes=2 << 20, **big)  # pipelined buckets
     _emit({"metric": "multigroup_8mb_ab",
-           "policy": mb["policy"],
+           "policy": mb["policy"], **mgrow(mb),
            "grad_mbytes": round(mb["grad_mbytes"], 2),
            "single_shot_steps_per_s": round(m1["steps_per_s"], 3),
            "bucketed_steps_per_s": round(mb["steps_per_s"], 3),
@@ -1826,7 +1891,7 @@ def main() -> None:
                            wire_dtype=jnp.bfloat16, **big)
     _emit({"metric": "multigroup_8mb_bf16_wire",
            "value": round(mwb["steps_per_s"], 3), "unit": "steps/s",
-           "policy": mwb["policy"],
+           "policy": mwb["policy"], **mgrow(mwb),
            "speedup_vs_exact": round(
                mwb["steps_per_s"] / max(mb["steps_per_s"], 1e-9), 2),
            "wire_mbytes_per_step": round(mwb["wire_mbytes_per_step"], 2),
@@ -1851,6 +1916,7 @@ def main() -> None:
 
     _emit({"metric": "multigroup_8mb_overlap_ab",
            "sync_policy": mb["policy"], "overlap_policy": mov["policy"],
+           **mgrow(mov),
            "grad_mbytes": round(mov["grad_mbytes"], 2),
            "sync_steps_per_s": round(mb["steps_per_s"], 3),
            "overlap_steps_per_s": round(mov["steps_per_s"], 3),
@@ -1871,7 +1937,7 @@ def main() -> None:
     mtr_off = bench_multigroup(bucket_bytes=2 << 20, tracing=False,
                                **big)
     _emit({"metric": "multigroup_8mb_trace_ab",
-           "policy": mtr_on["policy"],
+           "policy": mtr_on["policy"], **mgrow(mtr_on),
            "grad_mbytes": round(mtr_on["grad_mbytes"], 2),
            "trace_on_steps_per_s": round(mtr_on["steps_per_s"], 3),
            "trace_off_steps_per_s": round(mtr_off["steps_per_s"], 3),
@@ -1890,7 +1956,7 @@ def main() -> None:
     # (less fold compute; comparable ring bytes at world 2).
     mrs = bench_multigroup(bucket_bytes=2 << 20, shard_update=True, **big)
     _emit({"metric": "multigroup_8mb_rs_ab",
-           "policy": mrs["policy"],
+           "policy": mrs["policy"], **mgrow(mrs),
            "grad_mbytes": round(mrs["grad_mbytes"], 2),
            "allreduce_steps_per_s": round(mb["steps_per_s"], 3),
            "rs_steps_per_s": round(mrs["steps_per_s"], 3),
@@ -1909,6 +1975,93 @@ def main() -> None:
            "allreduce_opt_state_mbytes":
                round(mb["opt_state_mbytes"], 2),
            "rs_opt_state_mbytes": round(mrs["opt_state_mbytes"], 2)})
+
+    # Device-side wire quantization A/B (ROADMAP item 2, docs/design/
+    # hier_transport.md): the same comm-bound 8MB scenario with the
+    # quantize/cast fused into the device pack (D2H moves WIRE bytes)
+    # vs host-side (D2H moves full-precision bytes, quantize/cast on
+    # the host). Two rungs: bf16 wire (2x fetch bytes host-side) and
+    # the int8+EF policy rung (4x). Gate: device fetch-stage ms <=
+    # 0.6x host-side at 8MB; results are bitwise identical across the
+    # legs (the parity tests/test_transport.py freezes).
+    from torchft_tpu import policy as _pol
+    int8_policy = next(p for p in _pol.LADDER if p.name == "sync-int8")
+    legs = {}
+    for dq in (True, False):
+        legs[("bf16", dq)] = bench_multigroup(
+            bucket_bytes=2 << 20, wire_dtype=jnp.bfloat16,
+            device_quantize=dq, **big)
+        legs[("int8", dq)] = bench_multigroup(
+            bucket_bytes=2 << 20, policy=int8_policy,
+            device_quantize=dq, **big)
+
+    def dq_fields(rung: str) -> Dict[str, Any]:
+        dev, host = legs[(rung, True)], legs[(rung, False)]
+        dev_f = dev["stages_ms"]["fetch"]
+        host_f = host["stages_ms"]["fetch"]
+        return {
+            f"{rung}_dev_fetch_ms_avg": round(dev_f, 2),
+            f"{rung}_host_fetch_ms_avg": round(host_f, 2),
+            f"{rung}_fetch_ms_ratio": round(
+                dev_f / max(host_f, 1e-9), 3),
+            f"{rung}_dev_fetch_mbytes_per_step": round(
+                dev["fetch_mbytes_per_step"], 3),
+            f"{rung}_host_fetch_mbytes_per_step": round(
+                host["fetch_mbytes_per_step"], 3),
+            f"{rung}_dev_steps_per_s": round(dev["steps_per_s"], 3),
+            f"{rung}_host_steps_per_s": round(host["steps_per_s"], 3),
+        }
+
+    _emit({"metric": "multigroup_8mb_devquant_ab",
+           "grad_mbytes": round(
+               legs[("bf16", True)]["grad_mbytes"], 2),
+           "target_fetch_ms_ratio": 0.6,
+           **mgrow(legs[("int8", True)]),
+           **dq_fields("bf16"), **dq_fields("int8"),
+           # Is the fetch stage still the majority of the host step?
+           "int8_dev_fetch_frac_of_step": round(
+               legs[("int8", True)]["stages_ms"]["fetch"]
+               / max(1e3 / max(legs[("int8", True)]["steps_per_s"],
+                               1e-9), 1e-9), 3)})
+
+    # Flat vs hierarchical transport A/B (docs/design/
+    # hier_transport.md): 4 groups as 2 simulated hosts x 2 co-located
+    # ranks. The hier leg's cross-host (leader-ring) bytes must scale
+    # with hosts, not groups: <= 1/per_host of the flat ring bytes at
+    # 2x2 (measured: hosts*(hosts-1)*per_host vs n*(n-1) raw-buffer
+    # sends), with bitwise-identical results (fold order unchanged;
+    # frozen by tests/test_transport.py).
+    hier_cfg = dict(n_groups=4, steps=4, hidden=1024, depth=3,
+                    bucket_bytes=2 << 20, wire_dtype=jnp.bfloat16)
+    mflat = bench_multigroup(**hier_cfg)
+    mhier = bench_multigroup(hier_hosts=2, **hier_cfg)
+    _emit({"metric": "multigroup_8mb_hier_ab",
+           "policy": mhier["policy"],
+           "flat_ring_topology": mflat["ring_topology"],
+           "hier_ring_topology": mhier["ring_topology"],
+           "fetch_mbytes_per_step": round(
+               mhier["fetch_mbytes_per_step"], 3),
+           "ring_topology": mhier["ring_topology"],
+           "flat_steps_per_s": round(mflat["steps_per_s"], 3),
+           "hier_steps_per_s": round(mhier["steps_per_s"], 3),
+           "hier_speedup": round(
+               mhier["steps_per_s"] / max(mflat["steps_per_s"], 1e-9),
+               2),
+           # Cross-host bytes, summed across groups: the flat leg's
+           # ring bytes ALL cross hosts; the hier leg's leader-ring
+           # slice is the cross-host traffic (intra-host star bytes
+           # are loopback).
+           "flat_ring_wire_mbytes_per_step": round(
+               mflat["ring_wire_mbytes_per_step_total"], 2),
+           "hier_leader_mbytes_per_step": round(
+               mhier["hier_leader_mbytes_per_step"], 2),
+           "hier_intra_mbytes_per_step": round(
+               mhier["hier_intra_mbytes_per_step"], 2),
+           "cross_host_bytes_ratio": round(
+               mhier["hier_leader_mbytes_per_step"]
+               / max(mflat["ring_wire_mbytes_per_step_total"], 1e-9),
+               3),
+           "target_cross_host_bytes_ratio": 0.5})
 
     # Degraded-mode goodput A/B (docs/design/degraded_mode.md): one
     # group loses half its capacity mid-run and keeps contributing at
@@ -1979,7 +2132,7 @@ def main() -> None:
     _emit({"metric": "multigroup_mesh_steps_per_s",
            "value": round(mm["steps_per_s"], 2), "unit": "steps/s",
            "n_groups": mm["n_groups"], "backend": "mesh",
-           "policy": mm["policy"],
+           "policy": mm["policy"], **mgrow(mm),
            "allreduce_ms_avg": round(mm["allreduce_ms_avg"], 2),
            "speedup_vs_host": round(mm["steps_per_s"]
                                     / max(mg["steps_per_s"], 1e-9), 2)})

@@ -153,6 +153,25 @@ if [[ "${1:-}" == "degrade" ]]; then
     exit 0
 fi
 
+# Transport tier: the data-plane transport's focused gate
+# (docs/design/hier_transport.md) — the power-of-two int8 quantizer's
+# device/host bitwise parity (payloads + error-feedback residual
+# trajectories), the Manager-level device-vs-host quantize A/B (~1/4
+# D2H bytes, identical results), the schedule-fingerprint residual-
+# migration guard, and the hierarchical two-level ring's socketpair
+# battery (exact/bf16/int8/weighted bitwise vs the flat ring,
+# leader-death latch, skew aborts, leader-leg byte scaling). Tier-1
+# too (not marked slow); run this tier on host/communicator/manager
+# fetch-path changes. The 4-group hier chaos soak (leader kill mid-op
+# must recover like a ring reset) is marked nightly+slow and rides
+# the nightly tier.
+if [[ "${1:-}" == "transport" ]]; then
+    stage transport env JAX_PLATFORMS=cpu \
+        python -m pytest tests/test_transport.py -q -m "transport and not slow"
+    echo "== total: ${SECONDS}s"
+    exit 0
+fi
+
 # Obs tier: the observability tier's focused gate
 # (docs/design/observability.md) — span-ring bounds/context, the
 # flight recorder's triggers (vote abort, latched comm error, heal

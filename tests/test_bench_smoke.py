@@ -113,6 +113,44 @@ class TestAllreduceABSmoke:
         assert on["steps_per_s"] > 0
         assert off["steps_per_s"] > 0
 
+    def test_devquant_ab_smoke(self):
+        """Device-vs-host wire-quantization A/B plumbing at tiny size
+        (docs/design/hier_transport.md): the int8-policy device leg
+        fetches the wire payload (~1/4 of the host leg's f32 D2H) and
+        both legs report the fetch accounting the
+        multigroup_8mb_devquant_ab row is built from. (The 0.6x
+        fetch-ms gate is the bench row's — smoke sizes are
+        dispatch-bound noise; the BITWISE identity of the two legs is
+        frozen native-free in tests/test_transport.py.)"""
+        from torchft_tpu import policy as policy_mod
+
+        int8 = next(p for p in policy_mod.LADDER
+                    if p.name == "sync-int8")
+        dev = self._mg(steps=2, policy=int8, device_quantize=True)
+        host = self._mg(steps=2, policy=int8, device_quantize=False)
+        assert dev["steps_per_s"] > 0 and host["steps_per_s"] > 0
+        assert 0 < dev["fetch_mbytes_per_step"] \
+            < 0.3 * host["fetch_mbytes_per_step"], (dev, host)
+        assert dev["ring_topology"] == "flat"
+
+    def test_hier_ab_smoke(self):
+        """Flat vs hierarchical transport A/B plumbing at tiny size: 4
+        groups as 2 simulated hosts x 2 build the two-level ring
+        (topology string reports it), results stay byte-accounted per
+        leg, and the cross-host (leader) bytes land under the flat
+        ring's total — the scaling gate the multigroup_8mb_hier_ab row
+        asserts at 8MB. (Bitwise flat-vs-hier identity is frozen
+        native-free in tests/test_transport.py.)"""
+        flat = self._mg(n_groups=4, steps=2)
+        hier = self._mg(n_groups=4, steps=2, hier_hosts=2)
+        assert flat["ring_topology"] == "flat"
+        assert hier["ring_topology"] == "hier:2x2"
+        assert hier["steps_per_s"] > 0
+        assert hier["hier_intra_mbytes_per_step"] > 0
+        assert hier["hier_leader_mbytes_per_step"] > 0
+        assert hier["hier_leader_mbytes_per_step"] <= \
+            flat["ring_wire_mbytes_per_step_total"] / 2, (flat, hier)
+
     def test_chaos_short_read_on_wire_ring(self):
         """A seeded short-read fault injected into the ring's data plane
         lands mid-collective in the wire path's segment upcast loop; the

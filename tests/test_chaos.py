@@ -552,7 +552,23 @@ class TestChaosSoak:
         identical with zero lost or duplicated commits."""
         self._soak(overlap_steps=1)
 
-    def _soak(self, overlap_steps: int):
+    def test_soak_hier_leader_kill(self):
+        """The hierarchical round (docs/design/hier_transport.md): 4
+        groups as 2 simulated hosts x 2 co-located ranks run the same
+        seeded chaos soak over the two-level ring, PLUS a hard leader
+        kill mid-run (its star + leader-ring sockets dropped mid-op).
+        A dead leader must latch a clean CommunicatorError and recover
+        through the identical poison -> recovery-rendezvous ->
+        re-election path as a flat ring reset: every group finishes
+        every step, params bitwise identical, zero lost or duplicated
+        commits."""
+        results = self._soak(overlap_steps=0, n_groups=4,
+                             hier_hosts=2, leader_kill_at=8)
+        topos = [r.get("ring_topology", "") for r in results]
+        assert any(t.startswith("hier:") for t in topos), topos
+
+    def _soak(self, overlap_steps: int, n_groups: int = 2,
+              hier_hosts=None, leader_kill_at=None):
         import jax
         import jax.numpy as jnp
         import optax
@@ -585,6 +601,17 @@ class TestChaosSoak:
                 logits, batch["y"]).mean()
 
         progress = {}  # group -> latest step (read by the main thread)
+        host_comms = {}  # group -> HostCommunicator (leader-kill hook)
+
+        def make_host_comm(group: int) -> HostCommunicator:
+            if hier_hosts:
+                hc = HostCommunicator(
+                    timeout_sec=15, hier=True,
+                    host_id=f"soakh{group % hier_hosts}")
+            else:
+                hc = HostCommunicator(timeout_sec=15)
+            host_comms[group] = hc
+            return hc
 
         def run_group(group: int):
             params = model.init(jax.random.key(42), jnp.zeros((1, 8)))
@@ -594,8 +621,7 @@ class TestChaosSoak:
                     # schedule=None: the shim reads chaos.active() per
                     # op, so the main thread's uninstall() at the drain
                     # boundary silences this path too.
-                    comm=ChaosCommunicator(
-                        HostCommunicator(timeout_sec=15)),
+                    comm=ChaosCommunicator(make_host_comm(group)),
                     load_state_dict=load, state_dict=save,
                     min_replica_size=1, replica_id=f"chaos{group}",
                     lighthouse_addr=lh.address(), rank=0, world_size=1,
@@ -644,28 +670,49 @@ class TestChaosSoak:
                         trainer.manager.batches_committed(),
                     "commits": commits,
                     "metrics": trainer.manager.metrics(),
+                    "ring_topology": trainer.manager.metrics_info()
+                    .get("ring_topology", "flat"),
                 }
             finally:
                 trainer.shutdown()
 
+        killed = [False]
         try:
-            with ThreadPoolExecutor(max_workers=2) as pool:
-                futs = [pool.submit(run_group, g) for g in range(2)]
+            with ThreadPoolExecutor(max_workers=n_groups) as pool:
+                futs = [pool.submit(run_group, g)
+                        for g in range(n_groups)]
                 # Drain boundary: once every group is past `chaos_until`,
                 # stop injecting and let the tail converge cleanly.
                 deadline = time.monotonic() + 480
-                while not (len(progress) == 2 and all(
+                while not (len(progress) == n_groups and all(
                         s >= chaos_until for s in progress.values())):
                     if time.monotonic() > deadline:
                         break  # let result() surface the real failure
                     if any(f.done() and f.exception() for f in futs):
                         break
+                    # The leader kill: once every group is past the
+                    # kill step, drop one elected leader's hier sockets
+                    # mid-flight — the next wire op on any survivor
+                    # latches a CommunicatorError and the recovery
+                    # rendezvous must rebuild + re-elect.
+                    if (leader_kill_at is not None and not killed[0]
+                            and len(progress) == n_groups
+                            and all(s >= leader_kill_at
+                                    for s in progress.values())):
+                        for hc in host_comms.values():
+                            topo = hc._hier
+                            if topo is not None and topo.is_leader:
+                                topo.close()
+                                killed[0] = True
+                                break
                     time.sleep(0.25)
                 chaos.uninstall()
                 results = [f.result(timeout=600) for f in futs]
         finally:
             chaos.uninstall()
             lh.shutdown()
+        if leader_kill_at is not None:
+            assert killed[0], "leader kill never fired"
 
         # Everyone finished every step under sustained disruption.
         assert all(r["step"] == total_steps for r in results), results
@@ -679,11 +726,12 @@ class TestChaosSoak:
         # Zero lost commits: batches_committed consistent across
         # survivors, and params bitwise identical (a lost commit on one
         # side would diverge both).
-        assert (results[0]["batches_committed"]
-                == results[1]["batches_committed"]), results
-        jax.tree_util.tree_map(
-            lambda a, b_: np.testing.assert_array_equal(a, b_),
-            results[0]["params"], results[1]["params"])
+        for r in results[1:]:
+            assert (results[0]["batches_committed"]
+                    == r["batches_committed"]), results
+            jax.tree_util.tree_map(
+                lambda a, b_: np.testing.assert_array_equal(a, b_),
+                results[0]["params"], r["params"])
 
         # Chaos genuinely fired into the transports...
         trace = schedule.trace()
@@ -702,6 +750,7 @@ class TestChaosSoak:
         for d in trace:
             replay.decide(d.endpoint, d.op)
         assert replay.trace() == trace
+        return results
 
 
 @pytest.mark.slow

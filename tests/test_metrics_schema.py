@@ -51,6 +51,10 @@ DOCUMENTED_KEYS = frozenset([
     "allreduce_put_ms_total", "allreduce_wire_bytes_total",
     "allreduce_ring_wire_bytes_total",
     "allreduce_pack_cache_misses", "allreduce_d2h_async_fallbacks",
+    # D2H fetch accounting + hierarchical transport legs
+    # (docs/design/hier_transport.md)
+    "allreduce_d2h_wire_bytes_total",
+    "hier_intra_bytes_total", "hier_leader",
     # cross-step overlap engine
     "allreduce_hidden_ms_total", "allreduce_drain_wait_ms_total",
     "allreduce_inflight", "overlap_steps_deferred",
@@ -87,7 +91,7 @@ DOCUMENTED_KEYS = frozenset([
 # no per-key carve-outs.
 DOCUMENTED_INFO_KEYS = frozenset([
     "policy_name", "policy_last_reason", "ckpt_last_error",
-    "flight_last_path",
+    "flight_last_path", "ring_topology",
 ])
 
 # Span context tags every exported trace event must carry (the fleet

@@ -23,12 +23,14 @@ Design notes:
 from __future__ import annotations
 
 import logging
+import os
 import queue
 import socket
 import struct
 import threading
+import time
 from concurrent.futures import Future
-from typing import Any, List, Optional, Sequence, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 import jax
@@ -71,6 +73,75 @@ def _recv_exact_into(sock: socket.socket, view: memoryview) -> None:
 # before the full chunk has crossed the wire; a power of two so every
 # segment boundary is element-aligned for any power-of-two itemsize.
 _SEG_BYTES = 1 << 18  # 256 KB
+
+
+class _StoreLookupError(RuntimeError):
+    """Peer-address lookup failed during a ring/star rendezvous.
+    Deliberately NOT retried by the outer dial loop: the StoreClient
+    already applied its own retry policy (and chaos-injected store
+    faults surface type-unchanged as ConnectionError after it gives
+    up), so outer retries would compound the layers into
+    max_attempts^2 worst-case stalls on the quorum thread."""
+
+
+def _dial_transient(e: BaseException) -> bool:
+    """Outer dial retries cover the socket dial + handshake only —
+    OSError spans the whole dial-failure family (refused, reset, timed
+    out, no-route-to-host, DNS via socket.gaierror), and
+    CommunicatorError covers the handshake (short read / stale-acceptor
+    ACK mismatch). Never the store lookup (see _StoreLookupError, a
+    plain RuntimeError)."""
+    return isinstance(e, (OSError, CommunicatorError))
+
+
+class _HierTopo:
+    """One configure epoch's resolved two-level topology
+    (docs/design/hier_transport.md). ``hosts`` is the canonical host
+    map — member-rank lists sorted within each host, hosts ordered by
+    their min rank — identical on every rank (it is derived from the
+    same store keys), so leader election (``hosts[i][0]``) and bundle
+    geometry need no extra coordination. Leaders hold the cross-host
+    ring (a :class:`_Ring`) plus one accepted socket per local member;
+    members hold a single ``up_sock`` to their leader."""
+
+    __slots__ = ("hosts", "rank", "my_host", "members", "leader",
+                 "is_leader", "leader_ring", "member_socks", "up_sock",
+                 "listener")
+
+    def __init__(self, hosts: List[List[int]], rank: int,
+                 leader_ring: Optional[_Ring] = None,
+                 member_socks: Optional[Dict[int, socket.socket]] = None,
+                 up_sock: Optional[socket.socket] = None,
+                 listener: Optional[socket.socket] = None) -> None:
+        self.hosts = hosts
+        self.rank = rank
+        self.my_host = next(i for i, ms in enumerate(hosts)
+                            if rank in ms)
+        self.members = hosts[self.my_host]
+        self.leader = self.members[0]
+        self.is_leader = rank == self.leader
+        self.leader_ring = leader_ring
+        self.member_socks = member_socks or {}
+        self.up_sock = up_sock
+        self.listener = listener
+
+    def close(self) -> None:
+        socks = list(self.member_socks.values())
+        if self.up_sock is not None:
+            socks.append(self.up_sock)
+        if self.listener is not None:
+            socks.append(self.listener)
+        for s in socks:
+            try:
+                s.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                s.close()
+            except OSError:
+                pass
+        if self.leader_ring is not None:
+            self.leader_ring.close()
 
 
 def _as_bytes(arr: np.ndarray) -> memoryview:
@@ -151,11 +222,33 @@ class HostCommunicator(Communicator):
 
     def __init__(self, timeout_sec: float = 60.0,
                  retry_policy: Optional[RetryPolicy] = None,
-                 retry_stats: Optional[RetryStats] = None) -> None:
+                 retry_stats: Optional[RetryStats] = None,
+                 host_id: Optional[str] = None,
+                 hier: Optional[bool] = None) -> None:
         self._timeout = timeout_sec
         self._retry_policy = (retry_policy if retry_policy is not None
                               else RetryPolicy())
         self._retry_stats = retry_stats
+        # Topology-aware hierarchical transport
+        # (docs/design/hier_transport.md): each rank advertises a host
+        # id at rendezvous; when >= 2 hosts exist and any host holds
+        # >= 2 co-located ranks, wire ops route over a two-level ring
+        # (intra-host star to an elected leader + a cross-host leader
+        # ring) instead of the flat ring. ``host_id`` overrides the
+        # advertised id (benches/tests simulating hosts in-process;
+        # default env TORCHFT_HOST_ID, else this machine's advertised
+        # hostname). ``hier`` force-enables/disables (default env
+        # TORCHFT_HIER, on); the flag rides the allreduce-config
+        # fingerprint so mixed flat/hier launches die at rendezvous.
+        self._host_id = host_id
+        self._hier_opt = hier
+        self._hier: Optional[_HierTopo] = None
+        # Send-site byte counters of the two hierarchical legs: intra =
+        # loopback star traffic (member->leader + leader->members),
+        # leader = the cross-host leader-ring slice of _ring_bytes —
+        # the bytes the hierarchy exists to shrink.
+        self._hier_intra_bytes = 0.0
+        self._hier_leader_bytes = 0.0
 
         self._rank = 0
         self._world = 1
@@ -186,20 +279,48 @@ class HostCommunicator(Communicator):
 
     # ------------------------------------------------------------ configure
 
+    def _hier_flag(self) -> bool:
+        """Static hierarchical-transport opt-in: the constructor arg
+        wins; default env ``TORCHFT_HIER`` (on). A True flag only ARMS
+        the detection — the two-level ring is built when the advertised
+        host map actually shows >= 2 hosts with co-located ranks, so
+        single-host rigs (every local test/bench) stay flat."""
+        if self._hier_opt is not None:
+            return bool(self._hier_opt)
+        return os.environ.get("TORCHFT_HIER", "1").strip().lower() \
+            not in ("0", "false")
+
+    def _effective_host_id(self) -> str:
+        return (self._host_id
+                or os.environ.get("TORCHFT_HOST_ID", "").strip()
+                or advertise_host())
+
     def configure(self, store_addr: str, rank: int, world_size: int) -> None:
-        """Rebuild the ring for a new (rank, world_size).
+        """Rebuild the ring(s) for a new (rank, world_size).
 
         ``store_addr`` is ``"host:port/prefix..."``; each rank publishes its
         fresh listener under ``{prefix}/{rank}`` and dials its successor.
         In-flight collectives from the previous epoch are aborted by closing
         their sockets (reference abort-then-rebuild,
-        ``process_group.py:203-218``)."""
+        ``process_group.py:203-218``).
+
+        Each rank also advertises its host id under ``{prefix}/host/...``;
+        when the resulting map shows co-located ranks across >= 2 hosts
+        (and :meth:`_hier_flag` is armed), a second, two-level transport
+        is built for the wire ops (docs/design/hier_transport.md): an
+        intra-host star to the host's min-rank leader plus a cross-host
+        ring among leaders — rebuilt per epoch exactly like the flat
+        ring, so leader death recovers through the same
+        poison-and-re-rendezvous path as any ring reset."""
         with self._lock:
             old, self._ring = self._ring, None
+            old_hier, self._hier = self._hier, None
             self._epoch += 1
             epoch = self._epoch
         if old is not None:
             old.close()
+        if old_hier is not None:
+            old_hier.close()
         # Fail anything still queued from the old epoch.
         self._drain_queue("aborted by reconfigure")
 
@@ -220,8 +341,12 @@ class HostCommunicator(Communicator):
         # rank's fingerprint and compare against rank 0's over the store
         # we're already connected to — a mismatch is a launch bug, so fail
         # loudly now instead of degenerating into timeout/abort loops.
+        # The hier flag is appended here (not by the Manager, which is
+        # topology-agnostic): a flat rank and a hier rank would run
+        # DIFFERENT transports for the same op and wedge mid-collective.
         fp = getattr(self, "allreduce_config_fingerprint", None)
         if fp is not None:
+            fp = f"{fp};hier={int(self._hier_flag())}"
             tmo = int(self._timeout * 1000)
             store.set(f"{prefix}/arcfg/{rank}", fp.encode())
 
@@ -253,23 +378,69 @@ class HostCommunicator(Communicator):
                 if anchor != fp:
                     raise skew("replica rank 0", anchor)
 
+        # Advertise this rank's host id BEFORE the flat ring forms: the
+        # flat rendezvous is a barrier (every rank published its keys by
+        # the time it completes), so the host map is fully readable by
+        # the hier build that follows it.
+        if self._hier_flag():
+            store.set(f"{prefix}/host/{rank}",
+                      self._effective_host_id().encode())
+
+        next_sock, prev_sock, listener = self._ring_rendezvous(
+            store, prefix, "", rank, world_size)
+
+        topo: Optional[_HierTopo] = None
+        if self._hier_flag():
+            try:
+                topo = self._build_hier(store, prefix, rank, world_size)
+            except BaseException:
+                next_sock.close()
+                prev_sock.close()
+                listener.close()
+                raise
+
+        with self._lock:
+            if self._epoch != epoch:  # raced with another configure
+                next_sock.close()
+                prev_sock.close()
+                listener.close()
+                if topo is not None:
+                    topo.close()
+                return
+            # Chaos wrapping AFTER the epoch handshake: rendezvous stays
+            # clean (a fault there is just a failed configure), the data
+            # plane — every ring collective byte — is injectable.
+            self._ring = _Ring(
+                chaos.wrap_socket(next_sock, "ring"),
+                chaos.wrap_socket(prev_sock, "ring"),
+                listener)
+            self._hier = topo
+        logger.info("host communicator configured: rank=%d world=%d "
+                    "topology=%s (%s)", rank, world_size,
+                    self.ring_topology(), prefix)
+
+    def _ring_rendezvous(self, store: StoreClient, prefix: str, ns: str,
+                         pos: int, ring_world: int
+                         ) -> Tuple[socket.socket, socket.socket,
+                                    socket.socket]:
+        """One ring's store rendezvous among ``ring_world`` members
+        ordered by ``pos`` under key namespace ``{prefix}{ns}``: publish
+        a fresh listener at ``{prefix}{ns}/{pos}``, dial the successor
+        (with address re-reads per attempt), accept the predecessor —
+        the flat ring's battle-tested dial/accept protocol, factored out
+        so the hierarchical leader ring builds through the IDENTICAL
+        code path. Returns raw (not chaos-wrapped) ``(next, prev,
+        listener)`` sockets."""
+        hs_key = epoch_key(prefix + ns)
         listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         listener.bind(("0.0.0.0", 0))
         listener.listen(4)
         listener.settimeout(self._timeout)
         my_addr = f"{advertise_host()}:{listener.getsockname()[1]}"
-        store.set(f"{prefix}/{rank}", my_addr.encode())
+        store.set(f"{prefix}{ns}/{pos}", my_addr.encode())
 
-        next_rank = (rank + 1) % world_size
-
-        class _StoreLookupError(RuntimeError):
-            """Successor-address lookup failed. Deliberately NOT retried
-            by the outer dial loop: the StoreClient already applied its
-            own retry policy (and chaos-injected store faults surface
-            type-unchanged as ConnectionError after it gives up), so
-            outer retries would compound the layers into
-            max_attempts^2 worst-case stalls on the quorum thread."""
+        next_pos = (pos + 1) % ring_world
 
         # Retried dial, re-reading the successor's address each attempt:
         # besides riding out a transient reset mid-handshake, this heals
@@ -283,7 +454,7 @@ class HostCommunicator(Communicator):
         def dial() -> socket.socket:
             try:
                 next_addr = store.get(
-                    f"{prefix}/{next_rank}",
+                    f"{prefix}{ns}/{next_pos}",
                     timeout_ms=int(self._timeout * 1000)).decode()
             except Exception as e:  # KeyboardInterrupt must propagate
                 raise _StoreLookupError(
@@ -296,7 +467,7 @@ class HostCommunicator(Communicator):
                 s.settimeout(self._timeout)
                 # Identify ourselves so the acceptor can reject stale
                 # dialers...
-                _send_all(s, struct.pack("<qq", epoch_key(prefix), rank))
+                _send_all(s, struct.pack("<qq", hs_key, pos))
                 # ...and require its ACK so WE reject stale acceptors: a
                 # connect into the accept backlog of an abandoned
                 # listener from an earlier same-prefix attempt succeeds
@@ -305,22 +476,13 @@ class HostCommunicator(Communicator):
                 # key (its eventual listener close RSTs us instead,
                 # failing this read and triggering a re-read-and-redial).
                 ack = struct.unpack("<q", bytes(_recv_exact(s, 8)))[0]
-                if ack != epoch_key(prefix):
+                if ack != hs_key:
                     raise CommunicatorError(
                         "ring handshake ack mismatch (stale peer?)")
                 return s
             except BaseException:
                 s.close()
                 raise
-
-        # Outer retries cover the socket dial + handshake only —
-        # OSError spans the whole dial-failure family (refused, reset,
-        # timed out, no-route-to-host, DNS via socket.gaierror), and
-        # CommunicatorError covers the handshake (short read /
-        # stale-acceptor ACK mismatch). Never the store lookup (see
-        # _StoreLookupError, a plain RuntimeError).
-        def dial_transient(e: BaseException) -> bool:
-            return isinstance(e, (OSError, CommunicatorError))
 
         # The accept loop runs CONCURRENTLY with the dial: each rank's
         # dial blocks on its successor's ACK, and that ACK is sent by the
@@ -348,10 +510,10 @@ class HostCommunicator(Communicator):
                     cand.settimeout(self._timeout)
                     cand.setsockopt(socket.IPPROTO_TCP,
                                     socket.TCP_NODELAY, 1)
-                    key, peer_rank = struct.unpack(
+                    key, peer_pos = struct.unpack(
                         "<qq", bytes(_recv_exact(cand, 16)))
-                    if key != epoch_key(prefix) or peer_rank != (
-                            rank - 1) % world_size:
+                    if key != hs_key or peer_pos != (
+                            pos - 1) % ring_world:
                         cand.close()
                         continue
                     # Publish under the lock BEFORE ACKing: ACK-first
@@ -397,7 +559,7 @@ class HostCommunicator(Communicator):
         next_sock = None
         try:
             next_sock = call_with_retry(
-                dial, self._retry_policy, classify=dial_transient,
+                dial, self._retry_policy, classify=_dial_transient,
                 stats=self._retry_stats, op="ring.connect")
             have_prev.wait(timeout=self._timeout)
             with box_lock:
@@ -420,22 +582,121 @@ class HostCommunicator(Communicator):
                 next_sock.close()
             listener.close()  # unblocks the acceptor thread too
             raise
+        return next_sock, prev_sock, listener
 
-        with self._lock:
-            if self._epoch != epoch:  # raced with another configure
-                next_sock.close()
-                prev_sock.close()
-                listener.close()
-                return
-            # Chaos wrapping AFTER the epoch handshake: rendezvous stays
-            # clean (a fault there is just a failed configure), the data
-            # plane — every ring collective byte — is injectable.
-            self._ring = _Ring(
-                chaos.wrap_socket(next_sock, "ring"),
-                chaos.wrap_socket(prev_sock, "ring"),
-                listener)
-        logger.info("host communicator configured: rank=%d world=%d (%s)",
-                    rank, world_size, prefix)
+    def _build_hier(self, store: StoreClient, prefix: str, rank: int,
+                    world: int) -> Optional["_HierTopo"]:
+        """Resolve the advertised host map and, when it shows real
+        co-location across >= 2 hosts, build the two-level transport:
+        members dial their host's min-rank leader (a star — gather +
+        broadcast is its natural shape), leaders form a cross-host ring
+        through :meth:`_ring_rendezvous` under the ``/hl`` namespace.
+        Returns ``None`` (stay flat) when the map shows no co-location
+        — and also for a single all-co-located host, where the flat
+        ring is already loopback end to end and the hierarchy would
+        only add hops."""
+        tmo = int(self._timeout * 1000)
+        # world sequential store reads on the quorum thread; every key
+        # was published before the flat-ring barrier completed, so each
+        # is one immediate RTT (~world x store-RTT per reconfigure —
+        # linear like the rest of the rendezvous; batch here first if a
+        # very-large-world profile ever shows configure store-bound).
+        ids = [store.get(f"{prefix}/host/{r}", timeout_ms=tmo).decode()
+               for r in range(world)]
+        by_host: Dict[str, List[int]] = {}
+        for r, h in enumerate(ids):
+            by_host.setdefault(h, []).append(r)
+        hosts = sorted((sorted(ms) for ms in by_host.values()),
+                       key=lambda ms: ms[0])
+        if len(hosts) < 2 or max(len(ms) for ms in hosts) < 2:
+            return None
+        my_host = next(i for i, ms in enumerate(hosts) if rank in ms)
+        members = hosts[my_host]
+        leader = members[0]
+        hs = epoch_key(prefix + "/hh")
+        if rank != leader:
+            def dial() -> socket.socket:
+                try:
+                    addr = store.get(f"{prefix}/hh/{leader}",
+                                     timeout_ms=tmo).decode()
+                except Exception as e:
+                    raise _StoreLookupError(
+                        f"leader address lookup failed: {e}") from e
+                lhost, _, lport = addr.rpartition(":")
+                s = socket.create_connection((lhost, int(lport)),
+                                             timeout=self._timeout)
+                try:
+                    s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY,
+                                 1)
+                    s.settimeout(self._timeout)
+                    _send_all(s, struct.pack("<qq", hs, rank))
+                    ack = struct.unpack(
+                        "<q", bytes(_recv_exact(s, 8)))[0]
+                    if ack != hs:
+                        raise CommunicatorError(
+                            "hier star handshake ack mismatch")
+                    return s
+                except BaseException:
+                    s.close()
+                    raise
+
+            up = call_with_retry(
+                dial, self._retry_policy, classify=_dial_transient,
+                stats=self._retry_stats, op="hier.star.connect")
+            return _HierTopo(hosts, rank,
+                             up_sock=chaos.wrap_socket(up, "ring"))
+
+        # Leader: star listener published FIRST so members can dial (and
+        # park in the accept backlog) while the leader ring forms.
+        lst = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        lst.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        lst.bind(("0.0.0.0", 0))
+        lst.listen(max(len(members), 1))
+        lst.settimeout(min(self._timeout, 1.0))
+        store.set(f"{prefix}/hh/{leader}",
+                  f"{advertise_host()}:{lst.getsockname()[1]}".encode())
+        leader_ring: Optional[_Ring] = None
+        member_socks: Dict[int, socket.socket] = {}
+        try:
+            ln, lp, llst = self._ring_rendezvous(
+                store, prefix, "/hl", my_host, len(hosts))
+            leader_ring = _Ring(chaos.wrap_socket(ln, "ring"),
+                                chaos.wrap_socket(lp, "ring"), llst)
+            expected = set(members) - {rank}
+            deadline = time.monotonic() + self._timeout
+            while expected:
+                if time.monotonic() > deadline:
+                    raise CommunicatorError(
+                        "hier star accept failed: members "
+                        f"{sorted(expected)} never arrived")
+                try:
+                    cand, _ = lst.accept()
+                except OSError:
+                    continue  # listener timeout: re-check the deadline
+                try:
+                    cand.settimeout(self._timeout)
+                    cand.setsockopt(socket.IPPROTO_TCP,
+                                    socket.TCP_NODELAY, 1)
+                    key, peer = struct.unpack(
+                        "<qq", bytes(_recv_exact(cand, 16)))
+                    if key != hs or peer not in expected:
+                        cand.close()
+                        continue
+                    _send_all(cand, struct.pack("<q", hs))
+                except Exception:  # noqa: BLE001 — per-candidate
+                    cand.close()
+                    continue
+                member_socks[peer] = chaos.wrap_socket(cand, "ring")
+                expected.discard(peer)
+        except BaseException:
+            for s in member_socks.values():
+                s.close()
+            if leader_ring is not None:
+                leader_ring.close()
+            lst.close()
+            raise
+        return _HierTopo(hosts, rank, leader_ring=leader_ring,
+                         member_socks=member_socks, listener=lst)
 
     def _ring_span(self, kind: str) -> Any:
         """A ``ring`` span from the Manager-installed tracer
@@ -693,6 +954,23 @@ class HostCommunicator(Communicator):
         bounds = shard_bounds(acc.size, n)
         return np.array(acc[bounds[rank]:bounds[rank + 1]])
 
+    @staticmethod
+    def _wire_desc_key(op: str, buffers: List[Any],
+                       origs: List[np.dtype], tag: str) -> int:
+        """Stable hash of one wire op's full format: op kind, payload
+        tag, and every buffer's wire format/size/accumulator dtype —
+        the ONE spelling shared by the flat ring's preamble and the
+        hierarchical transport's record headers, so the two topologies
+        detect exactly the same skew classes."""
+        desc = [op, tag]
+        for b, orig in zip(buffers, origs):
+            if isinstance(b, Int8Wire):
+                desc.append(f"i8:{b.size}:{b.seg_elems}:{orig}")
+            else:
+                a = np.asarray(b)
+                desc.append(f"{a.dtype}:{a.size}:{orig}")
+        return epoch_key("|".join(desc))
+
     def _wire_preamble(self, ring: _Ring, op: str, buffers: List[Any],
                        origs: List[np.dtype], tag: str = "",
                        weight: int = -1) -> Optional[List[int]]:
@@ -730,14 +1008,7 @@ class HostCommunicator(Communicator):
         unweighted, 24*(world-1) + (world-1) weighted — excluded from
         the ring byte counters (protocol, not payload)."""
         n, rank = self._world, self._rank
-        desc = [op, tag]
-        for b, orig in zip(buffers, origs):
-            if isinstance(b, Int8Wire):
-                desc.append(f"i8:{b.size}:{b.seg_elems}:{orig}")
-            else:
-                a = np.asarray(b)
-                desc.append(f"{a.dtype}:{a.size}:{orig}")
-        key = epoch_key("|".join(desc))
+        key = self._wire_desc_key(op, buffers, origs, tag)
         weight = int(weight)
 
         def skew(gkey: int) -> CommunicatorError:
@@ -777,6 +1048,10 @@ class HostCommunicator(Communicator):
                            buffers: List[Any], origs: List[np.dtype],
                            op: str, tag: str = "",
                            weight: int = -1) -> List[np.ndarray]:
+        topo = self._hier
+        if topo is not None:
+            return self._do_wire_hier(topo, "ar", buffers, origs, op,
+                                      tag, weight)
         if ring is None:
             raise CommunicatorError("communicator not configured")
         weights = self._wire_preamble(ring, "ar", buffers, origs, tag,
@@ -1080,6 +1355,10 @@ class HostCommunicator(Communicator):
                                 buffers: List[Any], origs: List[np.dtype],
                                 op: str, tag: str = "",
                                 weight: int = -1) -> List[np.ndarray]:
+        topo = self._hier
+        if topo is not None:
+            return self._do_wire_hier(topo, "rs", buffers, origs, op,
+                                      tag, weight)
         if ring is None:
             raise CommunicatorError("communicator not configured")
         weights = self._wire_preamble(ring, "rs", buffers, origs, tag,
@@ -1193,6 +1472,296 @@ class HostCommunicator(Communicator):
             acc += b[lo:hi].astype(orig)
         return acc
 
+    # --------------------------------------- hierarchical wire transport
+    # (docs/design/hier_transport.md) Wire ops on a co-located topology
+    # route here instead of the flat ring: every rank's RAW wire
+    # contribution — never a partial sum — reaches every rank through
+    # three legs (member->leader star gather, leader-ring allgather of
+    # per-host bundles, leader->member broadcast), and the FOLD is then
+    # a purely local computation replicating the flat transport's fold
+    # order bit for bit. Raw forwarding is what preserves the
+    # one-quantization-per-contribution contract AND makes the
+    # cross-host leg's bytes scale with hosts: each leader sends
+    # (hosts-1) bundles instead of each of n ranks sending (n-1)
+    # buffers.
+
+    def _hier_span(self, stage: str, **tags: Any) -> Any:
+        """Per-leg span (``hier_intra``/``hier_leader``) from the
+        Manager-installed tracer — the attribution that splits "slow
+        hier op" into the loopback star vs the cross-host ring."""
+        return maybe_span(getattr(self, "tracer", None), stage,
+                          world=self._world, rank=self._rank, **tags)
+
+    @staticmethod
+    def _hier_serialize(buffers: List[Any]) -> List[Any]:
+        """Raw wire bytes of this rank's contributions, one part per
+        buffer: :meth:`Int8Wire.to_bytes` for the int8 rung, the
+        buffer's own bytes for float wires — exactly what the flat
+        transports put on the TCP ring, so byte counts and formats are
+        identical across topologies."""
+        parts: List[Any] = []
+        for b in buffers:
+            if isinstance(b, Int8Wire):
+                parts.append(b.to_bytes())
+            else:
+                a = np.ravel(np.asarray(b))
+                if not a.flags.c_contiguous:
+                    a = np.ascontiguousarray(a)
+                parts.append(memoryview(a.view(np.uint8)).cast("B"))
+        return parts
+
+    @staticmethod
+    def _hier_decode(payload: Any, template: Any) -> Any:
+        """Decode one received raw contribution using the local
+        buffer's format (geometry is schedule-deterministic, and the
+        record header's format hash was validated before any payload
+        byte was trusted)."""
+        if isinstance(template, Int8Wire):
+            return Int8Wire.from_bytes(payload, template.size,
+                                       template.seg_elems)
+        dt = np.ravel(np.asarray(template)).dtype
+        return np.frombuffer(payload, dt)
+
+    def _hier_recv_record(self, sock: socket.socket, key: int,
+                          weight: int, sizes: List[int],
+                          expect_rank: int) -> Tuple[bytes, int, list]:
+        """Receive + validate one rank's record (32-byte header +
+        payloads). The header carries the same format hash as the flat
+        ring's per-op preamble, so format/weight-mode skew aborts on
+        the FIRST hop it crosses — before a single payload byte is
+        parsed as data."""
+        hdr = bytes(_recv_exact(sock, 32))
+        magic, gkey, gw, grank = struct.unpack("<qqqq", hdr)
+        if magic == _HIER_ABORT:
+            raise CommunicatorError(
+                "hier transport abort relayed by the leader (a peer "
+                "announced a mismatched wire-op format)")
+        if magic != _HIER_MAGIC or gkey != key:
+            raise CommunicatorError(
+                "wire format skew: a peer announced a different "
+                f"wire-op format (got {gkey:#x}, expected {key:#x})"
+                " — policy/wire-dtype mismatch across groups; "
+                "aborting the collective before folding garbage")
+        if (gw < 0) != (weight < 0):
+            raise CommunicatorError(
+                "wire weight skew: this op mixes weighted and "
+                f"unweighted ranks (mine {weight}, a peer's {gw}) "
+                "— degraded mode (weighted folding) must be "
+                "enabled on EVERY group or none; aborting the "
+                "collective before folding garbage")
+        if grank != expect_rank:
+            raise CommunicatorError(
+                f"hier record rank mismatch (got {grank}, expected "
+                f"{expect_rank}) — stale or crossed hier stream")
+        payloads = [_recv_exact(sock, s) for s in sizes]
+        return hdr, int(gw), payloads
+
+    def _hier_abort_down(self, topo: "_HierTopo") -> None:
+        """Best-effort poison header down the star so members fail
+        fast on the leader's abort instead of blocking out their
+        socket timeout. A member that already completed this op reads
+        it at its NEXT op's header — a clean CommunicatorError either
+        way, and the latched error's recovery rendezvous rebuilds
+        every hier socket, so the stray header cannot leak across
+        epochs."""
+        abort = struct.pack("<qqqq", _HIER_ABORT, 0, -1, self._rank)
+        for s in topo.member_socks.values():
+            try:
+                _send_all(s, abort)
+            except Exception:  # noqa: BLE001 — member already gone
+                pass
+
+    def _hier_leader_exchange(self, topo: "_HierTopo", key: int,
+                              weight: int, sizes: List[int],
+                              hdrs: list, payloads: list, wts: list,
+                              all_int8: bool, kind: str) -> None:
+        """The cross-host leg: ring-allgather of per-host record
+        bundles among the leaders (each step forwards the previously
+        received bundle — the flat wire ring's forwarding loop, one
+        level up). Per leader: (hosts-1) bundle sends of
+        per_host * record bytes — the leg whose bytes scale with
+        hosts, not groups."""
+        ring = topo.leader_ring
+        nh = len(topo.hosts)
+        mh = topo.my_host
+        with self._hier_span("hier_leader", kind=kind, hosts=nh):
+            send_chunks: List[Any] = []
+            for r in topo.members:
+                send_chunks.append(hdrs[r])
+                send_chunks.extend(payloads[r])
+            for step in range(nh - 1):
+                futs = [ring.send_async(ch) for ch in send_chunks]
+                sent = sum(len(ch) for ch in send_chunks)
+                src = (mh - step - 1) % nh
+                recv_chunks: List[Any] = []
+                for r in topo.hosts[src]:
+                    h, gw, pl = self._hier_recv_record(
+                        ring.prev_sock, key, weight, sizes, r)
+                    hdrs[r], payloads[r], wts[r] = h, pl, gw
+                    recv_chunks.append(h)
+                    recv_chunks.extend(pl)
+                for f in futs:
+                    f.result()
+                self._ring_bytes += sent
+                self._hier_leader_bytes += sent
+                if all_int8:
+                    self._ring_bytes_int8 += sent
+                send_chunks = recv_chunks  # forward along the ring
+
+    def _do_wire_hier(self, topo: "_HierTopo", kind: str,
+                      buffers: List[Any], origs: List[np.dtype],
+                      op: str, tag: str, weight: int
+                      ) -> List[np.ndarray]:
+        n, rank = self._world, self._rank
+        weight = int(weight)
+        key = self._wire_desc_key(kind, buffers, origs, tag)
+        parts = self._hier_serialize(buffers)
+        sizes = [len(p) for p in parts]
+        rec_bytes = 32 + sum(sizes)
+        hdr = struct.pack("<qqqq", _HIER_MAGIC, key, weight, rank)
+        payloads: List[Optional[list]] = [None] * n
+        hdrs: List[Optional[bytes]] = [None] * n
+        wts = [0] * n
+        payloads[rank] = list(parts)
+        hdrs[rank] = hdr
+        wts[rank] = weight
+        all_int8 = bool(buffers) and all(
+            isinstance(b, Int8Wire) for b in buffers)
+        try:
+            if not topo.is_leader:
+                with self._hier_span("hier_intra", kind=kind, leg="up"):
+                    _send_all(topo.up_sock, hdr)
+                    for p in parts:
+                        _send_all(topo.up_sock, p)
+                    self._hier_intra_bytes += rec_bytes
+                with self._hier_span("hier_intra", kind=kind,
+                                     leg="down"):
+                    # The leader elides THIS member's own record from
+                    # its down stream (we already have it).
+                    for r in range(n):
+                        if r == rank:
+                            continue
+                        h, gw, pl = self._hier_recv_record(
+                            topo.up_sock, key, weight, sizes, r)
+                        payloads[r] = pl
+                        wts[r] = gw
+            else:
+                with self._hier_span("hier_intra", kind=kind,
+                                     leg="gather"):
+                    for r in topo.members:
+                        if r == rank:
+                            continue
+                        h, gw, pl = self._hier_recv_record(
+                            topo.member_socks[r], key, weight, sizes,
+                            r)
+                        hdrs[r], payloads[r], wts[r] = h, pl, gw
+                if topo.leader_ring is not None:
+                    self._hier_leader_exchange(topo, key, weight,
+                                               sizes, hdrs, payloads,
+                                               wts, all_int8, kind)
+                with self._hier_span("hier_intra", kind=kind,
+                                     leg="down"):
+                    # ONE concatenated down bundle (records in rank
+                    # order, with per-rank byte offsets), sent as at
+                    # most two slices per member — the member's own
+                    # record is elided (it already has it), and the
+                    # single buffer replaces ~2n per-chunk sendalls
+                    # per member with <= 2.
+                    chunks: List[Any] = []
+                    offs = [0] * (n + 1)
+                    for r in range(n):
+                        chunks.append(hdrs[r])
+                        chunks.extend(payloads[r])
+                        offs[r + 1] = offs[r] + rec_bytes
+                    down = memoryview(b"".join(chunks))
+                    for m in topo.members:
+                        if m == rank:
+                            continue
+                        s = topo.member_socks[m]
+                        _send_all(s, down[:offs[m]])
+                        _send_all(s, down[offs[m + 1]:])
+                        self._hier_intra_bytes += (n - 1) * rec_bytes
+        except Exception as e:
+            if topo.is_leader:
+                self._hier_abort_down(topo)
+            raise (e if isinstance(e, CommunicatorError)
+                   else CommunicatorError(str(e)))
+        ws = list(map(int, wts)) if weight >= 0 else None
+        if ws is not None and op == "mean":
+            raise CommunicatorError(
+                "op='mean' is not supported with weighted folding "
+                "(the weighted fold already normalizes)")
+        out: List[np.ndarray] = []
+        for k, (mine, orig) in enumerate(zip(buffers, origs)):
+            contribs = [
+                mine if r == rank else self._hier_decode(
+                    payloads[r][k], mine)
+                for r in range(n)]
+            out.append(self._hier_fold(kind, contribs, orig, ws, op))
+        return out
+
+    def _hier_fold(self, kind: str, contribs: List[Any],
+                   orig: np.dtype, weights: Optional[List[int]],
+                   op: str) -> np.ndarray:
+        """Local fold over all n raw contributions, replicating the
+        flat transport's fold order BIT FOR BIT per mode — the
+        hierarchical transport changes only how bytes travel, never
+        what is folded in which order (the "fold order unchanged"
+        invariant the A/B acceptance test freezes):
+
+        * weighted: the shared :meth:`_weighted_fold` (canonical rank
+          order, zero weights excluded, normalized in the fold);
+        * int8: zeros-start canonical rank order over dequantized
+          contributions (= ``_ring_allreduce_int8``);
+        * in-crossover narrow wires: the flat raw-forwarding fold —
+          own-first two-term at world 2, zeros-start linear at 3+;
+        * exact (and past-crossover narrow wires, which the flat path
+          upcasts into the exact ring): the exact ring's rotated
+          per-stripe order via :func:`_fold_exact_ring_order`.
+        """
+        n, rank = self._world, self._rank
+        is_int8 = isinstance(contribs[0], Int8Wire)
+        size = (contribs[0].size if is_int8
+                else np.ravel(np.asarray(contribs[0])).size)
+        bounds = shard_bounds(size, n)
+        lo, hi = ((int(bounds[rank]), int(bounds[rank + 1]))
+                  if kind == "rs" else (0, size))
+        if weights is not None:
+            gen = ((wb.dequantize(orig) for wb in contribs) if is_int8
+                   else contribs)
+            return self._weighted_fold(gen, orig, weights, lo, hi)
+        if is_int8:
+            acc = np.zeros(hi - lo, orig)
+            if kind == "rs":
+                for wb in contribs:
+                    acc += wb.dequantize(orig)[lo:hi]
+            else:
+                for wb in contribs:
+                    acc += wb.dequantize(orig)
+        else:
+            arrs = [np.ravel(np.asarray(b)) for b in contribs]
+            wdt = arrs[0].dtype
+            if wdt != orig and n * wdt.itemsize <= 2 * orig.itemsize:
+                if n == 2:
+                    acc = arrs[0][lo:hi].astype(orig)
+                    acc += arrs[1][lo:hi].astype(orig)
+                else:
+                    acc = np.zeros(hi - lo, orig)
+                    for a in arrs:
+                        acc += a[lo:hi].astype(orig)
+            else:
+                if wdt != orig:
+                    arrs = [a.astype(orig) for a in arrs]
+                acc = _fold_exact_ring_order(
+                    arrs, orig, n,
+                    stripe=rank if kind == "rs" else None)
+        if op == "mean":
+            if np.issubdtype(acc.dtype, np.inexact):
+                acc /= n
+            else:
+                acc //= n
+        return acc
+
     def _do_broadcast(self, ring: Optional[_Ring], tree: Any,
                       root: int) -> Any:
         if ring is None:
@@ -1243,6 +1812,26 @@ class HostCommunicator(Communicator):
     def int8_ring_bytes_total(self) -> float:
         return self._ring_bytes_int8
 
+    def ring_topology(self) -> str:
+        topo = self._hier
+        if topo is None:
+            return "flat"
+        return (f"hier:{len(topo.hosts)}x"
+                f"{max(len(ms) for ms in topo.hosts)}")
+
+    def hier_intra_bytes_total(self) -> float:
+        return self._hier_intra_bytes
+
+    def hier_leader_bytes_total(self) -> float:
+        """The cross-host leader-ring slice of :meth:`ring_bytes_total`
+        — the bytes the hierarchy exists to shrink (scales with hosts,
+        not groups)."""
+        return self._hier_leader_bytes
+
+    def hier_leader(self) -> float:
+        topo = self._hier
+        return 1.0 if topo is not None and topo.is_leader else 0.0
+
     def shutdown(self) -> None:
         if self._shutdown:
             return
@@ -1251,14 +1840,53 @@ class HostCommunicator(Communicator):
         self._ops.put(None)
         with self._lock:
             ring, self._ring = self._ring, None
+            topo, self._hier = self._hier, None
         if ring is not None:
             ring.close()
+        if topo is not None:
+            topo.close()
         self._worker.join(timeout=5)
 
 
 # Wire-op preamble magic (see _wire_preamble): distinguishes a format
 # hash from stray payload bytes when a skewed peer is mid-stream.
 _WIRE_MAGIC = 0x7F7A_57F7
+# Hierarchical record-header magic + the leader's abort poison header
+# (see _hier_recv_record / _hier_abort_down) — distinct values so a
+# flat preamble can never parse as a hier record or vice versa.
+_HIER_MAGIC = 0x7F7A_57F8
+_HIER_ABORT = 0x7F7A_57A0
+
+
+def _fold_exact_ring_order(arrs: List[np.ndarray], orig: np.dtype,
+                           world: int,
+                           stripe: Optional[int] = None) -> np.ndarray:
+    """Fold full-precision contributions in the exact ring's order:
+    canonical stripe ``c`` (:func:`shard_bounds` geometry — the ring's
+    own chunking) is the sequential left fold over ranks ``c, c+1, ...,
+    c+world-1`` (mod world), which is bit-for-bit the value the flat
+    ring's reduce-scatter phase produces for that chunk (each ring step
+    computes ``local + received_partial``; two-term f32 adds commute
+    bitwise, so the nesting matches — frozen by
+    tests/test_transport.py's flat-vs-hier battery). ``stripe=r``
+    returns only rank r's canonical stripe (the reduce-scatter
+    contract); ``None`` assembles the full buffer."""
+    size = arrs[0].size
+    bounds = shard_bounds(size, world)
+
+    def fold_chunk(c: int) -> np.ndarray:
+        lo, hi = int(bounds[c]), int(bounds[c + 1])
+        acc = np.array(arrs[c % world][lo:hi], dtype=orig)
+        for s in range(1, world):
+            acc += arrs[(c + s) % world][lo:hi]
+        return acc
+
+    if stripe is not None:
+        return fold_chunk(stripe)
+    out = np.empty(size, orig)
+    for c in range(world):
+        out[int(bounds[c]):int(bounds[c + 1])] = fold_chunk(c)
+    return out
 
 
 def epoch_key(prefix: str) -> int:

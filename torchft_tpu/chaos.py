@@ -954,5 +954,17 @@ class ChaosCommunicator(Communicator):
     def int8_ring_bytes_total(self) -> float:
         return self._comm.int8_ring_bytes_total()
 
+    def ring_topology(self) -> str:
+        return self._comm.ring_topology()
+
+    def hier_intra_bytes_total(self) -> float:
+        return self._comm.hier_intra_bytes_total()
+
+    def hier_leader(self) -> float:
+        return self._comm.hier_leader()
+
+    def hier_leader_bytes_total(self) -> float:
+        return self._comm.hier_leader_bytes_total()
+
     def shutdown(self) -> None:
         self._comm.shutdown()

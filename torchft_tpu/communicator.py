@@ -101,49 +101,92 @@ class Int8Wire:
         return max(1, -(-int(size) // int(seg_elems)))
 
     @staticmethod
+    def pow2_scales(s0: np.ndarray) -> np.ndarray:
+        """Smallest power of two >= each (assumed positive, finite) f32
+        in ``s0``, computed by exponent-bit manipulation — NOT by
+        ``2**ceil(log2(...))``, whose transcendental pieces round
+        differently between libm and XLA. Integer bit ops are exactly
+        reproducible everywhere, which is what lets the device-side
+        quantizer (``manager.py:_device_quantize_pack``) produce
+        bit-identical payloads to this host path. Subnormal inputs clamp
+        up to the smallest normal (2^-126); near-max inputs clamp down
+        to 2^127 (the resulting |q| overflow is absorbed by the ±127
+        clip)."""
+        bits = np.asarray(s0, np.float32).view(np.uint32)
+        e = (bits >> np.uint32(23)) + (bits & np.uint32(0x7FFFFF) != 0)
+        e = np.clip(e, 1, 254).astype(np.uint32)
+        return (e << np.uint32(23)).view(np.float32)
+
+    @staticmethod
     def quantize(values: np.ndarray,
                  seg_elems: int = INT8_SEG_ELEMS) -> "Int8Wire":
         """Per-segment affine quantization of a 1-D float buffer.
-        Deterministic (pure numpy, round-half-even via ``np.rint``) so
-        identically-seeded groups quantize identically."""
+        Deterministic (pure vectorized f32 numpy, round-half-even via
+        ``np.rint``) so identically-seeded groups quantize identically.
+
+        The segment scale is rounded UP to a power of two
+        (:meth:`pow2_scales`): ``q * scale`` is then exact in f32 (an
+        8-bit integer times a power of two never rounds), so the
+        reconstruction ``q*scale + zero`` has exactly ONE rounding —
+        which makes dequantization immune to FMA contraction and lets
+        the fused device-side quantizer (the D2H fetch optimization,
+        ``manager.py:_device_quantize_pack``) match this host spelling
+        bit for bit, error-feedback residuals included
+        (tests/test_transport.py freezes the parity). Costs at most one
+        bit of quantization resolution, which the EF residual loop
+        absorbs.
+
+        Non-finite segments (a loss-spike inf/NaN element) encode as
+        exact zero rather than poisoning the whole segment's
+        reconstruction with NaN — the contribution is junk either way,
+        but this keeps the format (and the caller's error-feedback
+        residual, see Manager._int8_quantize_bucket) finite so the rank
+        recovers on the next clean step. Constant segments encode as
+        ``scale=0, zero=v`` and reconstruct exactly."""
+        seg_elems = int(seg_elems)
         v = np.ravel(np.asarray(values)).astype(np.float32, copy=False)
         n = v.size
         nseg = Int8Wire.nseg(n, seg_elems)
-        scales = np.zeros(nseg, np.float32)
-        zeros = np.zeros(nseg, np.float32)
-        q = np.zeros(n, np.int8)
-        for s in range(nseg):
-            seg = v[s * seg_elems:(s + 1) * seg_elems]
-            lo = float(seg.min())
-            hi = float(seg.max())
-            zero = (hi + lo) / 2.0
-            scale = (hi - lo) / 254.0
-            if not (np.isfinite(zero) and np.isfinite(scale)):
-                # Non-finite segment (a loss-spike inf/NaN element):
-                # encode as exact zero rather than poisoning the whole
-                # segment's reconstruction with NaN — the contribution
-                # is junk either way, but this keeps the format (and
-                # the caller's error-feedback residual, see
-                # Manager._int8_quantize_bucket) finite so the rank
-                # recovers on the next clean step instead of banking
-                # NaN forever.
-                continue
-            zeros[s] = zero
-            if scale <= 0.0:
-                continue  # constant segment: q=0, reconstructs exactly
-            scales[s] = scale
-            q[s * seg_elems:(s + 1) * seg_elems] = np.clip(
-                np.rint((seg - zero) / scale), -127, 127).astype(np.int8)
+        if n == 0:
+            return Int8Wire(np.zeros(0, np.int8),
+                            np.zeros(nseg, np.float32),
+                            np.zeros(nseg, np.float32), seg_elems)
+        pad = nseg * seg_elems - n
+        # Pad with the last element: it already belongs to the last
+        # segment, so the padded min/max are the true segment min/max.
+        vp = (np.concatenate([v, np.broadcast_to(v[-1], (pad,))])
+              if pad else v)
+        m = vp.reshape(nseg, seg_elems)
+        lo = m.min(axis=1)
+        hi = m.max(axis=1)
+        zero = (hi + lo) / np.float32(2.0)
+        s0 = (hi - lo) / np.float32(254.0)
+        finite = np.isfinite(zero) & np.isfinite(s0)
+        ok = finite & (s0 > 0)
+        zeros = np.where(finite, zero, np.float32(0)).astype(np.float32)
+        scales = np.where(
+            ok, Int8Wire.pow2_scales(np.where(ok, s0, np.float32(1))),
+            np.float32(0)).astype(np.float32)
+        with np.errstate(all="ignore"):  # masked-out lanes divide by 0
+            qf = np.clip(np.rint((m - zeros[:, None]) / scales[:, None]),
+                         -127, 127)
+        q = np.where(scales[:, None] > 0, qf,
+                     np.float32(0)).astype(np.int8).reshape(-1)[:n]
         return Int8Wire(q, scales, zeros, seg_elems)
 
     def dequantize(self, dtype: Any = np.float32) -> np.ndarray:
-        """Affine reconstruction into the accumulator dtype."""
-        out = np.empty(self.size, np.float32)
-        for s in range(len(self.scales)):
-            sl = slice(s * self.seg_elems,
-                       min((s + 1) * self.seg_elems, self.size))
-            out[sl] = (self.q[sl].astype(np.float32) * self.scales[s]
-                       + self.zeros[s])
+        """Affine reconstruction into the accumulator dtype. The
+        ``q*scale`` product is exact (power-of-two scales, see
+        :meth:`quantize`), so the reconstruction rounds exactly once —
+        the property the device-side residual fold relies on."""
+        n, seg = self.size, self.seg_elems
+        nseg = len(self.scales)
+        pad = nseg * seg - n
+        q = (np.concatenate([self.q, np.zeros(pad, np.int8)])
+             if pad else self.q)
+        out = (q.reshape(nseg, seg).astype(np.float32)
+               * self.scales[:, None]
+               + self.zeros[:, None]).reshape(-1)[:n]
         return out.astype(np.dtype(dtype), copy=False)
 
     # -------------------------------------------------- ring wire format
@@ -306,6 +349,39 @@ class Communicator(ABC):
         (payload + per-segment headers), surfaced by the Manager as
         ``allreduce_int8_ring_bytes_total`` so the int8 rung's ~4x ring
         saving is observable on its own. Wrappers MUST forward."""
+        return 0.0
+
+    def ring_topology(self) -> str:
+        """Human-readable transport topology of the wire ops:
+        ``"flat"`` (the classic single-level ring — the default for
+        every backend without a hierarchical transport) or
+        ``"hier:<hosts>x<per_host>"`` when the host backend detected
+        co-located ranks and built the two-level ring
+        (docs/design/hier_transport.md). Surfaced by the Manager in
+        ``metrics_info()`` and stamped into bench rows. Wrappers MUST
+        forward."""
+        return "flat"
+
+    def hier_intra_bytes_total(self) -> float:
+        """Bytes this rank has sent over the INTRA-host (loopback) leg
+        of the hierarchical transport — the traffic that stopped
+        crossing the DCN ring. 0.0 on flat topologies/backends without
+        one. Surfaced as ``hier_intra_bytes_total``; wrappers MUST
+        forward."""
+        return 0.0
+
+    def hier_leader(self) -> float:
+        """1.0 when this rank is its host's elected leader on the
+        hierarchical transport's cross-host ring, else 0.0 (members and
+        flat topologies). Surfaced as the ``hier_leader`` gauge;
+        wrappers MUST forward."""
+        return 0.0
+
+    def hier_leader_bytes_total(self) -> float:
+        """The cross-host leader-ring slice of :meth:`ring_bytes_total`
+        — the bytes the hierarchy exists to shrink (0.0 on members and
+        flat topologies; the hier bench A/B sums it across groups).
+        Wrappers MUST forward."""
         return 0.0
 
     @abstractmethod
@@ -601,6 +677,18 @@ class ErrorSwallowingCommunicator(Communicator):
     def int8_ring_bytes_total(self) -> float:
         return self._comm.int8_ring_bytes_total()
 
+    def ring_topology(self) -> str:
+        return self._comm.ring_topology()
+
+    def hier_intra_bytes_total(self) -> float:
+        return self._comm.hier_intra_bytes_total()
+
+    def hier_leader(self) -> float:
+        return self._comm.hier_leader()
+
+    def hier_leader_bytes_total(self) -> float:
+        return self._comm.hier_leader_bytes_total()
+
     def shutdown(self) -> None:
         self._comm.shutdown()
 
@@ -733,6 +821,18 @@ class ManagedCommunicator(Communicator):
 
     def int8_ring_bytes_total(self) -> float:
         return self._comm.int8_ring_bytes_total()
+
+    def ring_topology(self) -> str:
+        return self._comm.ring_topology()
+
+    def hier_intra_bytes_total(self) -> float:
+        return self._comm.hier_intra_bytes_total()
+
+    def hier_leader(self) -> float:
+        return self._comm.hier_leader()
+
+    def hier_leader_bytes_total(self) -> float:
+        return self._comm.hier_leader_bytes_total()
 
     @property
     def wants_device_arrays(self) -> bool:

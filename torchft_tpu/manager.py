@@ -56,8 +56,9 @@ from torchft_tpu import policy as policy_mod
 from torchft_tpu import tracing as tracing_mod
 from torchft_tpu._native import ManagerClient, ManagerServer, Store, StoreClient
 from torchft_tpu.checkpointing import CheckpointServer
-from torchft_tpu.communicator import (Communicator, CommunicatorError,
-                                      Int8Wire, shard_bounds)
+from torchft_tpu.communicator import (INT8_SEG_ELEMS, Communicator,
+                                      CommunicatorError, Int8Wire,
+                                      shard_bounds)
 from torchft_tpu.retry import RetryPolicy, RetryStats
 from torchft_tpu.utils import advertise_host, div_by_count
 
@@ -223,6 +224,19 @@ class Manager:
             advance over an unsettled deferred step, ``save_durable``
             refuses mid-flight snapshots) whenever a deferred step is
             staged.
+        device_quantize: fuse wire quantization into the device-side
+            jitted pack (default on; env ``TORCHFT_DEVICE_QUANT=0``
+            opts out): under the int8+EF policy rung the affine
+            quantize and the error-feedback residual fold run ON
+            DEVICE and ``copy_to_host_async`` moves the ~1/4-size wire
+            payload instead of full f32 gradients — the D2H fetch
+            stage's dominant-cost fix (ROADMAP item 2); bf16 wire
+            casts stay fused in the pack as before. Residuals stay
+            device-resident between steps; payloads are bit-identical
+            to the host-side quantize path (power-of-two quantizer
+            scales), so the two settings interoperate freely across
+            ranks. ``False`` restores the host-side quantize/cast
+            paths — the bench ``multigroup_8mb_devquant_ab`` A/B leg.
         shard_update: opt-in ZeRO-style cross-replica sharding of the
             weight update (docs/design/sharded_update.md). When True,
             trainers call :meth:`reduce_scatter` instead of
@@ -329,6 +343,7 @@ class Manager:
         allreduce_wire_dtype: Optional[Any] = None,
         overlap_steps: int = 0,
         shard_update: bool = False,
+        device_quantize: Optional[bool] = None,
         degraded_mode: Optional[bool] = None,
         heal_striped: Optional[bool] = None,
         auth_token: Optional[str] = None,
@@ -404,6 +419,25 @@ class Manager:
         # (schedule fingerprint, bucket, chunk); mutated only on the
         # caller thread that runs the pipelines.
         self._ef_residuals: Dict[tuple, np.ndarray] = {}
+        # Device-side wire quantization (docs/design/hier_transport.md
+        # + allreduce_pipeline.md): when on (default; kwarg or env
+        # TORCHFT_DEVICE_QUANT=0 opts out — the bench A/B's knob), the
+        # int8 rung's affine quantize + error-feedback fold fuse into
+        # the cached jitted pack so copy_to_host_async moves WIRE bytes
+        # (~1/4 of f32) instead of full-precision gradients, and bf16
+        # casts stay fused in the pack as before. Off, the pre-
+        # optimization paths run: f32 fetch + host-side Int8Wire
+        # .quantize, orig-dtype fetch + host-side bf16 cast. Residuals
+        # of the fused path stay DEVICE-resident between steps, keyed
+        # like _ef_residuals; both paths produce bit-identical wire
+        # payloads (power-of-two quantizer scales — see
+        # Int8Wire.quantize — frozen by tests/test_transport.py).
+        if device_quantize is None:
+            device_quantize = os.environ.get(
+                "TORCHFT_DEVICE_QUANT", "1").strip().lower() \
+                not in ("0", "false")
+        self._device_quant = bool(device_quantize)
+        self._dev_residuals: Dict[tuple, Any] = {}
         self._shard_update = bool(shard_update)
         # --- degraded-mode groups (docs/design/degraded_mode.md) ---------
         # Weighted folding is a CLUSTER-WIDE wire-format property (every
@@ -514,6 +548,13 @@ class Manager:
             "allreduce_fetch_wait_ms_total": 0.0,
             "allreduce_ring_ms_total": 0.0,
             "allreduce_put_ms_total": 0.0, "allreduce_wire_bytes_total": 0.0,
+            # Actual device->host traffic of the fetch stage (what
+            # device_get / copy_to_host_async really moved — wire bytes
+            # under device-side quantization, NOT grad bytes). Tracks
+            # allreduce_wire_bytes_total today but is frozen under its
+            # own name so the devquant A/B and bench fetch accounting
+            # never conflate "bytes fetched" with "payload represented".
+            "allreduce_d2h_wire_bytes_total": 0.0,
             # Cross-step overlap engine (docs/design/overlap.md):
             # hidden = comm wall that ran concurrently with the caller's
             # compute between dispatch and drain (the ms the engine
@@ -1015,12 +1056,19 @@ class Manager:
                 # preamble (backends/host.py). degraded= pins the
                 # weighted-fold mode cluster-wide at rendezvous; the
                 # preamble's weight-mode check is the per-op backstop.
+                # payload=wire-v5: v5 moved the int8 rung's quantizer
+                # to power-of-two segment scales (the device-side-
+                # quantization parity contract, Int8Wire.quantize) —
+                # a pre-v5 rank would quantize the same contribution
+                # to different bytes, so mixed builds must die at
+                # rendezvous rather than silently fold mismatched
+                # rungs.
                 wire_fp = ("dynamic" if self._policy_aware
                            else str(self._wire_dtype))
                 setter(f"bucket_bytes={self._bucket_bytes};"
                        f"wire_dtype={wire_fp};"
                        f"degraded={int(self._degraded)};"
-                       f"payload=wire-v4")
+                       f"payload=wire-v5")
             reconf_t0 = time.perf_counter()
             self._comm.configure(
                 store_prefixed, q.replica_rank, q.replica_world_size
@@ -1540,7 +1588,7 @@ class Manager:
             while next_to_stage < min(hi, n_buckets):
                 staged[next_to_stage] = self._stage_bucket(
                     sched.chunks[next_to_stage], leaves,
-                    bucket=next_to_stage)
+                    bucket=next_to_stage, sched=sched, int8=int8)
                 next_to_stage += 1
 
         # Stage 2: per bucket, in order — wait for its wire buffers and
@@ -1678,6 +1726,12 @@ class Manager:
                 if k[0] == sched.fingerprint}
         out = []
         for j, (c, buf) in enumerate(zip(chunks, bufs)):
+            if isinstance(buf, Int8Wire):
+                # Already quantized ON DEVICE (the fused pack path,
+                # _stage_bucket): the residual was folded and banked
+                # device-side; nothing left to do host-side.
+                out.append(buf)
+                continue
             if not np.issubdtype(c.orig, np.floating):
                 out.append(buf)
                 continue
@@ -1697,9 +1751,7 @@ class Manager:
                 res[~np.isfinite(res)] = 0.0
             self._ef_residuals[key] = res
             out.append(w)
-        total = sum(r.nbytes for r in self._ef_residuals.values())
-        with self._metrics_lock:  # gauge, not a counter
-            self._metrics["wire_quant_residual_bytes"] = float(total)
+        self._update_residual_gauge()
         return out
 
     def _get_schedule(self, treedef: Any, leaves: list
@@ -1727,27 +1779,90 @@ class Manager:
         return sched
 
     def _stage_bucket(self, chunks: list, leaves: list,
-                      bucket: int = -1) -> list:
+                      bucket: int = -1,
+                      sched: Optional["_AllreduceSchedule"] = None,
+                      int8: bool = False) -> list:
         """Fetch stage 1 (dispatch): kick off one bucket's cached jitted
         packs and start each packed buffer's D2H copy immediately —
         without blocking — so DMA overlaps the ring. Returns the
-        bucket's staging records for :meth:`_wait_bucket`."""
+        bucket's staging records for :meth:`_wait_bucket`.
+
+        Under the int8+EF rung with ``device_quantize`` on, all-device
+        float chunks take the FUSED path (``_device_quantize_pack``):
+        concat + f32 upcast + device-resident residual fold + affine
+        quantize run in one jitted dispatch, and the D2H copy moves the
+        serialized ``Int8Wire`` payload (~1/4 of f32) instead of the
+        full-precision buffer — the dominant-stage cut of ROADMAP item
+        2. The banked residual never leaves the device. With
+        ``device_quantize`` off, narrow-wire chunks fetch in their
+        ACCUMULATOR dtype and cast host-side (the pre-optimization
+        behavior the ``multigroup_8mb_devquant_ab`` bench leg
+        measures)."""
         t0 = time.perf_counter()
         with self._tracer.span("fetch_dispatch", bucket=bucket):
             recs = []
-            for c in chunks:
-                dev = [(j, leaves[i]) for j, i in enumerate(c.idx)
+            dev_quant = False
+            for j, c in enumerate(chunks):
+                dev = [(jj, leaves[i]) for jj, i in enumerate(c.idx)
                        if isinstance(leaves[i], jax.Array)]
                 packed = None
-                if dev:
-                    packed = _pack_leaves([x for _, x in dev],
-                                          str(c.wire))
+                kind = "pack"
+                if (int8 and self._device_quant and sched is not None
+                        and dev and len(dev) == len(c.idx) and c.total
+                        and np.issubdtype(c.orig, np.floating)):
+                    kind = "int8dev"
+                    dev_quant = True
+                    key = (sched.fingerprint, bucket, j)
+                    self._prune_dev_residuals(sched.fingerprint)
+                    res = self._dev_residuals.get(key)
+                    if res is None or int(np.shape(res)[0]) != c.total:
+                        res = jnp.zeros(c.total, jnp.float32)
+                    packed, new_res = _device_quantize_pack(
+                        [x for _, x in dev], res)
+                    # Banked at quantize time, exactly like the host
+                    # path's _ef_residuals — an aborted step keeps its
+                    # residual either way.
+                    self._dev_residuals[key] = new_res
                     _start_copy_to_host(packed)
-                recs.append((c, dev, packed))
+                elif dev:
+                    wire = c.wire
+                    if not self._device_quant and wire != c.orig:
+                        # A/B leg (device_quantize=False): fetch the
+                        # full-precision buffer, cast host-side in
+                        # _wait_bucket — the pre-fused-pack fetch cost.
+                        wire = c.orig
+                        kind = "hostcast"
+                    packed = _pack_leaves([x for _, x in dev],
+                                          str(wire))
+                    _start_copy_to_host(packed)
+                recs.append((c, dev, packed, kind))
+            if dev_quant:
+                self._update_residual_gauge()
         ms = (time.perf_counter() - t0) * 1e3
         self._record(allreduce_fetch_dispatch_ms_total=ms,
                      allreduce_fetch_ms_total=ms)
         return recs
+
+    def _prune_dev_residuals(self, fingerprint: str) -> None:
+        """Bound the device-resident EF residual store to the CURRENT
+        schedule fingerprint — the same shape-churn discipline as
+        ``_ef_residuals``: a grad-signature change re-chunks the
+        pytree, so a stale residual would fold into the WRONG elements
+        (and leak one model-size f32 device buffer per signature)."""
+        if any(k[0] != fingerprint for k in self._dev_residuals):
+            self._dev_residuals = {
+                k: v for k, v in self._dev_residuals.items()
+                if k[0] == fingerprint}
+
+    def _update_residual_gauge(self) -> None:
+        """``wire_quant_residual_bytes`` = host-banked + device-banked
+        EF residual footprint (device entries are f32 per element by
+        construction)."""
+        total = sum(r.nbytes for r in self._ef_residuals.values())
+        total += sum(int(np.shape(r)[0]) * 4
+                     for r in self._dev_residuals.values())
+        with self._metrics_lock:  # gauge, not a counter
+            self._metrics["wire_quant_residual_bytes"] = float(total)
 
     def _wait_bucket(self, recs: list, leaves: list,
                      bucket: int = -1) -> list:
@@ -1769,20 +1884,36 @@ class Manager:
             allreduce_fetch_ms_total=ms,
             # Bytes that actually crossed D2H (host-native leaves never
             # do; rank-local accounting, no cross-rank constraint).
-            allreduce_wire_bytes_total=float(d2h))
+            # d2h_wire is the same quantity under its frozen name —
+            # with device-side quantization these are WIRE bytes, the
+            # ~1/4-of-f32 the fetch optimization exists for.
+            allreduce_wire_bytes_total=float(d2h),
+            allreduce_d2h_wire_bytes_total=float(d2h))
         return bufs
 
     def _wait_bucket_inner(self, recs: list, leaves: list) -> tuple:
         got = iter(jax.device_get(
-            [p for _, _, p in recs if p is not None]))
+            [p for _, _, p, _ in recs if p is not None]))
         bufs = []
         d2h = 0
-        for c, dev, packed in recs:
+        for c, dev, packed, kind in recs:
             fetched = None
             if packed is not None:
                 fetched = np.asarray(next(got))
                 d2h += fetched.nbytes
-                if fetched.dtype != c.wire:
+                if kind == "int8dev":
+                    # Device-quantized chunk: the fetched uint8 buffer
+                    # IS the Int8Wire payload (scales | zeros | q, the
+                    # to_bytes layout), bit-identical to what host-side
+                    # Int8Wire.quantize would have produced — decode
+                    # and hand it to the ring unchanged.
+                    bufs.append(Int8Wire.from_bytes(fetched, c.total))
+                    continue
+                if kind == "hostcast":
+                    # A/B leg: full-precision fetch, wire cast here on
+                    # the host (the serialized pre-optimization cost).
+                    fetched = fetched.astype(c.wire)
+                elif fetched.dtype != c.wire:
                     # Non-native wire dtype crossed D2H as its canonical
                     # uint carrier (_transfer_dtype); view the bits back
                     # — zero-copy, bitwise identical.
@@ -1940,7 +2071,7 @@ class Manager:
             while next_to_stage < min(hi, n_buckets):
                 staged[next_to_stage] = self._stage_bucket(
                     sched.chunks[next_to_stage], leaves,
-                    bucket=next_to_stage)
+                    bucket=next_to_stage, sched=sched, int8=int8)
                 next_to_stage += 1
 
         int8 = self._policy.wire == policy_mod.WIRE_INT8
@@ -2327,8 +2458,10 @@ class Manager:
         if wire_changed:
             # Wire-rung transitions flush quantizer state: the int8
             # rung's residuals belong to the outgoing format and must
-            # never fold into a different wire's contributions.
+            # never fold into a different wire's contributions — the
+            # device-resident bank included.
             self._ef_residuals.clear()
+            self._dev_residuals.clear()
         rung = -1.0
         if self._controller is not None:
             r = self._controller.rung_of(p)
@@ -2721,6 +2854,21 @@ class Manager:
         int8_bytes = getattr(self._comm, "int8_ring_bytes_total", None)
         out["allreduce_int8_ring_bytes_total"] = (
             float(int8_bytes()) if int8_bytes is not None else 0.0)
+        # Hierarchical-transport legs (docs/design/hier_transport.md):
+        # loopback intra-host bytes (traffic that stopped crossing the
+        # DCN ring) and whether this rank leads its host's star. 0 on
+        # flat topologies / backends without a hierarchy; getattr
+        # tolerates bare duck-typed comms in tests, and the float()
+        # guard tolerates MagicMock getters.
+        for mkey, attr in (("hier_intra_bytes_total",
+                            "hier_intra_bytes_total"),
+                           ("hier_leader", "hier_leader")):
+            getter = getattr(self._comm, attr, None)
+            try:
+                out[mkey] = (float(getter())
+                             if getter is not None else 0.0)
+            except (TypeError, ValueError):
+                out[mkey] = 0.0
         # Observability-tier health: span ring volume/drops and flight-
         # recorder dump count (docs/design/observability.md).
         out.update(self._tracer.metrics())
@@ -2761,17 +2909,25 @@ class Manager:
         Keys: ``policy_name`` / ``policy_last_reason`` (the active
         FT policy and why it was last switched), ``ckpt_last_error``
         (the attached durable writer's sticky last failure, ``""`` when
-        clean), and ``flight_last_path`` (newest flight-recorder dump,
-        ``""`` before the first)."""
+        clean), ``flight_last_path`` (newest flight-recorder dump,
+        ``""`` before the first), and ``ring_topology`` (the
+        communicator's wire-op transport — ``"flat"`` or
+        ``"hier:<hosts>x<per_host>"``,
+        docs/design/hier_transport.md)."""
         last_err = ""
         if self._ckpt_writer is not None:
             last_err = self._ckpt_writer.last_error() or ""
+        topo_fn = getattr(self._comm, "ring_topology", None)
+        topo = topo_fn() if callable(topo_fn) else "flat"
         return {
             "policy_name": self._policy.name,
             "policy_last_reason": self._policy_last_reason,
             "ckpt_last_error": last_err,
             "flight_last_path": (self._flight.last_path
                                  if self._flight is not None else ""),
+            # isinstance guard: duck-typed/MagicMock comms must not
+            # leak a non-string into the strings-only dict.
+            "ring_topology": topo if isinstance(topo, str) else "flat",
         }
 
     # ------------------------------------------------- durable checkpoints
@@ -3196,6 +3352,97 @@ def _pack_leaves(leaves: list, wire_dtype_str: str) -> Any:
 
         fn = _PACK_FNS[wire_dtype_str] = jax.jit(pack)
     return fn(leaves)
+
+
+_DEV_QUANT_FNS: Dict[int, Any] = {}
+
+
+def _device_quantize_pack(leaves: list, residual: Any,
+                          seg_elems: int = INT8_SEG_ELEMS) -> Any:
+    """Fused device-side int8 wire quantization (the D2H fetch-wall
+    fix, ROADMAP item 2): one cached jitted dispatch concatenates the
+    chunk's device leaves, upcasts to f32, folds in the device-resident
+    error-feedback ``residual``, quantizes per segment, and emits
+
+    * the serialized wire payload as ONE uint8 buffer laid out exactly
+      like :meth:`Int8Wire.to_bytes` (``scales | zeros | q``, f32
+      little-endian) — so ``copy_to_host_async`` moves ~1/4 of the f32
+      bytes and the host side decodes with ``Int8Wire.from_bytes``
+      zero-conversion;
+    * the NEW residual (``v - dequant(q)``, non-finite entries zeroed),
+      which stays on device for the next step.
+
+    The arithmetic mirrors :meth:`Int8Wire.quantize` operation for
+    operation in f32: min/max/sub/div/rint are exact or
+    single-rounding, the power-of-two scale comes from integer
+    exponent bits, and ``q*scale`` is exact — so the reconstruction's
+    one rounding survives XLA's FMA contraction and the whole
+    trajectory (payload AND residual) is bit-identical to the host
+    path (frozen by tests/test_transport.py). Cached per ``seg_elems``;
+    jit re-specializes per leaf-shape signature, counted by the
+    trace-time ``pack_cache_misses`` bump like ``_pack_leaves``.
+
+    The byte layout assumes a little-endian host (every supported
+    deployment); the parity test would catch a BE port."""
+    fn = _DEV_QUANT_FNS.get(seg_elems)
+    if fn is None:
+
+        def qpack(ls, res):
+            # Trace-time side effect: counts pack-executable cache
+            # misses exactly like _pack_leaves (compiles once per grad
+            # signature, never on steady-state dispatch).
+            _pack_stat_bump("pack_cache_misses")
+            v = jnp.concatenate(
+                [jnp.ravel(x).astype(jnp.float32) for x in ls])
+            v = v + res
+            n = v.shape[0]
+            nseg = max(1, -(-n // seg_elems))
+            pad = nseg * seg_elems - n
+            # Pad with the last element (it belongs to the last
+            # segment, so padded min/max are the true segment min/max
+            # — Int8Wire.quantize pads identically).
+            vp = (jnp.concatenate(
+                [v, jnp.broadcast_to(v[n - 1], (pad,))]) if pad else v)
+            m = vp.reshape(nseg, seg_elems)
+            lo = jnp.min(m, axis=1)
+            hi = jnp.max(m, axis=1)
+            zero = (hi + lo) / np.float32(2.0)
+            s0 = (hi - lo) / np.float32(254.0)
+            finite = jnp.isfinite(zero) & jnp.isfinite(s0)
+            ok = finite & (s0 > 0)
+            zeros = jnp.where(finite, zero, 0.0)
+            # Smallest power of two >= s0 by exponent bits — the
+            # integer spelling of Int8Wire.pow2_scales, exactly
+            # reproducible across numpy and XLA.
+            bits = jax.lax.bitcast_convert_type(
+                jnp.where(ok, s0, 1.0), jnp.uint32)
+            e = (bits >> 23) + ((bits & 0x7FFFFF) != 0)
+            e = jnp.clip(e, 1, 254).astype(jnp.uint32)
+            scales = jnp.where(
+                ok,
+                jax.lax.bitcast_convert_type(e << 23, jnp.float32),
+                0.0)
+            qf = jnp.clip(
+                jnp.rint((m - zeros[:, None]) / scales[:, None]),
+                -127, 127)
+            qm = jnp.where(scales[:, None] > 0, qf, 0.0).astype(
+                jnp.int8)
+            q = qm.reshape(-1)[:n]
+            deq = (qm.astype(jnp.float32) * scales[:, None]
+                   + zeros[:, None]).reshape(-1)[:n]
+            new_res = v - deq
+            new_res = jnp.where(jnp.isfinite(new_res), new_res, 0.0)
+            payload = jnp.concatenate([
+                jax.lax.bitcast_convert_type(
+                    scales, jnp.uint8).reshape(-1),
+                jax.lax.bitcast_convert_type(
+                    zeros, jnp.uint8).reshape(-1),
+                jax.lax.bitcast_convert_type(q, jnp.uint8),
+            ])
+            return payload, new_res
+
+        fn = _DEV_QUANT_FNS[seg_elems] = jax.jit(qpack)
+    return fn(leaves, residual)
 
 
 def _stage_ahead_window() -> Optional[int]:

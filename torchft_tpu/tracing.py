@@ -67,8 +67,8 @@ CONTEXT_TAGS = ("replica_id", "quorum_id", "epoch", "step", "policy_name")
 # track per stage, in protocol order. Unknown stages append after.
 STAGES = (
     "quorum", "heal", "heal_stripe", "fetch_dispatch", "fetch_wait",
-    "ring", "put", "overlap_drain", "drain", "vote", "ckpt_save",
-    "publish",
+    "ring", "hier_intra", "hier_leader", "put", "overlap_drain",
+    "drain", "vote", "ckpt_save", "publish",
 )
 
 
