@@ -23,6 +23,7 @@ them at tiny scale and asserts the recovery guarantees (<1 step lost).
 from __future__ import annotations
 
 import json
+import logging
 import os
 import statistics
 import sys
@@ -1618,6 +1619,287 @@ def bench_policy_soak(policy: str = "adaptive",
     }
 
 
+def _hard_kill_manager(m: Any) -> None:
+    """SIGKILL simulation for the churn bench's control leg: tear the
+    group down the way a reclaimed-without-notice VM does — sockets
+    slam shut, NO farewell, NO final save, heartbeats stop — so
+    survivors pay the staleness-eviction path. Reaches into Manager
+    internals deliberately: a public API for dying badly would invite
+    production use."""
+    try:
+        srv = m._manager_server
+        if srv is not None:
+            hs = getattr(srv, "hard_stop", None)
+            (hs if hs is not None else srv.shutdown)()
+    except Exception:  # noqa: BLE001
+        pass
+    for closer in (m._ckpt_server.shutdown, m._comm.shutdown):
+        try:
+            closer()
+        except Exception:  # noqa: BLE001
+            pass
+    m._executor.shutdown(wait=False, cancel_futures=True)
+    m._put_executor.shutdown(wait=False)
+
+
+def bench_churn_goodput(churn_pct_per_min: float = 0.0,
+                        leg: str = "graceful",
+                        n_groups: int = 4,
+                        duration_s: float = 30.0,
+                        seed: int = 1234,
+                        dim: int = 4096,
+                        reclaim_s: float = 10.0,
+                        replace_delay_s: float = 1.5,
+                        ckpt_every: int = 4,
+                        drain_steps: int = 8,
+                        join_window_ms: int = 400,
+                        phases: Optional[tuple] = None,
+                        workdir: Optional[str] = None) -> Dict[str, Any]:
+    """One leg of the churn-goodput curve (docs/design/churn.md, ROADMAP
+    item 4): ``n_groups`` replica groups train for ``duration_s`` while
+    a seeded :class:`~torchft_tpu.chaos.ChurnOrchestrator` preempts
+    ``churn_pct_per_min``% of the fleet per minute — every preemption
+    either a *graceful* reclaim notice (``leg="graceful"``:
+    ``request_preemption(reclaim_s)`` → boundary drain → farewell →
+    final sharded durable save → exit) or a SIGKILL
+    (``leg="sigkill"``: sockets slam shut, no farewell — the control
+    leg) — and cold replacements respawn after ``replace_delay_s``,
+    cold-starting from the slot's durable checkpoints and healing in.
+
+    The gate metric is **fleet committed-batches/sec**: any survivor's
+    ``batches_committed`` delta over the window (it advances by the
+    participating world per committed boundary, so it integrates the
+    fleet's goodput through every membership change). The run ends with
+    a churn-free drain so the bitwise-convergence oracle is exact:
+    every group at the fleet's max step must hold identical bytes.
+
+    ``phases`` optionally walks the churn intensity
+    :class:`~torchft_tpu.policy.PhasedChaos`-style — a tuple of
+    ``(duration_s, churn_pct_per_min)`` legs (stable -> storm ->
+    stable) applied via ``ChurnOrchestrator.set_rate``; it overrides
+    ``duration_s``/``churn_pct_per_min``.
+
+    Needs the native control plane (callers gate on
+    :func:`_native_control_plane_available`)."""
+    import shutil
+    import tempfile
+
+    from torchft_tpu import (AsyncCheckpointer, HostCommunicator,
+                             Lighthouse, Manager, PreemptedExit)
+    from torchft_tpu.chaos import ChurnOrchestrator
+
+    if phases is not None:
+        duration_s = sum(d for d, _ in phases)
+        churn_pct_per_min = max(p for _, p in phases)
+    rate_per_min = churn_pct_per_min / 100.0 * n_groups
+    tmp = workdir or tempfile.mkdtemp(prefix="bench_churn_")
+    lh = Lighthouse(bind="127.0.0.1:0", min_replicas=1,
+                    join_timeout_ms=1_000, quorum_tick_ms=50,
+                    heartbeat_fresh_ms=300,
+                    eviction_staleness_factor=3,
+                    join_window_ms=join_window_ms)
+    rng = np.random.default_rng(seed)
+    params0 = np.asarray(rng.normal(size=(dim,)), np.float32)
+
+    stop_all = threading.Event()
+    lock = threading.Lock()
+    # Per-slot mutable state shared across incarnations.
+    slot_params: Dict[int, Any] = {s: {"w": params0.copy()}
+                                   for s in range(n_groups)}
+    registry: Dict[int, Any] = {}       # slot -> live Manager
+    kill_events: Dict[int, threading.Event] = {}
+    threads: Dict[int, threading.Thread] = {}
+    counters = {"graceful_exits": 0, "deadline_expired": 0,
+                "aborts": 0, "hard_kills": 0}
+    finals: Dict[str, tuple] = {}  # incarnation id -> (step, batches, bytes)
+
+    def grads(slot: int, step: int, p: Dict[str, Any]) -> Dict[str, Any]:
+        # Group-varying but deterministic per (slot, step): the averaged
+        # update is identical on every participant, so survivors stay
+        # bitwise-lockstep through arbitrary membership drift.
+        g = np.asarray(
+            np.sin(np.arange(dim, dtype=np.float32) * (slot + 1)
+                   + step) * 1e-2, np.float32)
+        return {"w": g}
+
+    def run_group(slot: int, incarnation: int) -> None:
+        sdir = os.path.join(tmp, f"slot{slot}")
+        os.makedirs(sdir, exist_ok=True)
+        holder = {"p": slot_params[slot]}
+
+        def load(state):
+            holder["p"] = {k: np.asarray(v) for k, v in state.items()}
+
+        m = Manager(
+            comm=HostCommunicator(timeout_sec=10),
+            load_state_dict=load, state_dict=lambda: holder["p"],
+            min_replica_size=1,
+            replica_id=f"g{slot}", lighthouse_addr=lh.address(),
+            rank=0, world_size=1, timeout_ms=10_000,
+            quorum_timeout_ms=10_000, max_consecutive_failures=10_000)
+        writer = AsyncCheckpointer(keep=2, shards=2)
+        m.set_durable_target(writer, sdir)
+        kill_evt = threading.Event()
+        with lock:
+            registry[slot] = m
+            kill_events[slot] = kill_evt
+            slot_params[slot] = holder["p"]
+        if incarnation > 0:
+            try:
+                m.cold_start(sdir)
+            except Exception:  # noqa: BLE001 — fresh start; heal covers
+                logging.getLogger(__name__).warning(
+                    "cold start failed", exc_info=True)
+        base = m.batches_committed()
+        t0 = time.perf_counter()
+        step_i = 0
+        try:
+            while True:
+                if kill_evt.is_set():
+                    with lock:
+                        counters["hard_kills"] += 1
+                        registry.pop(slot, None)
+                    _hard_kill_manager(m)
+                    return
+                if stop_all.is_set() and step_i >= drain_steps:
+                    break
+                if stop_all.is_set():
+                    step_i += 1  # churn-free drain steps before the oracle
+                m.step()
+                avg = m.allreduce(
+                    grads(slot, m.current_step(), holder["p"])).result()
+                if m.should_commit():
+                    holder["p"] = {
+                        k: np.asarray(holder["p"][k] - avg[k], np.float32)
+                        for k in holder["p"]}
+                    with lock:
+                        slot_params[slot] = holder["p"]
+                    if m.current_step() % ckpt_every == 0:
+                        m.save_durable(writer, sdir)
+                else:
+                    with lock:
+                        counters["aborts"] += 1
+        except PreemptedExit:
+            with lock:
+                counters["graceful_exits"] += 1
+                registry.pop(slot, None)
+            return  # manager already shut down by the drain
+        except Exception:  # noqa: BLE001 — a dying group is expected here
+            logging.getLogger(__name__).warning(
+                "churn worker g%d died", slot, exc_info=True)
+            with lock:
+                registry.pop(slot, None)
+            # A crashed group must NOT record finals: its truncated
+            # window (and possibly stale params) would pollute the
+            # goodput gate and the bitwise oracle.
+            return
+        # Clean end-of-run exit: record the oracle inputs, then leave.
+        wall = time.perf_counter() - t0
+        mx = m.metrics()
+        with lock:
+            counters["deadline_expired"] += int(
+                mx["preempt_deadline_expired_total"])
+            finals[f"g{slot}.{incarnation}"] = (
+                m.current_step(),
+                (m.batches_committed() - base) / max(wall, 1e-9),
+                np.asarray(holder["p"]["w"]).tobytes(),
+                mx["reconfigure_count"], mx["joins_coalesced_total"],
+                wall)
+            registry.pop(slot, None)
+        m.shutdown()
+
+    def notify(slot: int) -> None:
+        with lock:
+            m = registry.get(slot)
+        if m is not None:
+            m.request_preemption(reclaim_s, reason="bench churn")
+
+    def kill(slot: int) -> None:
+        with lock:
+            evt = kill_events.get(slot)
+        if evt is not None:
+            evt.set()
+
+    def replace(slot: int) -> None:
+        if stop_all.is_set():
+            return
+        with lock:
+            inc = replace.count[slot] = replace.count.get(slot, 0) + 1
+        t = threading.Thread(target=run_group, args=(slot, inc),
+                             name=f"churn-g{slot}.{inc}", daemon=True)
+        with lock:
+            threads[f"{slot}.{inc}"] = t
+        t.start()
+
+    replace.count = {}
+
+    orch = ChurnOrchestrator(
+        seed=seed, groups=list(range(n_groups)),
+        rate_per_min=rate_per_min, graceful_frac=(
+            1.0 if leg == "graceful" else 0.0),
+        notify=notify, kill=kill, replace=replace,
+        replace_delay_s=replace_delay_s, min_live=max(1, n_groups // 2))
+
+    for s in range(n_groups):
+        t = threading.Thread(target=run_group, args=(s, 0),
+                             name=f"churn-g{s}.0", daemon=True)
+        threads[f"{s}.0"] = t
+        t.start()
+    t0 = time.monotonic()
+    t_end = t0 + duration_s
+    while time.monotonic() < t_end:
+        if phases is not None:
+            # PhasedChaos-style walk (stable -> storm -> stable).
+            elapsed = time.monotonic() - t0
+            pct = phases[-1][1]
+            acc = 0.0
+            for dur, level in phases:
+                acc += dur
+                if elapsed < acc:
+                    pct = level
+                    break
+            orch.set_rate(pct / 100.0 * n_groups)
+        orch.tick(time.monotonic())
+        time.sleep(0.05)
+    stop_all.set()
+    deadline = time.monotonic() + 120.0
+    for t in list(threads.values()):
+        t.join(timeout=max(deadline - time.monotonic(), 1.0))
+    lh.shutdown()
+    if workdir is None:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    if not finals:
+        raise RuntimeError("churn leg ended with no surviving group")
+    max_step = max(v[0] for v in finals.values())
+    at_max = {k: v for k, v in finals.items() if v[0] == max_step}
+    blobs = {v[2] for v in at_max.values()}
+    # Gate metric = the rate of the group with the LONGEST measurement
+    # window: any survivor's batches_committed counts FLEET commits, but
+    # a late replacement's short window is mostly the churn-free drain
+    # phase — max() over rates would let it mask the storm's cost.
+    rep = max(finals.values(), key=lambda v: v[5])
+    return {
+        "leg": leg,
+        "churn_pct_per_min": churn_pct_per_min,
+        "preempts_per_min": rate_per_min,
+        "n_groups": n_groups,
+        "duration_s": duration_s,
+        "committed_batches_per_s": rep[1],
+        "measured_window_s": rep[5],
+        "graceful_exits": counters["graceful_exits"],
+        "hard_kills": counters["hard_kills"],
+        "deadline_expired": counters["deadline_expired"],
+        "aborts": counters["aborts"],
+        "notices": orch.notices, "kills": orch.kills,
+        "replacements": orch.replacements,
+        "reconfigures_max": max(v[3] for v in finals.values()),
+        "joins_coalesced_max": max(v[4] for v in finals.values()),
+        "survivors_at_max_step": len(at_max),
+        "bitwise_identical": len(blobs) == 1,
+    }
+
+
 def _native_control_plane_available() -> bool:
     """Probe for the C++ control-plane library (mirrors tests/conftest.py's
     native_available): the quorum benches are thin ctypes loops and skip
@@ -2113,6 +2395,38 @@ def main() -> None:
                        / max(legs[True]["p50_ms"], 1e-9), 2),
                    "arrival_jitter_ms": legs[True]["arrival_jitter_ms"],
                    "fast_path_hits": legs[True]["fast_path_hits"]})
+        # Churn goodput curve (docs/design/churn.md, ROADMAP item 4):
+        # committed-batches/sec under seeded Poisson preemption at
+        # accelerated churn rates (a per-commit bench can't wait out a
+        # literal 5%/min hour — the nightly soak runs the gated legs),
+        # graceful-drain vs SIGKILL A/B. churn_rate (%-of-fleet/min) is
+        # stamped on EVERY row.
+        churn_base = bench_churn_goodput(churn_pct_per_min=0.0,
+                                         duration_s=20.0)
+        base_rate = max(churn_base["committed_batches_per_s"], 1e-9)
+        _emit({"metric": "churn_goodput", "leg": "baseline",
+               "churn_rate": 0.0,
+               "committed_batches_per_s": round(base_rate, 2),
+               "baseline_ratio": 1.0,
+               "bitwise_identical": churn_base["bitwise_identical"]})
+        for leg in ("graceful", "sigkill"):
+            row = bench_churn_goodput(churn_pct_per_min=150.0, leg=leg,
+                                      duration_s=20.0, reclaim_s=6.0)
+            _emit({"metric": "churn_goodput", "leg": leg,
+                   "churn_rate": row["churn_pct_per_min"],
+                   "committed_batches_per_s": round(
+                       row["committed_batches_per_s"], 2),
+                   "baseline_ratio": round(
+                       row["committed_batches_per_s"] / base_rate, 3),
+                   "notices": row["notices"], "kills": row["kills"],
+                   "replacements": row["replacements"],
+                   "graceful_exits": row["graceful_exits"],
+                   "deadline_expired": row["deadline_expired"],
+                   "aborts": row["aborts"],
+                   "reconfigures_max": row["reconfigures_max"],
+                   "joins_coalesced_max": row["joins_coalesced_max"],
+                   "bitwise_identical": row["bitwise_identical"]})
+
         fo = bench_quorum_failover()
         _emit({"metric": "quorum_standby_failover", "n": fo["n"],
                "kill_at": fo["kill_at"],

@@ -38,6 +38,7 @@ MODULES = [
     ("torchft_tpu.serialization", "Streaming pytree wire format"),
     ("torchft_tpu.optim", "Commit-gated optimizer wrappers"),
     ("torchft_tpu.policy", "Adaptive fault-tolerance policy"),
+    ("torchft_tpu.chaos", "Fault injection + churn orchestration"),
     ("torchft_tpu.data", "Replica-group data sharding"),
     ("torchft_tpu.degraded", "Degraded-mode groups (partial chip loss)"),
     ("torchft_tpu.local_sgd", "DiLoCo-style local SGD"),

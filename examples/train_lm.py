@@ -217,13 +217,43 @@ def main() -> None:
 
         ckpt_writer = AsyncCheckpointer(keep=3)
 
+    # Spot/preemptible reclaim notices (docs/design/churn.md): SIGTERM
+    # arms the graceful drain — at the next clean commit boundary the
+    # manager farewells the quorum (survivors lose nothing), takes a
+    # final durable save (SAME tree structure as the cadence saves, so
+    # resume never hits a mismatch), withdraws its heal/publish
+    # advertisements, and step() raises PreemptedExit below.
+    def _drain_user_state():
+        user = {"trainer": trainer.state_dict()}
+        if not elastic:
+            user["loader"] = batches.state_dict()
+        return user
+
+    if ckpt_writer is not None:
+        m.set_durable_target(ckpt_writer,
+                             os.path.join(ckpt_dir, ckpt_name),
+                             user_state_fn=_drain_user_state)
+    m.install_preemption_handler()
+
+    from torchft_tpu import PreemptedExit
+
     t0 = time.perf_counter()
-    while m.current_step() < total_steps:
+    preempted = False
+    while not preempted and m.current_step() < total_steps:
         # Elastic mode hands the loader ITSELF to train_step (a zero-arg
         # callable): the draw then happens after manager.step(), reading
         # the step's true slot.
         batch = batches if elastic else next(batches)
-        loss, committed = trainer.train_step(batch)
+        try:
+            loss, committed = trainer.train_step(batch)
+        except PreemptedExit:
+            # The noticed-reclaim SUCCESS path: the drain already
+            # farewelled, took the final save, withdrew advertisements,
+            # and shut the manager down — exit 0 before the SIGKILL.
+            logger.info("gracefully preempted at step %d; exiting",
+                        m.current_step())
+            preempted = True
+            continue
         step = m.current_step()
         if ckpt_writer is not None and committed and step % ckpt_every == 0:
             # Overlap mode keeps one allreduce in flight across the step
@@ -248,8 +278,9 @@ def main() -> None:
                 step, float(loss), committed,
                 m.num_participants(), 10 / dt if dt else 0)
             t0 = time.perf_counter()
-    logger.info("done: %d steps, %d batches committed",
-                m.current_step(), m.batches_committed())
+    if not preempted:
+        logger.info("done: %d steps, %d batches committed",
+                    m.current_step(), m.batches_committed())
     try:
         if ckpt_writer is not None:
             ckpt_writer.shutdown()  # drain the in-flight durable save;

@@ -172,6 +172,28 @@ if [[ "${1:-}" == "transport" ]]; then
     exit 0
 fi
 
+# Churn tier: the spot-instance churn arc's focused gate
+# (docs/design/churn.md) — the seeded ChurnOrchestrator event stream,
+# the graceful preemption drain state machine (notice/SIGTERM ->
+# boundary drain -> farewell -> final sharded save -> advertisement
+# withdrawal -> PreemptedExit; deferral mid-heal/mid-deferred/errored/
+# aborted; deadline expiry + flight dump), manager-side join-coalescing
+# and reconfigures-per-minute accounting, the pre-join heal
+# (join backpressure over real checkpoint HTTP), chaos kill-latch
+# rebirth for address-reusing replacements, and the 2-group
+# graceful-vs-SIGKILL A/B drive over a real socketpair ring. Tier-1 too
+# (not marked slow); run this tier on manager/chaos/lighthouse changes.
+# The lighthouse-side join window + farewell-race regression run in the
+# `core` tier (core_test.cc); the Poisson churn soak
+# (bench_churn_goodput goodput + bitwise gates) is native-gated and
+# rides the nightly tier.
+if [[ "${1:-}" == "churn" ]]; then
+    stage churn env JAX_PLATFORMS=cpu \
+        python -m pytest tests/test_churn.py -q -m "churn and not slow"
+    echo "== total: ${SECONDS}s"
+    exit 0
+fi
+
 # Obs tier: the observability tier's focused gate
 # (docs/design/observability.md) — span-ring bounds/context, the
 # flight recorder's triggers (vote abort, latched comm error, heal

@@ -83,6 +83,11 @@ DOCUMENTED_KEYS = frozenset([
     "allreduce_int8_ring_bytes_total",
     # observability tier (docs/design/observability.md)
     "trace_spans_total", "trace_spans_dropped", "flight_dumps_total",
+    # spot-instance churn (docs/design/churn.md)
+    "preempt_notices_total", "preempt_drain_deferrals_total",
+    "preempt_deadline_expired_total", "graceful_exits_total",
+    "prejoin_heals_total", "joins_coalesced_total",
+    "reconfigures_per_min",
 ])
 
 # String-valued diagnostics, SPLIT from the numeric dict at the source

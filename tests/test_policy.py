@@ -221,7 +221,7 @@ class TestPolicyController:
         assert sig.comm_frac > 0.0
         assert set(sig.as_dict()) == {
             "failures_in_window", "window", "failure_rate",
-            "comm_frac", "quiet_boundaries"}
+            "comm_frac", "quiet_boundaries", "churn_rate"}
 
 
 # -------------------------------------------------------------- int8 wire

@@ -18,7 +18,7 @@ from torchft_tpu._native import (
     StoreClient,
 )
 from torchft_tpu.chaos import (ChaosCommunicator, ChaosSchedule,
-                               EndpointChaos)
+                               ChurnOrchestrator, EndpointChaos)
 from torchft_tpu.checkpointing import CheckpointServer
 from torchft_tpu.checkpoint_io import AsyncCheckpointer
 from torchft_tpu.retry import (RetryError, RetryPolicy, RetryStats,
@@ -38,7 +38,7 @@ from torchft_tpu.data import (BatchIterator, DistributedSampler,
 from torchft_tpu.degraded import DegradedModeDriver, live_devices
 from torchft_tpu.local_sgd import (DiLoCoTrainer, StreamingDiLoCoTrainer,
                                    diloco_outer_optimizer)
-from torchft_tpu.manager import Manager, WorldSizeMode
+from torchft_tpu.manager import Manager, PreemptedExit, WorldSizeMode
 from torchft_tpu.optim import (DelayedOptimizer, FTOptimizer,
                                OptimizerWrapper)
 from torchft_tpu.policy import (LADDER, POLICIES, AdaptiveTrainer,
@@ -63,6 +63,7 @@ __all__ = [
     "PolicySignals",
     "ChaosCommunicator",
     "ChaosSchedule",
+    "ChurnOrchestrator",
     "CheckpointServer",
     "EndpointChaos",
     "RetryError",
@@ -95,6 +96,7 @@ __all__ = [
     "ManagerClient",
     "ManagerServer",
     "OptimizerWrapper",
+    "PreemptedExit",
     "PublicationServer",
     "QuorumResult",
     "StaleWeightsError",

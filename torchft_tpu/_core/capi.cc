@@ -49,7 +49,7 @@ void* tft_lighthouse_new(const char* bind, uint64_t min_replicas,
                          int64_t eviction_staleness_factor,
                          const char* auth_token, int32_t fast_path,
                          const char* standby_of, int64_t replicate_ms,
-                         char** err) {
+                         int64_t join_window_ms, char** err) {
   try {
     LighthouseOpt opt;
     opt.bind = bind;
@@ -63,6 +63,7 @@ void* tft_lighthouse_new(const char* bind, uint64_t min_replicas,
     opt.fast_path = fast_path != 0;
     opt.standby_of = standby_of ? standby_of : "";
     opt.replicate_ms = replicate_ms;
+    opt.join_window_ms = join_window_ms;
     return new Lighthouse(opt);
   } catch (const std::exception& e) {
     fail(err, e.what());
@@ -110,6 +111,10 @@ void tft_manager_set_status(void* h, const char* metrics_json,
   ((ManagerServer*)h)->set_status(metrics_json, heal_count, committed_steps,
                                   aborted_steps);
 }
+
+void tft_manager_farewell(void* h) { ((ManagerServer*)h)->farewell(); }
+
+void tft_manager_hard_stop(void* h) { ((ManagerServer*)h)->hard_stop(); }
 
 int64_t tft_manager_lighthouse_redials(void* h) {
   return ((ManagerServer*)h)->lighthouse_redials();

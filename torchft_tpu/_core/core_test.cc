@@ -1069,6 +1069,121 @@ static void test_manager_lighthouse_failover() {
          (long long)m_b.lighthouse_redials());
 }
 
+static StatusResponse fetch_status(const std::string& lh_addr) {
+  RpcClient c(lh_addr, 2'000);
+  std::string resp, err;
+  assert(c.call(kLighthouseStatus, StatusRequest().SerializeAsString(),
+                &resp, &err, 2'000));
+  StatusResponse st;
+  assert(st.ParseFromString(resp));
+  return st;
+}
+
+// Join-coalescing window (docs/design/churn.md): joiners arriving within
+// join_window_ms of the round's first joiner are admitted as ONE
+// membership delta — one quorum_id bump for the storm, counted in
+// joins_coalesced — instead of one slow round + reconfigure per joiner.
+static void test_join_coalescing_window() {
+  LighthouseOpt lopt;
+  lopt.bind = "127.0.0.1:0";
+  lopt.min_replicas = 1;
+  lopt.join_timeout_ms = 100;  // would cut per joiner without the window
+  lopt.quorum_tick_ms = 10;
+  lopt.heartbeat_fresh_ms = 300;
+  lopt.join_window_ms = 500;
+  Lighthouse lh(lopt);
+
+  // Round 1: solo {a}.
+  LighthouseQuorumResponse r1 = join_beat(lh.address(), "a", 1);
+  assert(r1.quorum().participants_size() == 1);
+
+  // Join storm: b lands, then c 150ms later (past join_timeout_ms — a
+  // window-less lighthouse would already have cut b's round), then a
+  // re-joins. All three must land in ONE quorum with ONE id bump.
+  LighthouseQuorumResponse rb, rc, ra;
+  announce_beat(lh.address(), "b");
+  announce_beat(lh.address(), "c");
+  int64_t t0 = now_ms();
+  std::thread tb([&] { rb = join_beat(lh.address(), "b", 1); });
+  usleep(150'000);
+  std::thread tc([&] { rc = join_beat(lh.address(), "c", 1); });
+  usleep(50'000);
+  ra = join_beat(lh.address(), "a", 2);
+  tb.join();
+  tc.join();
+  int64_t waited = now_ms() - t0;
+  assert(ra.quorum().participants_size() == 3);
+  assert(rb.quorum().participants_size() == 3);
+  assert(rc.quorum().participants_size() == 3);
+  assert(ra.quorum().quorum_id() == r1.quorum().quorum_id() + 1);
+  assert(rb.quorum().quorum_id() == ra.quorum().quorum_id());
+  // The window actually held the cut open (b arrived at t0; without the
+  // window the 100ms join_timeout cuts before c's +150ms arrival).
+  assert(waited >= 300);
+  // Observable: one joiner beyond the first coalesced into the delta.
+  assert(fetch_status(lh.address()).joins_coalesced() == 1);
+
+  // Steady state resumes fast over the grown membership; a lone LEAVE is
+  // not held by the window (only additive deltas coalesce).
+  assert(join_beat(lh.address(), "a", 3).fast_path());
+  announce_beat(lh.address(), "c", false, /*leaving=*/true);
+  int64_t t1 = now_ms();
+  LighthouseQuorumResponse r4a, r4b;
+  std::thread tb2([&] { r4b = join_beat(lh.address(), "b", 4); });
+  r4a = join_beat(lh.address(), "a", 4);
+  tb2.join();
+  assert(r4a.quorum().participants_size() == 2);
+  assert(now_ms() - t1 < 450);  // farewell cut, not window-held
+  printf("test_join_coalescing_window ok (storm held %lldms)\n",
+         (long long)waited);
+}
+
+// Regression (churn satellite): a farewell arriving while the fast path
+// is armed must invalidate the cached decision BEFORE it is served — the
+// next request must take the slow path and exclude the leaver, never be
+// handed a cached membership naming it (which would abort the requester's
+// next collective: the exact failure the graceful drain exists to avoid).
+static void test_farewell_invalidates_fast_path_cache() {
+  LighthouseOpt lopt;
+  lopt.bind = "127.0.0.1:0";
+  lopt.min_replicas = 1;
+  lopt.join_timeout_ms = 5'000;  // must NOT gate: the farewell path does
+  lopt.quorum_tick_ms = 10;
+  lopt.heartbeat_fresh_ms = 200;
+  lopt.eviction_staleness_factor = 3;
+  Lighthouse lh(lopt);
+
+  LighthouseQuorumResponse r1a, r1b;
+  std::thread t1([&] { r1a = join_beat(lh.address(), "a", 1); });
+  std::thread t2([&] { r1b = join_beat(lh.address(), "b", 1); });
+  t1.join();
+  t2.join();
+  assert(r1a.quorum().participants_size() == 2);
+  // Fast path armed: beats fresh, membership settled.
+  assert(join_beat(lh.address(), "a", 2).fast_path());
+  assert(join_beat(lh.address(), "b", 2).fast_path());
+
+  // b drains gracefully: farewell, then silence (the drained manager's
+  // heartbeat loop goes quiet and it never re-joins).
+  announce_beat(lh.address(), "b", false, /*leaving=*/true);
+
+  // a's very next round: the cached {a,b} decision must NOT be served.
+  // The slow path forms {a} via the farewell's fast-eviction proof —
+  // bounded far below join_timeout — and a's subsequent rounds ride the
+  // re-armed solo cache. Zero rounds in between may name b.
+  int64_t t0 = now_ms();
+  LighthouseQuorumResponse r3 = join_beat(lh.address(), "a", 3);
+  int64_t waited = now_ms() - t0;
+  assert(!r3.fast_path());
+  assert(r3.quorum().participants_size() == 1);
+  assert(r3.quorum().participants(0).replica_id() == "a");
+  assert(r3.quorum().quorum_id() == r1a.quorum().quorum_id() + 1);
+  assert(waited < 2'000);  // farewell-proof eviction, not join_timeout
+  assert(join_beat(lh.address(), "a", 4).fast_path());
+  printf("test_farewell_invalidates_fast_path_cache ok (%lldms)\n",
+         (long long)waited);
+}
+
 int main() {
   test_quorum_changed();
   test_store();
@@ -1086,6 +1201,8 @@ int main() {
   test_fast_path_invalidation_joiner();
   test_fast_path_invalidation_farewell_min_replicas();
   test_fast_vs_slow_identical_decisions();
+  test_join_coalescing_window();
+  test_farewell_invalidates_fast_path_cache();
   test_standby_replication_and_promotion();
   test_manager_lighthouse_failover();
   printf("ALL CORE TESTS PASSED\n");

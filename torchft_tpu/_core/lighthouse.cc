@@ -21,7 +21,12 @@ void BeatTable::record(const std::string& id, int64_t now, bool joining,
   b.heal_count = heal_count;
   b.committed_steps = committed;
   b.aborted_steps = aborted;
-  s.departed.erase(id);  // back from the dead
+  // Back from the dead — a membership-relevant transition like the
+  // departure itself, so it bumps the same sequence the fast path's
+  // serve-time recheck reads (a revival racing a serve is the mirror
+  // image of a farewell racing one).
+  if (s.departed.erase(id) > 0)
+    departed_seq_.fetch_add(1, std::memory_order_release);
 }
 
 void BeatTable::adopt(const std::string& id, int64_t last_ms,
@@ -49,6 +54,7 @@ void BeatTable::adopt_departed(const std::string& id, int64_t departed_ms) {
   }
   int64_t& d = s.departed[id];
   d = std::max(d, departed_ms);
+  departed_seq_.fetch_add(1, std::memory_order_release);
 }
 
 void BeatTable::farewell(const std::string& id, int64_t now) {
@@ -56,12 +62,14 @@ void BeatTable::farewell(const std::string& id, int64_t now) {
   std::lock_guard<std::mutex> lk(s.mu);
   s.beats.erase(id);
   s.departed[id] = now;
+  departed_seq_.fetch_add(1, std::memory_order_release);
 }
 
 void BeatTable::revive(const std::string& id) {
   Shard& s = shard_for(id);
   std::lock_guard<std::mutex> lk(s.mu);
-  s.departed.erase(id);
+  if (s.departed.erase(id) > 0)
+    departed_seq_.fetch_add(1, std::memory_order_release);
 }
 
 bool BeatTable::lookup(const std::string& id, Beat* out) const {
@@ -215,6 +223,8 @@ std::string Lighthouse::status_json(const StatusResponse& r) {
                     std::to_string(r.slow_path_served()) +
                     ",\"slow_path_rounds\":" +
                     std::to_string(r.slow_path_rounds()) +
+                    ",\"joins_coalesced\":" +
+                    std::to_string(r.joins_coalesced()) +
                     ",\"fast_path_eligible\":" +
                     (r.fast_path_eligible() ? "true" : "false") +
                     ",\"is_standby\":" + (r.is_standby() ? "true" : "false") +
@@ -280,6 +290,27 @@ bool Lighthouse::quorum_valid_locked() const {
         break;
       }
     }
+  }
+  // Join-coalescing window (docs/design/churn.md): a JOINER in the
+  // forming round holds the cut open for join_window_ms from the first
+  // joiner's arrival, so a storm of replacements is admitted as ONE
+  // membership delta (one quorum_id bump, one ring reconfigure) instead
+  // of one per joiner. Placed BEFORE the fast-quorum cut — with all
+  // previous members re-joined plus one joiner, all_present would
+  // otherwise cut instantly on the first arrival. Only additive deltas
+  // are held: a round with no joiner (shrink / unchanged) never enters
+  // this branch, so farewell/eviction latency is untouched.
+  if (opt_.join_window_ms > 0 && has_prev_quorum_ && first_joiner_ms_ > 0 &&
+      now - first_joiner_ms_ < opt_.join_window_ms) {
+    bool any_new = false;
+    for (const auto& [pid, j] : participants_) {
+      (void)j;
+      if (!prev_ids_.count(pid)) {
+        any_new = true;
+        break;
+      }
+    }
+    if (any_new) return false;
   }
   if (has_prev_quorum_ && !pending_alive) {
     // Fast quorum: every member of the previous quorum has re-joined AND
@@ -405,6 +436,17 @@ bool Lighthouse::tick() {
   // iteration order), mirrors reference :175. Replica ranks derive from it.
   for (const auto& [id, joiner] : participants_)
     *q.add_participants() = joiner.member;
+  // Join-coalescing accounting: joiners admitted by this cut beyond the
+  // first of their round rode an already-open window — each is one
+  // reconfigure the fleet did NOT pay (docs/design/churn.md).
+  if (has_prev_quorum_) {
+    int64_t new_members = 0;
+    for (const auto& [id, joiner] : participants_) {
+      (void)joiner;
+      if (!prev_ids_.count(id)) new_members++;
+    }
+    if (new_members > 1) joins_coalesced_ += new_members - 1;
+  }
   if (!has_prev_quorum_ || quorum_changed(prev_quorum_, q)) quorum_id_++;
   q.set_quorum_id(quorum_id_);
   q.set_created_unix_ms(
@@ -424,6 +466,7 @@ bool Lighthouse::tick() {
   slow_path_rounds_++;
   participants_.clear();
   first_join_ms_ = 0;
+  first_joiner_ms_ = 0;
   broadcast_seq_++;
   cv_.notify_all();
   return true;
@@ -477,7 +520,18 @@ bool Lighthouse::handle_quorum(const LighthouseQuorumRequest& r,
   if (r.has_beat() && !r.beat().replica_id().empty()) record_beat(r.beat());
 
   std::unique_lock<std::mutex> lk(mu_);
-  if (fast_eligible_locked(me.replica_id(), me.step())) {
+  // Farewell-vs-serve race guard: beats (and farewells) land in the
+  // lock-striped BeatTable WITHOUT the quorum mutex, so a farewell can
+  // arrive between the eligibility check below and the serve. Snapshot
+  // the departure counter first and re-read it before answering — a
+  // cached decision naming a member that just said goodbye must never be
+  // served (the requester would run its next collective against a peer
+  // that is exiting: the exact vote abort the graceful-drain protocol
+  // exists to prevent). A farewell landing after the re-read is
+  // indistinguishable from one landing after the response hit the wire.
+  int64_t dseq = beats_.departed_seq();
+  if (fast_eligible_locked(me.replica_id(), me.step()) &&
+      beats_.departed_seq() == dseq) {
     // FAST PATH: membership is settled and everyone is provably alive —
     // serve the cached decision with this member's registration refreshed
     // and a bumped epoch. No tick-loop park, no fan-in barrier, and the
@@ -501,6 +555,10 @@ bool Lighthouse::handle_quorum(const LighthouseQuorumRequest& r,
 
   // SLOW PATH: the reference rendezvous — park until the round cuts.
   if (participants_.empty()) first_join_ms_ = now_ms();
+  // First JOINER (not a previous member) opens the coalescing window.
+  if (has_prev_quorum_ && first_joiner_ms_ == 0 &&
+      !prev_ids_.count(me.replica_id()))
+    first_joiner_ms_ = now_ms();
   participants_[me.replica_id()] = {me, now_ms()};
   // A join is proof of life: clear any stale farewell from a previous
   // incarnation of this id, or fast eviction would treat the live,
@@ -742,6 +800,7 @@ void Lighthouse::status_locked(StatusResponse* out) const {
   out->set_fast_path_hits(fast_path_hits_);
   out->set_slow_path_served(slow_path_served_);
   out->set_slow_path_rounds(slow_path_rounds_);
+  out->set_joins_coalesced(joins_coalesced_);
   out->set_standby_address(standby_addr_);
   out->set_is_standby(!promoted_.load());
   out->set_fast_path_eligible(

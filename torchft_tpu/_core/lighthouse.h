@@ -91,6 +91,15 @@ struct LighthouseOpt {
   // unreplicated fast-path serves).
   std::string standby_of;
   int64_t replicate_ms = 100;
+  // Join-coalescing window (docs/design/churn.md): once a JOINER (a
+  // participant not in the previous quorum) lands in a forming round, the
+  // cut is held open for this long from the first joiner's arrival so a
+  // join storm is admitted as ONE membership delta — reconfigures then
+  // scale with windows, not joiners. Only additive deltas are held:
+  // shrinks (farewell / eviction) cut on their normal schedule, and the
+  // window also caps the extra latency a lone joiner pays. 0 (default)
+  // disables: every joiner cuts its own round (pre-churn behavior).
+  int64_t join_window_ms = 0;
 };
 
 // Sharded liveness table: beat writes (the per-member hot path — 64+ clients
@@ -119,6 +128,14 @@ class BeatTable {
   // beat the standby heard directly after the snapshot was taken.
   void adopt_departed(const std::string& id, int64_t departed_ms);
   void farewell(const std::string& id, int64_t now);
+  // Monotonic count of departure recordings (farewell / adopt_departed).
+  // The fast path snapshots it before its eligibility check and re-reads
+  // it before serving: a farewell landing in between (beats are lock-
+  // striped, NOT under the quorum mutex) would otherwise be served a
+  // cached membership naming the leaver — see handle_quorum.
+  int64_t departed_seq() const {
+    return departed_seq_.load(std::memory_order_acquire);
+  }
   // Visit every farewell record (for replication).
   void for_each_departed(
       const std::function<void(const std::string&, int64_t)>& fn) const;
@@ -149,6 +166,7 @@ class BeatTable {
     return shards_[std::hash<std::string>{}(id) % kShards];
   }
   std::array<Shard, kShards> shards_;
+  std::atomic<int64_t> departed_seq_{0};
 };
 
 class Lighthouse {
@@ -231,6 +249,12 @@ class Lighthouse {
   int64_t fast_path_hits_ = 0;
   int64_t slow_path_served_ = 0;
   int64_t slow_path_rounds_ = 0;
+  // Join-coalescing state (docs/design/churn.md): when the first JOINER
+  // (non-previous-member) of the forming round arrived (0 = none), and
+  // the running count of joiners admitted beyond the first of their
+  // round — the "reconfigures grow with windows, not joiners" observable.
+  int64_t first_joiner_ms_ = 0;
+  int64_t joins_coalesced_ = 0;
   // Previous-quorum membership as a set (updated at each formation /
   // adoption); lets the fast path and beat handling test membership without
   // scanning the proto.

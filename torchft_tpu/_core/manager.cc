@@ -124,20 +124,51 @@ std::string ManagerServer::address() const {
 
 void ManagerServer::shutdown() {
   std::shared_ptr<RpcClient> inflight;
-  std::string lh_addr;
   {
     std::lock_guard<std::mutex> lk(mu_);
     if (shutdown_) return;
     shutdown_ = true;
     inflight = lighthouse_inflight_;
-    lh_addr = current_lighthouse_locked();
   }
   if (inflight) inflight->cancel();
   cv_.notify_all();
   if (heartbeat_thread_.joinable()) heartbeat_thread_.join();
   // Farewell beat: clears this replica's liveness record so survivors'
   // next quorum cut is not deferred by our still-fresh heartbeats (clean
-  // shutdowns say goodbye; crashes rely on staleness). Best-effort.
+  // shutdowns say goodbye; crashes rely on staleness). Best-effort; a
+  // graceful preemption drain already sent it via farewell() (idempotent).
+  farewell();
+  server_->shutdown();
+}
+
+void ManagerServer::hard_stop() {
+  {
+    // Setting farewell_sent_ BEFORE shutdown suppresses the goodbye a
+    // clean shutdown would send: survivors must observe exactly what a
+    // SIGKILL leaves behind — silence, then staleness.
+    std::lock_guard<std::mutex> lk(mu_);
+    farewell_sent_ = true;
+  }
+  shutdown();
+}
+
+void ManagerServer::farewell() {
+  std::string lh_addr;
+  {
+    std::unique_lock<std::mutex> lk(mu_);
+    if (farewell_sent_) return;
+    farewell_sent_ = true;  // also silences the heartbeat loop
+    // Serialize against an in-flight periodic beat: it was sent outside
+    // mu_ and may land at the lighthouse AFTER our leaving beat,
+    // erasing the departed record ("back from the dead") — the drained
+    // leaver would look alive and the fast path could serve a cached
+    // membership naming it. The beat RPC has a 1s deadline; bound the
+    // wait a little above it so a wedged transport cannot stall the
+    // drain (worst case the race degrades to staleness eviction).
+    cv_.wait_for(lk, std::chrono::milliseconds(1'500),
+                 [this] { return !beat_inflight_; });
+    lh_addr = current_lighthouse_locked();
+  }
   try {
     RpcClient c(lh_addr, 1'000);
     LighthouseHeartbeatRequest r;
@@ -147,7 +178,6 @@ void ManagerServer::shutdown() {
     c.call(kLighthouseHeartbeat, r.SerializeAsString(), &resp, &err, 1'000);
   } catch (...) {
   }
-  server_->shutdown();
 }
 
 void ManagerServer::heartbeat_loop() {
@@ -172,6 +202,9 @@ void ManagerServer::heartbeat_loop() {
       std::unique_lock<std::mutex> lk(mu_);
       cv_.wait_for(lk, std::chrono::milliseconds(opt_.heartbeat_ms));
       if (shutdown_) return;
+      // After a farewell (graceful drain), beating again would revive
+      // the departed record and stall survivors' fast eviction.
+      if (farewell_sent_) continue;
       joining = quorum_inflight_ > 0;
       heals = heal_count_;
       committed = committed_steps_;
@@ -184,6 +217,13 @@ void ManagerServer::heartbeat_loop() {
     }
     if (last_ok > 0 && now_ms() - last_ok < cadence)
       continue;  // a beat (possibly piggybacked on a quorum RPC) is recent
+    {
+      // Marked in flight so farewell() can order its leaving beat AFTER
+      // this one (see manager.h beat_inflight_).
+      std::lock_guard<std::mutex> lk(mu_);
+      if (farewell_sent_) continue;
+      beat_inflight_ = true;
+    }
     try {
       if (!client || client->address() != addr) {
         client.reset();
@@ -206,6 +246,11 @@ void ManagerServer::heartbeat_loop() {
     } catch (...) {
       client.reset();
     }
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      beat_inflight_ = false;
+    }
+    cv_.notify_all();
     // Deliberately NO rotation from this loop: beats are best-effort, and
     // this 1s deadline trips on a primary that is merely stalled. Only
     // the quorum path (5s deadline, the RPC that actually matters)

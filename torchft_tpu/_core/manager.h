@@ -55,6 +55,22 @@ class ManagerServer {
   std::string address() const;
   void shutdown();
 
+  // Graceful preemption drain (docs/design/churn.md): send the leaving
+  // beat to the lighthouse NOW, without shutting the server down — the
+  // draining Python Manager farewells FIRST (so survivors' next quorum
+  // round cuts the shrunken membership immediately) and then finishes
+  // its final save/withdrawal locally before the full shutdown().
+  // Idempotent; also silences the heartbeat loop so a later periodic
+  // beat cannot revive the departed record. Best-effort like the
+  // shutdown farewell (a lost farewell degrades to staleness eviction).
+  void farewell();
+
+  // SIGKILL simulation for churn benches/soaks: stop serving and beating
+  // WITHOUT the farewell (a real SIGKILL sends none), so survivors pay
+  // the staleness-eviction path — the honest control leg for the
+  // graceful-drain A/B. Production code never calls this.
+  void hard_stop();
+
   // Operator-facing status push (VERDICT r3 missing #3): the Python
   // Manager's per-step state machine owns the interesting metrics
   // (quorum/heal/allreduce timings, commit counts); it pushes a JSON
@@ -86,6 +102,17 @@ class ManagerServer {
   mutable std::mutex mu_;
   std::condition_variable cv_;
   bool shutdown_ = false;
+  // The farewell has been sent: the heartbeat loop stops beating (a beat
+  // after the farewell would erase the departed record and make fast
+  // eviction wait out the grace window for a group that cleanly left).
+  bool farewell_sent_ = false;
+  // A periodic beat RPC is in flight (sent outside mu_ — it can take up
+  // to its 1s deadline). farewell() waits for it to land before sending
+  // the leaving beat: a stale beat arriving AFTER the farewell would
+  // erase the departed record at the lighthouse, making the drained
+  // leaver look alive again and re-arming the fast path with a cached
+  // membership that names it.
+  bool beat_inflight_ = false;
 
   // Barrier round for quorum: all world_size local ranks must arrive; the
   // completing rank performs the lighthouse RPC for the group. The response

@@ -98,7 +98,8 @@ def _load() -> ctypes.CDLL:
     lib.tft_free.restype = None
 
     lib.tft_lighthouse_new.argtypes = [c, u64, i64, i64, i64, i64, i64, c,
-                                       i32, c, i64, ctypes.POINTER(vp)]
+                                       i32, c, i64, i64,
+                                       ctypes.POINTER(vp)]
     lib.tft_lighthouse_new.restype = vp
     lib.tft_lighthouse_address.argtypes = [vp]
     lib.tft_lighthouse_address.restype = vp
@@ -114,6 +115,10 @@ def _load() -> ctypes.CDLL:
     lib.tft_manager_free.argtypes = [vp]
     lib.tft_manager_set_status.argtypes = [vp, c, i64, i64, i64]
     lib.tft_manager_set_status.restype = None
+    lib.tft_manager_farewell.argtypes = [vp]
+    lib.tft_manager_farewell.restype = None
+    lib.tft_manager_hard_stop.argtypes = [vp]
+    lib.tft_manager_hard_stop.restype = None
     lib.tft_manager_lighthouse_redials.argtypes = [vp]
     lib.tft_manager_lighthouse_redials.restype = i64
     lib.tft_manager_lighthouse_addr.argtypes = [vp]
@@ -221,7 +226,8 @@ class Lighthouse:
                  auth_token: str = "",
                  fast_path: bool = True,
                  standby_of: str = "",
-                 replicate_ms: int = 100):
+                 replicate_ms: int = 100,
+                 join_window_ms: int = 0):
         """``heartbeat_fresh_ms``/``heartbeat_grace_factor``: a previous
         member absent from the join round but heartbeating within
         ``heartbeat_fresh_ms`` extends the straggler wait to
@@ -252,7 +258,14 @@ class Lighthouse:
         lighthouse at this address — replicate its quorum state every
         ``replicate_ms``, refuse Quorum RPCs until the primary is provably
         dead, then promote and serve the same membership under the SAME
-        quorum_id so managers re-dial mid-step without a ring rebuild."""
+        quorum_id so managers re-dial mid-step without a ring rebuild.
+
+        ``join_window_ms``: join-coalescing window
+        (docs/design/churn.md) — once a joiner lands in a forming
+        round, the cut holds open this long from the first joiner's
+        arrival so a join storm is admitted as ONE membership delta
+        (reconfigures scale with windows, not joiners; the
+        ``joins_coalesced`` status counter observes it). 0 disables."""
         err = ctypes.c_void_p()
         self._h = _check_handle(
             lib().tft_lighthouse_new(bind.encode(), min_replicas,
@@ -263,6 +276,7 @@ class Lighthouse:
                                      auth_token.encode(),
                                      1 if fast_path else 0,
                                      standby_of.encode(), replicate_ms,
+                                     join_window_ms,
                                      ctypes.byref(err)), err)
 
     def address(self) -> str:
@@ -326,6 +340,23 @@ class ManagerServer:
     def lighthouse_addr(self) -> str:
         """The lighthouse endpoint currently dialed (observability)."""
         return _take_str(lib().tft_manager_lighthouse_addr(self._h))
+
+    def farewell(self) -> None:
+        """Send the quorum farewell (leaving beat) NOW, without shutting
+        the server down — the graceful preemption drain's first act
+        (docs/design/churn.md): survivors' next quorum round then cuts
+        the shrunken membership immediately instead of waiting out
+        heartbeat staleness. Idempotent; also silences this manager's
+        heartbeat loop so a later beat cannot revive the departed
+        record. ``shutdown()`` still sends it for clean non-drain exits."""
+        lib().tft_manager_farewell(self._h)
+
+    def hard_stop(self) -> None:
+        """SIGKILL simulation (churn benches/soaks only): stop serving
+        and beating WITHOUT the farewell, so survivors pay the
+        staleness-eviction path — the honest control leg of the
+        graceful-drain A/B."""
+        lib().tft_manager_hard_stop(self._h)
 
     def shutdown(self) -> None:
         if self._h:

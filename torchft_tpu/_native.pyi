@@ -22,6 +22,7 @@ class Lighthouse:
         fast_path: bool = ...,
         standby_of: str = ...,
         replicate_ms: int = ...,
+        join_window_ms: int = ...,
     ) -> None: ...
     def address(self) -> str: ...
     def status(self, timeout_ms: int = ...) -> dict: ...
@@ -48,6 +49,8 @@ class ManagerServer:
     ) -> None: ...
     def lighthouse_redials(self) -> int: ...
     def lighthouse_addr(self) -> str: ...
+    def farewell(self) -> None: ...
+    def hard_stop(self) -> None: ...
     def shutdown(self) -> None: ...
 
 class Store:

@@ -328,9 +328,13 @@ class ChaosSchedule:
             self._dead[endpoint] = True
 
     def revive_endpoint(self, endpoint: str) -> None:
-        """Clear a dead latch (a donor "restarted")."""
+        """Clear a dead latch (a donor "restarted"). The streamed-byte
+        account resets with it: a ``kill_after_bytes`` threshold is per
+        incarnation, so a replacement reusing the address gets the full
+        allowance instead of dying on its first byte."""
         with self._lock:
             self._dead.pop(endpoint, None)
+            self._bytes.pop(endpoint, None)
 
     def is_dead(self, endpoint: str) -> bool:
         with self._lock:
@@ -484,6 +488,27 @@ def active() -> Optional[ChaosSchedule]:
                 _installed = parse_spec(spec)
             _env_checked = True
     return _installed
+
+
+def endpoint_reborn(*endpoints: str) -> None:
+    """A fresh server just bound at these chaos endpoints: clear any
+    dead latch a PREVIOUS process at the same address left behind.
+
+    The kill latches (``heal:<host:port>`` / ``serve:<host:port>``)
+    model a dead process by address — but under churn a *replacement*
+    legitimately reuses a dead member's host:port, and without this
+    hook it would inherit the corpse's latch: every dial refused
+    forever, which reads as "the replacement never came back" when it
+    demonstrably did. Servers call this at bind time
+    (:class:`~torchft_tpu.checkpointing.CheckpointServer`,
+    :class:`~torchft_tpu.serving.PublicationServer`); no-op without an
+    active schedule. The endpoint's ``kill_rate``/``kill_after_bytes``
+    faults stay armed — rebirth clears the latch, not the regime."""
+    sched = active()
+    if sched is None:
+        return
+    for e in endpoints:
+        sched.revive_endpoint(e)
 
 
 # ------------------------------------------------------------ RPC shims
@@ -968,3 +993,126 @@ class ChaosCommunicator(Communicator):
 
     def shutdown(self) -> None:
         self._comm.shutdown()
+
+
+# ------------------------------------------------------ churn orchestration
+
+
+class ChurnOrchestrator:
+    """Seeded Poisson preemption driver for churn soaks
+    (docs/design/churn.md): the spot/preemptible operating regime —
+    groups are reclaimed continuously (a mix of *graceful* 2-minute
+    notices and outright SIGKILLs) and cold replacements come back
+    after a respawn delay — reduced to a deterministic event stream.
+
+    Pure scheduling logic, no IO: the harness supplies callbacks and
+    drives :meth:`tick` with its own clock (wall time in a soak, a
+    simulated clock in unit tests — same seed + same tick times ⇒ the
+    identical event trace, which is what makes a churn soak
+    debuggable).
+
+    Args:
+        seed: event-stream seed (victim choice, graceful-vs-kill coin,
+            Poisson inter-arrival draws).
+        groups: initial live group ids.
+        rate_per_min: expected preemptions per minute across the fleet
+            (the Poisson intensity; as a fraction of an N-group fleet
+            this is ``rate_per_min / N`` per minute — the bench's
+            "%/min" knob). :meth:`set_rate` moves it live
+            (:class:`~torchft_tpu.policy.PhasedChaos`-style phases).
+        graceful_frac: probability a preemption is a *noticed* reclaim
+            (the ``notify`` callback — e.g. ``request_preemption``)
+            instead of a hard kill (``kill``).
+        notify / kill / replace: callbacks taking the group id; any may
+            be None (the event is still drawn and recorded, keeping
+            the stream identical across A/B legs that wire different
+            callbacks).
+        replace_delay_s: cold-replacement respawn delay; ``replace``
+            fires once the delay elapses. Negative = never replace.
+        min_live: never preempt below this many live groups (the soak
+            must keep a survivor to measure).
+    """
+
+    def __init__(self, seed: int, groups: Any, rate_per_min: float,
+                 graceful_frac: float = 0.5,
+                 notify: Optional[Any] = None,
+                 kill: Optional[Any] = None,
+                 replace: Optional[Any] = None,
+                 replace_delay_s: float = 0.0,
+                 min_live: int = 1) -> None:
+        self._rng = random.Random(f"churn:{seed}")
+        self.live = set(groups)
+        self.dead: Dict[Any, float] = {}  # gid -> respawn due time
+        self._rate = float(rate_per_min)
+        self.graceful_frac = float(graceful_frac)
+        self._notify, self._kill, self._replace = notify, kill, replace
+        self.replace_delay_s = float(replace_delay_s)
+        self.min_live = int(min_live)
+        self._next: Optional[float] = None  # next preemption due time
+        self.events: List[tuple] = []  # (t, kind, gid) trace
+        self.notices = 0
+        self.kills = 0
+        self.replacements = 0
+        self.skipped_min_live = 0
+
+    def set_rate(self, rate_per_min: float) -> None:
+        """Move the Poisson intensity live (phase walker hook). The
+        next inter-arrival is re-drawn at the new rate from the next
+        tick, so a storm phase takes effect within one tick."""
+        if float(rate_per_min) != self._rate:
+            self._rate = float(rate_per_min)
+            self._next = None  # re-draw at the new intensity
+
+    def _draw_next(self, now: float) -> Optional[float]:
+        if self._rate <= 0.0:
+            return None
+        # Exponential inter-arrival (Poisson process), minutes -> s.
+        return now + self._rng.expovariate(self._rate / 60.0)
+
+    def tick(self, now: float) -> List[tuple]:
+        """Process every event due by ``now``; returns the actions
+        fired this tick as ``(t, kind, gid)`` with kind in
+        ``notice | kill | replace | skip``."""
+        fired: List[tuple] = []
+        # Respawns first: a replacement coming back is what keeps the
+        # fleet from draining to min_live and starving the stream.
+        for gid in sorted(self.dead, key=str):
+            due = self.dead[gid]
+            if due <= now:
+                del self.dead[gid]
+                self.live.add(gid)
+                self.replacements += 1
+                fired.append((now, "replace", gid))
+                if self._replace is not None:
+                    self._replace(gid)
+        if self._next is None:
+            self._next = self._draw_next(now)
+        while self._next is not None and self._next <= now:
+            t = self._next
+            self._next = self._draw_next(t)
+            # Draw victim + coin even when the event must be skipped:
+            # the stream stays identical across legs and rate regimes.
+            pool = sorted(self.live, key=str)
+            if not pool:
+                continue
+            gid = self._rng.choice(pool)
+            graceful = self._rng.random() < self.graceful_frac
+            if len(self.live) <= self.min_live:
+                self.skipped_min_live += 1
+                fired.append((t, "skip", gid))
+                continue
+            self.live.discard(gid)
+            if self.replace_delay_s >= 0.0:
+                self.dead[gid] = t + self.replace_delay_s
+            if graceful:
+                self.notices += 1
+                fired.append((t, "notice", gid))
+                if self._notify is not None:
+                    self._notify(gid)
+            else:
+                self.kills += 1
+                fired.append((t, "kill", gid))
+                if self._kill is not None:
+                    self._kill(gid)
+        self.events.extend(fired)
+        return fired

@@ -619,6 +619,9 @@ class CheckpointServer:
             like the reference (checkpointing.py serves 0.0.0.0); set to an
             internal/VPC address on shared networks — this server streams
             full model weights to anyone who can connect.
+        bind_port: port to listen on (default 0 = OS-assigned). A churn
+            replacement can pin its predecessor's port so advertised
+            addresses stay dialable across the respawn.
         auth_token: when set, every GET must carry
             ``Authorization: Bearer <token>`` or is refused with 401.
             Healers send it automatically when the Manager is constructed
@@ -629,7 +632,8 @@ class CheckpointServer:
                  send_timeout_sec: float = 120.0,
                  lock_streaming: bool = False,
                  bind_host: str = "0.0.0.0",
-                 auth_token: Optional[str] = None) -> None:
+                 auth_token: Optional[str] = None,
+                 bind_port: int = 0) -> None:
         self._state_fn = state_fn
         self._send_timeout_sec = send_timeout_sec
         self._lock_streaming = lock_streaming
@@ -784,11 +788,19 @@ class CheckpointServer:
                         srv._inflight -= 1
                         srv._cond.notify_all()
 
-        self._server = _CheckpointHTTPServer((bind_host, 0), Handler)
+        self._server = _CheckpointHTTPServer((bind_host, bind_port),
+                                             Handler)
         self._thread = threading.Thread(
             target=self._server.serve_forever, daemon=True,
             name="checkpoint-server")
         self._thread.start()
+        # A fresh server at this address is a REBIRTH for the chaos kill
+        # latches: a churn replacement reusing a dead member's host:port
+        # must not inherit the corpse's dead latch (chaos.endpoint_reborn
+        # is a no-op without an active schedule).
+        netloc = urllib.parse.urlparse(self.address()).netloc
+        if netloc:
+            chaos.endpoint_reborn(f"heal:{netloc}", f"serve:{netloc}")
 
     def _capture_locked(self) -> Tuple[Any, Any]:
         """State + plan to stream for the current step. Requires _cond held.
@@ -901,6 +913,14 @@ class CheckpointServer:
         are then served at ``/publish/*`` on this server's port, next to
         the heal endpoints — one socket, one auth gate, two protocols."""
         self._publication = publication
+
+    def detach_publication(self) -> None:
+        """Withdraw the publication tier (graceful preemption drain,
+        docs/design/churn.md): ``/publish/*`` returns 404 from the next
+        request on, which subscribers classify as a dead parent and
+        rotate away from — no one is steered at a group that is about
+        to exit."""
+        self._publication = None
 
     def publish_address(self) -> str:
         """Dialable base URL of the attached publication tier

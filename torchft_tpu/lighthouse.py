@@ -60,6 +60,13 @@ def main(argv: list[str] | None = None) -> None:
                         "rebuild)")
     parser.add_argument("--replicate-ms", type=int, default=100,
                         help="standby replication poll interval")
+    parser.add_argument("--join-window-ms", type=int, default=0,
+                        help="join-coalescing window "
+                        "(docs/design/churn.md): hold a forming round "
+                        "open this long from the first JOINER's arrival "
+                        "so a join storm is admitted as one membership "
+                        "delta — reconfigures scale with windows, not "
+                        "joiners (0 = cut per joiner)")
     parser.add_argument("--address-file", default="",
                         help="write the bound host:port to this file once "
                         "listening (for scripts/tests that bind port 0)")
@@ -78,6 +85,7 @@ def main(argv: list[str] | None = None) -> None:
         fast_path=not args.no_fast_path,
         standby_of=args.standby_of,
         replicate_ms=args.replicate_ms,
+        join_window_ms=args.join_window_ms,
     )
     if args.address_file:
         tmp = args.address_file + ".tmp"

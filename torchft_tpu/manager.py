@@ -37,6 +37,7 @@ from __future__ import annotations
 import json
 import logging
 import os
+import signal
 import socket
 import sys
 import threading
@@ -76,6 +77,15 @@ _POLICY_KEY: str = "torchft/policy"
 # keeps three decimal places of fraction resolution in integer weights.
 _CAPACITY_WEIGHT_SCALE = 10_000
 T = TypeVar("T")
+
+
+class PreemptedExit(RuntimeError):
+    """Raised by :meth:`Manager.step` once a graceful preemption drain
+    has completed (docs/design/churn.md): the manager has taken its
+    final durable save, withdrawn its heal/publish advertisements, said
+    farewell to the quorum, and shut down — the training loop must exit
+    (with status 0: this is the *noticed-reclaim success path*, not a
+    failure)."""
 
 
 class _LatencyReservoir:
@@ -635,6 +645,25 @@ class Manager:
             "policy_switch_deferrals": 0.0,
             "failure_rate": 0.0,
             "wire_quant_residual_bytes": 0.0,
+            # Spot-instance churn (docs/design/churn.md): preemption
+            # notices received (SIGTERM / request_preemption), drains
+            # deferred past a boundary (mid-heal / mid-deferred /
+            # errored / aborted — the save_durable refusal classes),
+            # graceful exits completed (farewell sent, ads withdrawn),
+            # reclaim deadlines that expired before the drain landed
+            # (degraded to hard-kill behavior + a flight dump), cold
+            # pre-join heals (join backpressure: the replacement healed
+            # BEFORE its first quorum join), and joiners this manager
+            # observed being admitted as one coalesced membership delta
+            # (world grew by >1 in a single reconfigure).
+            # reconfigures_per_min (ring rebuilds in the trailing
+            # 60 s) is computed at metrics() read time.
+            "preempt_notices_total": 0.0,
+            "preempt_drain_deferrals_total": 0.0,
+            "preempt_deadline_expired_total": 0.0,
+            "graceful_exits_total": 0.0,
+            "prejoin_heals_total": 0.0,
+            "joins_coalesced_total": 0.0,
         }
         self._metrics_lock = threading.Lock()
         if self._controller is not None:
@@ -707,6 +736,35 @@ class Manager:
         # every poisoned group independently computes the same prefix and
         # they re-mesh without any extra coordination channel.
         self._comm_poisoned = False
+        # --- graceful preemption drain (docs/design/churn.md) ------------
+        # A reclaim notice (SIGTERM / request_preemption) arms a drain
+        # that lands at the next CLEAN commit boundary: farewell first
+        # (membership intent must beat the survivors' next quorum
+        # round), then the final durable save, then advertisement
+        # withdrawal, then shutdown. _preempt is None or
+        # {"deadline": monotonic, "reason": str}; _drained flips once
+        # the drain completed (step() then raises PreemptedExit);
+        # _preempt_expired latches the degraded-to-hard-kill outcome.
+        # _durable_target is the (writer, directory, prefix,
+        # user_state_fn) the final save goes to (set_durable_target /
+        # auto-remembered from save_durable).
+        self._preempt: Optional[Dict[str, Any]] = None
+        self._drained = False
+        self._preempt_expired = False
+        self._durable_target: Optional[tuple] = None
+        self._durable_explicit = False
+        self._shutdown_done = False
+        # Facts of the last validated quorum round consumed by the
+        # drain's advertisement withdrawal: (store_address,
+        # replica_rank). None before the first round.
+        self._last_round_facts: Optional[tuple] = None
+        # Churn-rate observability: monotonic stamps of recent ring
+        # reconfigures (reconfigures_per_min gauge), and the previous
+        # quorum's replica world (manager-side join-coalescing
+        # accounting: a reconfigure that grew the world by K>1 admitted
+        # K joiners as ONE membership delta).
+        self._reconfig_times: deque = deque(maxlen=512)
+        self._last_world = 0
         # One thread: quorum rounds are strictly ordered per rank (reference
         # manager.py:134).
         self._executor = ThreadPoolExecutor(
@@ -845,6 +903,27 @@ class Manager:
         nor count as aborted, silently losing a step the protocol
         thinks succeeded.
         """
+        if self._drained:
+            raise PreemptedExit(
+                f"{self._replica_id}: graceful preemption drain completed "
+                f"at step {self._step}; the training loop must exit "
+                "(this is the noticed-reclaim success path)")
+        # Preemption drain (docs/design/churn.md): a pending reclaim
+        # notice lands HERE — the post-apply half of the last commit
+        # boundary. Inside should_commit the caller has not yet applied
+        # the committed update, so a save there would persist step N's
+        # metadata over step N-1's params (a committed step silently
+        # lost on a fleet-wide drain); by the next step() the update is
+        # applied and the final save follows the exact convention of
+        # the cadence saves. Blocked boundaries (mid-heal, mid-deferred,
+        # errored, aborted vote) defer to the next one.
+        if self._preempt is not None:
+            self._maybe_drain(self._should_step)
+            if self._drained:
+                raise PreemptedExit(
+                    f"{self._replica_id}: graceful preemption drain "
+                    f"completed at step {self._step}; the training loop "
+                    "must exit (this is the noticed-reclaim success path)")
         if self._deferred is not None:
             raise RuntimeError(
                 f"{self._replica_id}: step {self._step} has a deferred "
@@ -966,6 +1045,11 @@ class Manager:
         # the "refused mid-heal, retried next boundary" rule).
         self._policy_round = (getattr(q, "store_address", "") or "",
                               q.replica_world_size, q.max_world_size)
+        # Facts the graceful drain's advertisement withdrawal needs
+        # after the quorum thread has moved on (store + our healset key
+        # rank, docs/design/churn.md).
+        self._last_round_facts = (getattr(q, "store_address", "") or "",
+                                  q.replica_rank)
 
         with self._metrics_lock:  # pair with participant_slot() snapshots
             if self._use_async_quorum:
@@ -1073,6 +1157,24 @@ class Manager:
             self._comm.configure(
                 store_prefixed, q.replica_rank, q.replica_world_size
             )
+            # Manager-side join-coalescing observability
+            # (docs/design/churn.md): a membership reconfigure that grew
+            # the world by K>1 admitted K joiners as ONE delta (the
+            # lighthouse's join window batched them) — count K-1
+            # coalesced joins. A LOWER bound by construction: managers
+            # see only the NET world delta, so a leave landing in the
+            # same round as coalesced joins hides one join per leave
+            # (the lighthouse's own `joins_coalesced` status counter is
+            # id-exact). Skipped on our OWN first round (the world
+            # jump there is just us discovering the fleet) and on
+            # recovery rendezvous (membership unchanged).
+            if not recovery and self._quorum_id != -1:
+                grown = q.replica_world_size - self._last_world
+                if grown > 1:
+                    self._record(joins_coalesced_total=grown - 1)
+            self._last_world = q.replica_world_size
+            with self._metrics_lock:  # reconfigures_per_min gauge input
+                self._reconfig_times.append(time.monotonic())
             self._quorum_id = q.quorum_id
             # Only after configure SUCCEEDS: a failed recovery rendezvous
             # (peers not there yet) must leave the poison set so the next
@@ -1111,15 +1213,8 @@ class Manager:
                 "heal", source=q.recover_manager_address,
                 max_step=q.max_step)
             try:
-                primary = ManagerClient(
-                    q.recover_manager_address,
-                    connect_timeout_ms=self._timeout_ms,
-                    retry_policy=self._retry_policy,
-                    retry_stats=self._retry_stats,
-                )
-                ckpt_addr = primary.checkpoint_address(
-                    self._rank, timeout_ms=self._timeout_ms
-                )
+                ckpt_addr = self._resolve_checkpoint_addr(
+                    q.recover_manager_address)
                 target = self._manager_state_dict()
                 with self._metrics_lock:  # fresh gauges for this transfer
                     self._metrics["heal_last_bytes_committed"] = 0.0
@@ -1187,6 +1282,19 @@ class Manager:
             self.load_state_dict(state["torchft"])
             self._pending_state_dict = state
 
+    def _resolve_checkpoint_addr(self, manager_addr: str) -> str:
+        """Resolve a peer manager's checkpoint-server URL for this
+        rank — the ONE spelling of the ManagerClient round-trip shared
+        by the in-quorum heal, the mid-heal donor failover, and the
+        pre-join heal (client wiring — timeouts, retry policy, shared
+        counters — must never diverge between them)."""
+        return ManagerClient(
+            manager_addr,
+            connect_timeout_ms=self._timeout_ms,
+            retry_policy=self._retry_policy,
+            retry_stats=self._retry_stats,
+        ).checkpoint_address(self._rank, timeout_ms=self._timeout_ms)
+
     def _apply_pending_state_dict(self) -> None:
         assert self._pending_state_dict is not None, "no staged state"
         logger.info("%s applying healed user state", self._replica_id)
@@ -1237,14 +1345,8 @@ class Manager:
                     "the heal", self._replica_id, q2.heal, q.max_step,
                     q2.max_step)
                 return None
-            primary = ManagerClient(
-                q2.recover_manager_address,
-                connect_timeout_ms=self._timeout_ms,
-                retry_policy=self._retry_policy,
-                retry_stats=self._retry_stats,
-            )
-            ckpt_addr = primary.checkpoint_address(
-                self._rank, timeout_ms=self._timeout_ms)
+            ckpt_addr = self._resolve_checkpoint_addr(
+                q2.recover_manager_address)
             self._log_event(
                 event="heal_failover", step=self._step,
                 n=failover_idx + 1, donor=q2.recover_manager_address)
@@ -2279,6 +2381,421 @@ class Manager:
                         error=repr(self._errored) if self._errored
                         else None)
 
+    # -------------------------------------- graceful preemption drain
+    # Spot-instance churn survival (docs/design/churn.md): a cloud
+    # reclaim notice (SIGTERM with TORCHFT_RECLAIM_SEC of warning, or an
+    # explicit request_preemption) arms a drain that lands at the next
+    # CLEAN commit boundary — concretely at the step() call that
+    # follows it, once the caller has APPLIED the committed update
+    # (saving inside should_commit would persist step N's metadata
+    # over step N-1's params) — with the save_durable refusal
+    # discipline: a boundary that is mid-heal, mid-deferred, errored,
+    # or aborted defers the drain to the next one. The drain itself:
+    # (1) farewell
+    # FIRST — the leaving intent must reach the lighthouse before the
+    # survivors' next quorum round is served, or their already-
+    # dispatched step would run a collective against a peer that is
+    # about to vanish (the vote abort this protocol exists to avoid);
+    # everything after the farewell is local, so ordering it first
+    # costs nothing. (2) the final durable save to the registered
+    # target (sharded when the writer shards). (3) advertisement
+    # withdrawal: the healset key is tombstoned (step -1 never matches
+    # a heal's max_step) and the publication tier detaches, so no
+    # healer or subscriber is steered at a corpse. (4) shutdown; the
+    # next step() raises PreemptedExit and the loop exits 0. Deadline
+    # expiry at any point degrades to today's hard-kill behavior with
+    # a flight-recorder dump attributing where the drain was stuck.
+
+    def set_durable_target(self, writer: Any, directory: str,
+                           prefix: str = "ckpt_",
+                           user_state_fn: Optional[Callable[[], Any]]
+                           = None) -> None:
+        """Register where the graceful drain's FINAL durable save goes
+        (and attach ``writer``'s counters to :meth:`metrics`, like
+        :meth:`save_durable` does). Callers already saving through
+        :meth:`save_durable` get this for free — it remembers its last
+        target — but a trainer that wants drain coverage from step 0
+        should register explicitly.
+
+        ``user_state_fn``: optional snapshot source for the final save,
+        for callers whose durable tree is richer than the
+        manager-registered state (the ``user_state`` analogue of
+        :meth:`save_durable` — e.g. a trainer checkpointing its loader
+        position alongside). The drain's file must load against the
+        same target structure as the cadence saves, or cold-start
+        resume breaks on a tree mismatch. An explicit registration is
+        never overwritten by later :meth:`save_durable` calls."""
+        self._ckpt_writer = writer
+        self._durable_target = (writer, directory, prefix, user_state_fn)
+        self._durable_explicit = True
+
+    def request_preemption(self, deadline_s: Optional[float] = None,
+                           reason: str = "reclaim",
+                           _signal_safe: bool = False) -> float:
+        """Arm the graceful preemption drain: this group will exit
+        cleanly at the next clean commit boundary (see the section
+        comment above). Idempotent under repeated notices: every
+        notice counts, the EARLIEST deadline wins.
+
+        ``_signal_safe`` (the installed SIGTERM handler passes True):
+        skip everything that acquires a lock — ``_metrics_lock``
+        (counters/events) and the logging module's handler locks. A
+        signal handler runs ON the main thread between bytecodes, so
+        taking a non-reentrant lock that the interrupted frame already
+        holds (step()'s advance block, any ``_record``) would deadlock
+        the training loop: no drain, no farewell, strictly worse than
+        no handler. The skipped accounting is staged in the
+        ``_preempt`` dict (plain main-thread field writes) and flushed
+        by :meth:`_maybe_drain` at the next boundary.
+
+        ``deadline_s`` is the reclaim warning the cloud gave (env
+        ``TORCHFT_RECLAIM_SEC``, default 120 — the common spot/
+        preemptible notice); past it the drain degrades to hard-kill
+        behavior with a flight dump. Returns the deadline in force (s
+        from now)."""
+        if deadline_s is None:
+            deadline_s = float(os.environ.get("TORCHFT_RECLAIM_SEC", 120.0))
+        deadline_s = max(float(deadline_s), 0.0)
+        now = time.monotonic()
+        # Work on a LOCAL snapshot: notices can arrive from a signal
+        # handler or a watcher/orchestrator thread while the training
+        # thread's _execute_drain nulls self._preempt — re-reading the
+        # attribute after the None check would TypeError. (Two racing
+        # FIRST notices can still drop one from the count — benign: the
+        # deadline is near-identical and the drain arms either way.)
+        p = self._preempt
+        if p is None:
+            p = {"deadline": now + deadline_s, "reason": str(reason),
+                 "pending_notices": 1}
+            self._preempt = p
+        elif self._preempt_expired:
+            # A FRESH notice after an expired one (spot reprieve, then
+            # re-reclaim): re-arm with the new deadline — min() against
+            # the long-expired stamp would keep the drain inert forever
+            # while logging a negative deadline.
+            p["deadline"] = now + deadline_s
+            p["reason"] = str(reason)
+            p["pending_notices"] += 1
+            self._preempt_expired = False
+        else:
+            p["deadline"] = min(p["deadline"], now + deadline_s)
+            p["pending_notices"] += 1
+        remaining = p["deadline"] - now
+        if not _signal_safe:
+            self._flush_preempt_notices()
+            logger.warning(
+                "%s: preemption notice (%s) — draining at the next clean "
+                "commit boundary, deadline %.1fs", self._replica_id,
+                reason, remaining)
+        return remaining
+
+    def _flush_preempt_notices(self) -> None:
+        """Move signal-staged notice accounting into the locked
+        counters/events — always on the training thread, never inside
+        a signal handler."""
+        p = self._preempt
+        if p is None:
+            return
+        pending = p.get("pending_notices", 0)
+        if pending:
+            p["pending_notices"] = 0
+            self._record(preempt_notices_total=pending)
+            self._log_event(
+                event="preempt_notice", step=self._step,
+                deadline_s=round(p["deadline"] - time.monotonic(), 3),
+                reason=p["reason"], notices=pending)
+
+    def install_preemption_handler(
+            self, deadline_s: Optional[float] = None,
+            signum: int = signal.SIGTERM) -> Any:
+        """Install a ``SIGTERM`` handler that turns the cloud's reclaim
+        signal into :meth:`request_preemption` (deadline from
+        ``deadline_s`` / ``TORCHFT_RECLAIM_SEC``), chaining any
+        previously-installed handler. Returns the previous handler.
+        Must run on the main thread (a Python signal constraint)."""
+        prev = signal.getsignal(signum)
+
+        def handler(sig: int, frame: Any) -> None:
+            # _signal_safe: no locks here — see request_preemption.
+            self.request_preemption(deadline_s, reason=f"signal {sig}",
+                                    _signal_safe=True)
+            if callable(prev) and prev not in (signal.SIG_IGN,
+                                               signal.SIG_DFL):
+                prev(sig, frame)
+
+        signal.signal(signum, handler)
+        return prev
+
+    def preemption_pending(self) -> bool:
+        """True while a reclaim notice is armed and the drain has not
+        yet landed (or expired)."""
+        return self._preempt is not None and not self._drained \
+            and not self._preempt_expired
+
+    def drained(self) -> bool:
+        """True once the graceful drain completed; :meth:`step` raises
+        :class:`PreemptedExit` from then on."""
+        return self._drained
+
+    def _maybe_drain(self, decision: bool) -> None:
+        """Boundary half of the drain: land it, defer it, or expire
+        it. Runs on the caller thread at the top of :meth:`step` — the
+        post-apply edge of the previous commit boundary, where nothing
+        is in flight and the caller has already applied the committed
+        update (so the final save snapshots exactly what a cadence
+        save at this step would)."""
+        p = self._preempt
+        if p is None or self._drained or self._preempt_expired:
+            return
+        self._flush_preempt_notices()  # signal-staged accounting
+        with self._metrics_lock:
+            healing = self._healing
+        blocked = []
+        if healing:
+            blocked.append("healing")
+        if self._deferred is not None:
+            blocked.append("deferred in flight")
+        if self._errored is not None:
+            blocked.append("errored")
+        if not decision:
+            blocked.append("vote aborted")
+        now = time.monotonic()
+        if now > p["deadline"]:
+            self._expire_preemption(",".join(blocked) or "notice deadline "
+                                    "passed before a boundary")
+            return
+        if blocked:
+            # save_durable's refusal classes: this boundary's state is
+            # not a settled committed step's — a final save now would
+            # persist (and a farewell would strand) exactly the
+            # inconsistent state the drain exists to escape. Retry at
+            # the next boundary; the deadline bounds how long.
+            self._record(preempt_drain_deferrals_total=1)
+            self._log_event(event="preempt_deferred", step=self._step,
+                            why=",".join(blocked))
+            logger.warning(
+                "%s: preemption drain deferred at step %d (%s); retrying "
+                "at the next boundary", self._replica_id, self._step,
+                ",".join(blocked))
+            return
+        self._execute_drain(p)
+
+    def _expire_preemption(self, why: str) -> None:
+        """The reclaim deadline passed before the drain landed: degrade
+        to the pre-protocol hard-kill behavior — the imminent SIGKILL
+        will look like a crash to survivors (staleness eviction, not
+        farewell) — leaving a flight-recorder dump attributing where
+        the drain was stuck."""
+        self._preempt_expired = True
+        self._record(preempt_deadline_expired_total=1)
+        self._log_event(event="preempt_deadline_expired",
+                        step=self._step, why=why)
+        self._flight_dump("preempt_deadline_expired", why=why)
+        logger.error(
+            "%s: preemption deadline expired before the drain landed "
+            "(%s); degrading to hard-kill behavior", self._replica_id,
+            why)
+
+    def _execute_drain(self, p: Dict[str, Any]) -> None:
+        self._log_event(event="preempt_drain", step=self._step,
+                        reason=p["reason"])
+        # (1) Farewell: membership intent out FIRST (section comment).
+        self._send_farewell()
+        # (2) Final durable save, bounded by the remaining deadline.
+        if self._durable_target is not None:
+            writer, directory, prefix, user_fn = self._durable_target
+            remaining = p["deadline"] - time.monotonic()
+            try:
+                fut = self.save_durable(
+                    writer, directory, prefix=prefix,
+                    user_state=(user_fn() if user_fn is not None
+                                else None))
+                if fut is None:
+                    # save_durable REFUSED: state turned unclean between
+                    # _maybe_drain's check and here (an async callback
+                    # latched an error, the quorum thread flagged a
+                    # heal). Completing the drain would log "final save
+                    # taken" while the newest checkpoint is a cadence
+                    # stale — degrade like a failed save instead.
+                    self._expire_preemption(
+                        "final durable save refused (state no longer a "
+                        "settled committed step's)")
+                    return
+                fut.result(timeout=max(remaining, 0.001))
+            except Exception as e:  # noqa: BLE001
+                self._expire_preemption(f"final durable save failed: {e!r}")
+                return
+        # (3) Withdraw heal/publish advertisements.
+        self._withdraw_advertisements()
+        # (4) Done: mark, count, shut down. step() raises PreemptedExit.
+        self._drained = True
+        self._preempt = None
+        self._record(graceful_exits_total=1)
+        self._log_event(event="graceful_exit", step=self._step,
+                        reason=p["reason"])
+        logger.warning(
+            "%s: graceful preemption drain complete at step %d "
+            "(farewell sent, final save %s, advertisements withdrawn)",
+            self._replica_id, self._step,
+            "taken" if self._durable_target is not None else "skipped "
+            "(no durable target registered)")
+        self.shutdown()
+
+    def _send_farewell(self) -> None:
+        """Send the quorum farewell (leaving beat): survivors' next
+        round then cuts the shrunken quorum immediately via the
+        lighthouse's existing farewell path instead of waiting out
+        staleness. Best-effort — a lost farewell degrades to the
+        staleness eviction a crash would get."""
+        sent = False
+        try:
+            fw = (getattr(self._manager_server, "farewell", None)
+                  if self._manager_server is not None else None)
+            if fw is not None:
+                fw()
+                sent = True
+        except Exception:  # noqa: BLE001
+            logger.warning("%s: farewell via manager server failed",
+                           self._replica_id, exc_info=True)
+        if not sent:
+            # Duck-typed fallback for externally-wired control planes
+            # (tests, alternative bridges): a client exposing farewell()
+            # carries the leaving intent the same way.
+            fw = getattr(self._client, "farewell", None)
+            if fw is not None:
+                try:
+                    fw()
+                    sent = True
+                except Exception:  # noqa: BLE001
+                    logger.warning("%s: farewell via client failed",
+                                   self._replica_id, exc_info=True)
+        self._log_event(event="farewell", step=self._step, sent=sent)
+
+    def _withdraw_advertisements(self) -> None:
+        """Withdraw this group's heal + publication advertisements so no
+        replacement or subscriber is steered at a corpse: tombstone the
+        healset key (step ``-1`` never matches a heal's ``max_step``,
+        so :meth:`_healset_donors` filters it without a format change),
+        detach the publication store (subscribers' next head poll gets
+        404 and rotates parents), and shut the heal serve window."""
+        facts = self._last_round_facts
+        if facts is not None and self._heal_striped:
+            try:
+                store = self._store_client(facts[0])
+                if store is not None:
+                    store.set(f"torchft/healset/{facts[1]}", b"-1:")
+            except Exception:  # noqa: BLE001 — withdrawal is best-effort
+                logger.debug("healset withdrawal failed", exc_info=True)
+        if self._publisher is not None:
+            detach = getattr(self._ckpt_server, "detach_publication", None)
+            if detach is not None:
+                detach()
+        self._ckpt_server.disallow_checkpoint()
+
+    # ------------------------------------------- join admission control
+
+    def prejoin_heal(self, fleet: Any,
+                     resolve: Optional[Callable[[str], str]] = None,
+                     timeout_sec: float = 60.0) -> bool:
+        """Cold-start join backpressure (docs/design/churn.md): fetch
+        the fleet's newest committed state BEFORE this manager's first
+        quorum join, so the replacement enters the voting quorum
+        already (near) max_step instead of flapping membership as a
+        mid-heal joiner — its death mid-catch-up then costs the fleet
+        nothing, and its admission is one clean membership delta the
+        lighthouse's join window can coalesce.
+
+        ``fleet``: either the lighthouse's ``host:port`` (its
+        ``GET /status.json`` is scraped for members + steps) or a
+        zero-arg callable returning that status dict (tests / custom
+        discovery). ``resolve`` maps a member's manager address to its
+        checkpoint-server URL (default: a native
+        :class:`~torchft_tpu._native.ManagerClient`
+        ``checkpoint_address`` round-trip). The fetch stripes across
+        every max-step member (same striped transfer heals use) and
+        verifies every leaf digest before placement.
+
+        Best-effort by design: any failure returns False and the
+        normal in-quorum heal covers correctness — backpressure is an
+        admission-control optimization, never a correctness gate.
+        Returns True when a newer state was adopted."""
+        if self._quorum_id != -1:
+            raise RuntimeError(
+                f"{self._replica_id}: prejoin_heal must run BEFORE the "
+                "first quorum join — this manager already joined "
+                f"quorum {self._quorum_id}")
+        try:
+            if callable(fleet):
+                status = fleet()
+            else:
+                import urllib.request
+
+                with urllib.request.urlopen(
+                        f"http://{fleet}/status.json",
+                        timeout=timeout_sec) as resp:
+                    status = json.loads(resp.read().decode())
+            members = list(status.get("members", []))
+            if not members:
+                return False
+            fleet_step = max(int(m.get("step", 0)) for m in members)
+            if fleet_step <= self._step:
+                return False  # already current (or ahead): just join
+            donors = [m for m in members
+                      if int(m.get("step", 0)) == fleet_step
+                      and m.get("address")]
+            if not donors:
+                return False
+            if resolve is None:
+                resolve = self._resolve_checkpoint_addr
+            addrs = []
+            for m in donors:
+                try:
+                    a = resolve(m["address"])
+                    if a and a not in addrs:
+                        addrs.append(a)
+                except Exception:  # noqa: BLE001 — skip unreachable donor
+                    logger.debug("prejoin donor resolve failed",
+                                 exc_info=True)
+            if not addrs:
+                return False
+            target = self._manager_state_dict()
+            stats: Dict[str, float] = {}
+            with self._tracer.span("prejoin_heal", donors=len(addrs),
+                                   fleet_step=fleet_step):
+                state = cast(
+                    Dict[str, Any],
+                    CheckpointServer.load_from_address(
+                        addrs[0], target, stats=stats,
+                        auth_token=self._auth_token,
+                        retry_policy=self._retry_policy,
+                        retry_stats=self._retry_stats,
+                        stall_timeout_sec=self._heal_stall_timeout_sec,
+                        donors=lambda i: None,
+                        max_donor_failovers=0,
+                        donor_addrs=(addrs if len(addrs) > 1 else None),
+                        stripe_seed=_stripe_seed(self._replica_id),
+                        tracer=self._tracer),
+                )
+            self.load_state_dict(state["torchft"])
+            self._user_load_state_dict(state["user"])
+            self._record(prejoin_heals_total=1,
+                         heal_bytes_total=stats.get("bytes", 0.0))
+            self._log_event(
+                event="prejoin_heal", step=self._step,
+                fleet_step=fleet_step, donors=len(addrs),
+                bytes=stats.get("bytes", 0.0))
+            logger.info(
+                "%s: pre-join heal adopted fleet step %d from %d "
+                "donor(s) (%d bytes) — joining the voting quorum "
+                "already current", self._replica_id, self._step,
+                len(addrs), int(stats.get("bytes", 0.0)))
+            return True
+        except Exception:  # noqa: BLE001 — backpressure is best-effort
+            logger.warning("%s: pre-join heal failed; falling back to "
+                           "the in-quorum heal", self._replica_id,
+                           exc_info=True)
+            return False
+
     # ------------------------------------------- degraded-mode groups
     # Partial-chip-loss survival (docs/design/degraded_mode.md): instead
     # of dying wholesale when a chip drops, a group lands a capacity
@@ -2645,6 +3162,7 @@ class Manager:
         with self._metrics_lock:
             rc = self._metrics["reconfigure_count"]
             ar = self._metrics["allreduce_ms_total"]
+            churn_per_min = self._churn_per_min_locked(now)
         prev = self._policy_prev_counters
         reconfigured = prev is not None and rc > prev["rc"]
         comm_frac = 0.0
@@ -2654,7 +3172,8 @@ class Manager:
                 comm_frac = min(1.0, max(0.0, ar - prev["ar"]) / wall_ms)
         self._policy_prev_counters = {"rc": rc, "ar": ar, "t": now}
         proposal = self._controller.note_boundary(
-            decision, reconfigured=reconfigured, comm_frac=comm_frac)
+            decision, reconfigured=reconfigured, comm_frac=comm_frac,
+            churn_rate=churn_per_min)
         with self._metrics_lock:  # gauge
             self._metrics["failure_rate"] = \
                 self._controller.last_signals.failure_rate
@@ -2764,6 +3283,14 @@ class Manager:
             for key, delta in deltas.items():
                 self._metrics[key] += delta
 
+    def _churn_per_min_locked(self, now_mono: float) -> float:
+        """Ring reconfigures in the trailing 60 s (requires
+        ``_metrics_lock`` held) — the one spelling behind both the
+        ``reconfigures_per_min`` gauge and the policy controller's
+        ``churn_rate`` signal, so the two can never drift."""
+        return float(sum(1 for t in self._reconfig_times
+                         if now_mono - t <= 60.0))
+
     def _log_event(self, **event: Any) -> None:
         event["t"] = time.time()
         # Clock-step-proof ordering (see _event_seq in __init__): the
@@ -2828,6 +3355,12 @@ class Manager:
         with self._metrics_lock:
             out = dict(self._metrics)
             pct = self._quorum_latency.percentiles()
+            # Churn-rate gauge (docs/design/churn.md): the
+            # reconfigures-per-minute bound the join-coalescing window
+            # exists to hold under a storm, and the churn signal the
+            # policy controller reads.
+            out["reconfigures_per_min"] = \
+                self._churn_per_min_locked(time.monotonic())
         out["quorum_ms_p50"] = pct["p50"]
         out["quorum_ms_p95"] = pct["p95"]
         out["quorum_ms_max"] = pct["max"]
@@ -2980,6 +3513,18 @@ class Manager:
                 deferred=deferred)
             return None
         self._ckpt_writer = writer
+        # Remember the target: the graceful preemption drain's FINAL
+        # save reuses it (docs/design/churn.md). Never clobbers an
+        # explicit set_durable_target (which may carry a richer
+        # user_state_fn the drain's file must keep matching) — and
+        # never auto-remembers a call that passed an explicit
+        # user_state: the drain would then write the manager-registered
+        # tree while every cadence save wrote the caller's richer one,
+        # and the NEWEST checkpoint would break cold-start resume on
+        # the structure mismatch. Such callers must register via
+        # set_durable_target(user_state_fn=...) for drain coverage.
+        if not self._durable_explicit and user_state is None:
+            self._durable_target = (writer, directory, prefix, None)
         meta = {
             "committed": True,
             "quorum_id": self._quorum_id,
@@ -3251,6 +3796,12 @@ class Manager:
         return getattr(self, "_store_addr", "")
 
     def shutdown(self) -> None:
+        # Idempotent: a graceful preemption drain shuts the manager down
+        # inside should_commit, and the trainer's normal teardown path
+        # (FTTrainer.shutdown / example finallys) then calls it again.
+        if self._shutdown_done:
+            return
+        self._shutdown_done = True
         if self._deferred is not None:
             # Dropping here loses at most the one in-flight step — the
             # same bound as a vote abort — but a clean exit should flush

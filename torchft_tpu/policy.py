@@ -216,6 +216,11 @@ class PolicySignals:
     failure_rate: float = 0.0   # failures per commit boundary, windowed
     comm_frac: float = 0.0      # allreduce wall / step wall, windowed
     quiet_boundaries: int = 0   # consecutive clean boundaries
+    # Live churn regime (docs/design/churn.md): ring reconfigures in the
+    # trailing minute, fed by the Manager's reconfigure-timestamp window
+    # — under spot churn this is the failure REGIME signal (groups are
+    # coming and going) even when every individual boundary commits.
+    churn_rate: float = 0.0
 
     def as_dict(self) -> Dict[str, float]:
         return {
@@ -224,6 +229,7 @@ class PolicySignals:
             "failure_rate": round(self.failure_rate, 4),
             "comm_frac": round(self.comm_frac, 4),
             "quiet_boundaries": float(self.quiet_boundaries),
+            "churn_rate": round(self.churn_rate, 4),
         }
 
 
@@ -305,7 +311,7 @@ class PolicyController:
     # ---------------------------------------------------------- decision
 
     def note_boundary(self, committed: bool, reconfigured: bool = False,
-                      comm_frac: float = 0.0
+                      comm_frac: float = 0.0, churn_rate: float = 0.0
                       ) -> Optional[Tuple[int, str, PolicySignals]]:
         """Record one commit boundary; return ``(target_rung, reason,
         signals)`` when the ladder should move, else ``None``. The
@@ -324,7 +330,8 @@ class PolicyController:
         sig = PolicySignals(
             failures_in_window=fails, window=len(self._recent),
             failure_rate=fails / max(len(self._recent), 1),
-            comm_frac=self._comm_ema, quiet_boundaries=self._quiet)
+            comm_frac=self._comm_ema, quiet_boundaries=self._quiet,
+            churn_rate=max(churn_rate, 0.0))
         self.last_signals = sig
         if self._since_switch < self.cooldown:
             return None

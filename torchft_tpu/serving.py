@@ -386,6 +386,12 @@ class PublicationServer:
             target=self._server.serve_forever, daemon=True,
             name="publication-server")
         self._thread.start()
+        # Rebirth for the chaos kill latches: a replacement relay bound
+        # at a dead relay's host:port must not inherit its dead latch
+        # (docs/design/churn.md; no-op without an active schedule).
+        netloc = urllib.parse.urlparse(self.address()).netloc
+        if netloc:
+            chaos.endpoint_reborn(f"serve:{netloc}")
 
     def address(self) -> str:
         port = self._server.server_address[1]
