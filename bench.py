@@ -278,6 +278,7 @@ def bench_multigroup(n_groups: int = 2, steps: int = 20,
                      overlap_steps: int = 0,
                      shard_update: bool = False,
                      tracing: Optional[bool] = None,
+                     fleet_telemetry: Optional[bool] = None,
                      device_quantize: Optional[bool] = None,
                      policy: Optional[Any] = None,
                      hier_hosts: Optional[int] = None
@@ -308,6 +309,13 @@ def bench_multigroup(n_groups: int = 2, steps: int = 20,
     ``tracing`` overrides the Manager's per-step span tracing (default:
     the ``TORCHFT_TRACING`` env default, i.e. on) — the knob the
     ``multigroup_8mb_trace_ab`` overhead A/B flips.
+
+    ``fleet_telemetry`` overrides the quorum-piggybacked fleet health
+    digest (docs/design/fleet_health.md; default: the
+    ``TORCHFT_FLEET_TELEMETRY`` env default, i.e. on) — the knob the
+    ``multigroup_8mb_fleet_ab`` overhead A/B flips. The result carries
+    ``fleet_p95_ms``/``fleet_groups`` (the lighthouse's echoed hint) so
+    the ON leg also proves the loop is actually closed.
 
     ``shard_update=True`` runs the ZeRO-style sharded weight update
     (docs/design/sharded_update.md): reduce-scatter instead of
@@ -376,6 +384,7 @@ def bench_multigroup(n_groups: int = 2, steps: int = 20,
                 overlap_steps=overlap_steps,
                 shard_update=shard_update,
                 tracing=tracing,
+                fleet_telemetry=fleet_telemetry,
                 device_quantize=device_quantize,
                 policy=policy,
             ),
@@ -473,6 +482,11 @@ def bench_multigroup(n_groups: int = 2, steps: int = 20,
             # from the lighthouse's membership-unchanged cache.
             "quorum_ms_p50": mx["quorum_ms_p50"],
             "quorum_ms_p95": mx["quorum_ms_p95"],
+            # Fleet health hint as echoed by the lighthouse
+            # (docs/design/fleet_health.md): nonzero on the ON leg of
+            # the fleet A/B proves digests flowed round-trip.
+            "fleet_p95_ms": mx["fleet_p95_ms"],
+            "fleet_groups": mx["fleet_groups"],
             "quorum_fast_frac": (
                 mx["quorum_fast_path_hits"]
                 / max(mx["quorum_fast_path_hits"]
@@ -2229,6 +2243,28 @@ def main() -> None:
            "target_max_overhead_frac": 0.02,
            "trace_on_stages_ms": stages(mtr_on),
            "trace_off_stages_ms": stages(mtr_off)})
+
+    # Fleet-telemetry overhead A/B on the same scenario
+    # (docs/design/fleet_health.md): the per-boundary digest push +
+    # quorum-piggybacked aggregation defaults ON, so its cost rides the
+    # same <2% gate as tracing. The ON leg's echoed fleet_p95_ms/
+    # fleet_groups also prove the digest->aggregate->hint loop closed.
+    mfl_on = bench_multigroup(bucket_bytes=2 << 20,
+                              fleet_telemetry=True, **big)
+    mfl_off = bench_multigroup(bucket_bytes=2 << 20,
+                               fleet_telemetry=False, **big)
+    _emit({"metric": "multigroup_8mb_fleet_ab",
+           "policy": mfl_on["policy"], **mgrow(mfl_on),
+           "grad_mbytes": round(mfl_on["grad_mbytes"], 2),
+           "fleet_on_steps_per_s": round(mfl_on["steps_per_s"], 3),
+           "fleet_off_steps_per_s": round(mfl_off["steps_per_s"], 3),
+           "overhead_frac": round(
+               1.0 - mfl_on["steps_per_s"]
+               / max(mfl_off["steps_per_s"], 1e-9), 4),
+           "target_max_overhead_frac": 0.02,
+           "fleet_p95_ms": round(mfl_on["fleet_p95_ms"], 1),
+           "fleet_groups": int(mfl_on["fleet_groups"]),
+           "fleet_off_groups": int(mfl_off["fleet_groups"])})
 
     # Allreduce vs ZeRO-style reduce-scatter+allgather A/B on the same
     # 8MB scenario (docs/design/sharded_update.md): the rs leg receives

@@ -35,6 +35,7 @@ MODULES = [
     ("torchft_tpu.checkpoint_io", "Durable checkpoint save/load"),
     ("torchft_tpu.serving", "Live weight publication + relay fan-out"),
     ("torchft_tpu.tracing", "Per-step tracing + flight recorder"),
+    ("torchft_tpu.fleet", "Fleet health plane (straggler/SLO mirror)"),
     ("torchft_tpu.serialization", "Streaming pytree wire format"),
     ("torchft_tpu.optim", "Commit-gated optimizer wrappers"),
     ("torchft_tpu.policy", "Adaptive fault-tolerance policy"),

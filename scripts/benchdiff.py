@@ -1,0 +1,247 @@
+#!/usr/bin/env python
+"""Bench-trajectory regression differ (docs/design/fleet_health.md).
+
+The repo's bench trajectory (``BENCH_r*.json``, one per PR round) was
+write-only: rows are emitted, never compared, so a perf regression
+lands silently and is archaeology three rounds later. This tool closes
+the loop:
+
+    python scripts/benchdiff.py BENCH_r04.json BENCH_r05.json
+    python scripts/benchdiff.py .                   # whole trajectory
+    python scripts/benchdiff.py . --threshold 0.05 --all
+
+It understands both spellings of a bench file:
+
+* the driver wrapper ``{"n": .., "cmd": .., "rc": .., "tail": ".."}``
+  whose ``tail`` holds the bench's JSON-lines rows, and
+* a raw JSON-lines file / JSON list of row objects (``bench.py``'s own
+  stdout captured to a file).
+
+Every row is keyed by its ``metric`` name; numeric fields (nested
+dicts like ``stages_ms`` flatten to ``stages_ms.fetch``) are compared
+with a DIRECTION inferred from the field/unit spelling — ``*_per_s`` /
+``speedup*`` / ``*tflops`` / ``mfu*`` / ``goodput`` are
+higher-is-better, ``*_ms`` / ``*_bytes`` / ``*wall_clock_s`` are
+lower-is-better, and config-shaped fields (``n_groups``, ``batch``,
+``seq_len``, ...) are ignored. A change past ``--threshold`` (default
+10%) against the direction is a REGRESSION; any regression in the
+gated pair(s) exits nonzero, so CI can hold the line. Improvements and
+within-threshold drift are reported, never fatal. A metric present
+only on one side is reported as added/removed, never fatal (benches
+grow with the repo).
+
+Directory mode diffs every adjacent pair of the sorted trajectory but
+gates (exit code) only the NEWEST pair by default — an old, already
+shipped regression should not permanently fail the gate; pass
+``--all`` to gate every pair. Native-free; smoke-tested in
+``tests/test_bench_smoke.py``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+# Direction vocabularies, checked in order (first match wins). Config
+# fields are NEUTRAL: real but not a quality signal — never gated.
+_HIGHER = ("per_s", "speedup", "tflops", "mfu", "goodput", "_rate",
+           "bucketing", "fits")
+_LOWER = ("_ms", "ms_per", "mbytes_per_step", "_bytes",
+          "wall_clock_s", "hbm_gb", "lag")
+_NEUTRAL = ("n_groups", "n_params", "batch", "seq_len", "sync_every",
+            "budget", "grad_mbytes", "unit", "backend", "mesh",
+            "window_s", "seed", "churn")
+# Exact-match neutral keys ("n" as a substring would swallow almost
+# everything).
+_NEUTRAL_EXACT = frozenset(["n", "rc", "step", "steps", "world",
+                            "depth", "hidden", "schema"])
+
+
+def direction_of(key: str, unit: str = "") -> Optional[int]:
+    """+1 higher-is-better, -1 lower-is-better, None neutral."""
+    k = key.lower()
+    if k == "value":
+        u = unit.lower()
+        if u.endswith("/s") or "flop" in u:
+            return 1
+        if u in ("s", "ms", "gb", "mb", "bytes"):
+            return -1
+        return 1  # a bare "value" row is a throughput by convention
+    leaf = k.rsplit(".", 1)[-1]
+    if leaf in _NEUTRAL_EXACT or any(p in k for p in _NEUTRAL):
+        return None
+    if any(p in k for p in _HIGHER):
+        return 1
+    if any(p in k for p in _LOWER):
+        return -1
+    return None
+
+
+def _flatten(row: Dict[str, Any], prefix: str = "") -> Dict[str, float]:
+    out: Dict[str, float] = {}
+    for k, v in row.items():
+        if k == "metric":
+            continue
+        name = f"{prefix}{k}"
+        if isinstance(v, dict):
+            out.update(_flatten(v, prefix=f"{name}."))
+        elif isinstance(v, bool):
+            out[name] = 1.0 if v else 0.0
+        elif isinstance(v, (int, float)):
+            out[name] = float(v)
+    return out
+
+
+def parse_bench_file(path: str) -> Dict[str, Dict[str, Any]]:
+    """Rows by metric name, from either bench-file spelling."""
+    with open(path) as f:
+        text = f.read()
+    rows: List[Dict[str, Any]] = []
+    try:
+        doc = json.loads(text)
+    except ValueError:
+        doc = None
+    if isinstance(doc, dict) and "tail" in doc:
+        text = doc["tail"]
+    elif isinstance(doc, list):
+        rows = [r for r in doc if isinstance(r, dict)]
+        text = ""
+    elif isinstance(doc, dict) and "metric" in doc:
+        rows, text = [doc], ""
+    for line in text.splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            obj = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(obj, dict) and "metric" in obj:
+            rows.append(obj)
+    # Last write wins on a duplicated metric (reruns append).
+    return {str(r["metric"]): r for r in rows if "metric" in r}
+
+
+def diff_rows(old: Dict[str, Dict[str, Any]],
+              new: Dict[str, Dict[str, Any]],
+              threshold: float) -> Dict[str, List[Dict[str, Any]]]:
+    """Compare two parsed bench files; returns {regressions,
+    improvements, changes, added, removed} entry lists."""
+    out: Dict[str, List[Dict[str, Any]]] = {
+        "regressions": [], "improvements": [], "changes": [],
+        "added": sorted(set(new) - set(old)),
+        "removed": sorted(set(old) - set(new)),
+    }
+    for metric in sorted(set(old) & set(new)):
+        o_f, n_f = _flatten(old[metric]), _flatten(new[metric])
+        unit = str(new[metric].get("unit", old[metric].get("unit", "")))
+        for key in sorted(set(o_f) & set(n_f)):
+            ov, nv = o_f[key], n_f[key]
+            if ov == nv:
+                continue
+            sense = direction_of(key, unit)
+            rel = (nv - ov) / abs(ov) if ov else float("inf")
+            entry = {"metric": metric, "key": key, "old": ov,
+                     "new": nv, "rel": rel}
+            if sense is None:
+                out["changes"].append(entry)
+            elif sense * rel < -threshold:
+                out["regressions"].append(entry)
+            elif sense * rel > threshold:
+                out["improvements"].append(entry)
+    return out
+
+
+def _fmt(entry: Dict[str, Any]) -> str:
+    rel = entry["rel"]
+    pct = f"{rel * 100:+.1f}%" if abs(rel) != float("inf") else "inf"
+    return (f"{entry['metric']}.{entry['key']}: "
+            f"{entry['old']:g} -> {entry['new']:g} ({pct})")
+
+
+def report(label: str, diff: Dict[str, List[Any]],
+           verbose: bool = False) -> None:
+    print(f"== {label}")
+    for e in diff["regressions"]:
+        print(f"  REGRESSION  {_fmt(e)}")
+    for e in diff["improvements"]:
+        print(f"  improved    {_fmt(e)}")
+    if verbose:
+        for e in diff["changes"]:
+            print(f"  changed     {_fmt(e)}")
+    for m in diff["added"]:
+        print(f"  added       {m}")
+    for m in diff["removed"]:
+        print(f"  removed     {m}")
+    if not any(diff[k] for k in
+               ("regressions", "improvements", "added", "removed")):
+        print("  no movement beyond threshold")
+
+
+def trajectory_files(directory: str) -> List[str]:
+    """The directory's bench trajectory, oldest first: BENCH_r*.json
+    sorted by round number."""
+    def round_no(p: str) -> Tuple[int, str]:
+        m = re.search(r"_r(\d+)", os.path.basename(p))
+        return (int(m.group(1)) if m else 0, p)
+
+    return sorted(glob.glob(os.path.join(directory, "BENCH_r*.json")),
+                  key=round_no)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Diff bench rows between rounds; exit nonzero on a "
+        "metric regression beyond the threshold.")
+    ap.add_argument("paths", nargs="+",
+                    help="two bench files, or ONE directory holding a "
+                    "BENCH_r*.json trajectory")
+    ap.add_argument("--threshold", type=float, default=0.10,
+                    help="relative regression tolerance "
+                    "(default 0.10 = 10%%)")
+    ap.add_argument("--all", action="store_true",
+                    help="directory mode: gate EVERY adjacent pair, "
+                    "not just the newest")
+    ap.add_argument("--verbose", action="store_true",
+                    help="also print neutral (config-shaped) changes")
+    args = ap.parse_args(argv)
+
+    if len(args.paths) == 1 and os.path.isdir(args.paths[0]):
+        files = trajectory_files(args.paths[0])
+        if len(files) < 2:
+            print(f"benchdiff: fewer than two BENCH_r*.json in "
+                  f"{args.paths[0]}; nothing to diff", file=sys.stderr)
+            return 0
+    elif len(args.paths) == 2 and \
+            all(os.path.isfile(p) for p in args.paths):
+        files = list(args.paths)
+    else:
+        ap.error("pass exactly two bench FILES, or one directory "
+                 "holding a BENCH_r*.json trajectory")
+
+    parsed = [parse_bench_file(p) for p in files]
+    failed = False
+    for i in range(1, len(files)):
+        diff = diff_rows(parsed[i - 1], parsed[i], args.threshold)
+        gated = args.all or i == len(files) - 1
+        report(f"{os.path.basename(files[i - 1])} -> "
+               f"{os.path.basename(files[i])}"
+               + ("" if gated else " (not gated)"),
+               diff, verbose=args.verbose)
+        if gated and diff["regressions"]:
+            failed = True
+    if failed:
+        print("benchdiff: FAIL (regression beyond "
+              f"{args.threshold * 100:g}%)", file=sys.stderr)
+        return 1
+    print("benchdiff: ok")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -194,6 +194,26 @@ if [[ "${1:-}" == "churn" ]]; then
     exit 0
 fi
 
+# Fleet tier: the fleet health plane's focused gate
+# (docs/design/fleet_health.md) — the straggler-score/attribution
+# battery against the pure-Python aggregator mirror (known-skew fleets,
+# single-group no-NaN, healer/degraded exclusion, staleness/farewell
+# pruning), the SLO engine's thresholds and (slo, group, step) dedup,
+# the frozen /fleet/metrics exposition names, the Manager's digest-push
+# deltas + hint consumption + SLO-breach flight dump, tracefleet's
+# --fleet resolution over a live stub, and benchdiff's regression
+# gating. Tier-1 and native-free (not marked slow); run this tier on
+# fleet/lighthouse/manager/tracing changes. The native 4-group
+# piggyback drive (slowed group leads the ranking, ring attributed,
+# breach echoed to it alone, C++-vs-Python aggregator parity) and the
+# churn-coherence soak are nightly+slow and ride the nightly tier.
+if [[ "${1:-}" == "fleet" ]]; then
+    stage fleet env JAX_PLATFORMS=cpu \
+        python -m pytest tests/test_fleet.py -q -m "fleet and not slow"
+    echo "== total: ${SECONDS}s"
+    exit 0
+fi
+
 # Obs tier: the observability tier's focused gate
 # (docs/design/observability.md) — span-ring bounds/context, the
 # flight recorder's triggers (vote abort, latched comm error, heal

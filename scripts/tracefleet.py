@@ -18,14 +18,26 @@ Addresses come from either:
   full ``http://host:port`` base; a ``/checkpoint/N`` suffix is
   stripped), e.g. what ``Manager.publish_address()`` / the lighthouse
   dashboard shows; or
+* ``--fleet host:port`` — the lighthouse's ``GET /fleet/status.json``
+  (docs/design/fleet_health.md): every group's telemetry digest carries
+  its checkpoint-server address, so the fleet enumerates itself over
+  plain HTTP — no quorum-store access, no native client, and dead
+  groups are already absent; or
 * ``--store host:port --world N`` — resolve them from the quorum
   store's healset advertisements (``torchft/healset/{rank}``), the SAME
   way healers resolve striped-heal donors, so the fleet enumerates
   itself with no extra registry. Requires the native store client.
 
+``--watch SECONDS`` keeps the merged timeline live: re-resolve (with
+``--fleet``, newly joined groups appear automatically), re-scrape, and
+atomically re-merge every interval until interrupted — leave Perfetto
+open on the output and reload.
+
 Usage:
     python scripts/tracefleet.py g0-host:29531 g1-host:29544 \
         --steps 64 --out fleet_trace.json
+    python scripts/tracefleet.py --fleet lh-host:29510 \
+        --watch 10 --out fleet_trace.json
     python scripts/tracefleet.py --store lh-host:29512 --world 16 \
         --out fleet_trace.json
 """
@@ -36,6 +48,7 @@ import argparse
 import json
 import os
 import sys
+import time
 import urllib.request
 from typing import List, Optional
 
@@ -70,6 +83,19 @@ def fetch_trace(addr: str, steps: Optional[int] = None,
         return json.loads(resp.read())
 
 
+def resolve_from_fleet(lighthouse_addr: str,
+                       timeout: float = 10.0) -> List[str]:
+    """Resolve the fleet's checkpoint-server addresses from the
+    lighthouse's ``GET /fleet/status.json`` — each group's telemetry
+    digest carries its ``trace_addr`` (docs/design/fleet_health.md), so
+    this needs neither quorum-store access nor the native client, and a
+    departed/silent group is already pruned from the listing."""
+    from torchft_tpu.fleet import fetch_fleet_status, resolve_trace_addrs
+
+    status = fetch_fleet_status(lighthouse_addr, timeout=timeout)
+    return resolve_trace_addrs(status)
+
+
 def resolve_from_store(store_addr: str, world: int,
                        timeout_ms: int = 2000) -> List[str]:
     """Resolve the fleet's checkpoint-server addresses from the quorum
@@ -100,10 +126,20 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("addrs", nargs="*",
                     help="group checkpoint-server addresses "
                     "(host:port or http://host:port)")
+    ap.add_argument("--fleet", default=None,
+                    help="lighthouse host:port — resolve addresses "
+                    "from GET /fleet/status.json (each digest carries "
+                    "its group's trace_addr; no quorum-store access, "
+                    "docs/design/fleet_health.md)")
     ap.add_argument("--store", default=None,
                     help="quorum store host:port — resolve addresses "
                     "from its healset advertisements (like healers "
                     "resolve donors)")
+    ap.add_argument("--watch", type=float, default=None, metavar="SEC",
+                    help="live mode: re-resolve + re-scrape + re-merge "
+                    "every SEC seconds until interrupted (the output "
+                    "is replaced atomically — keep Perfetto open on "
+                    "it and reload)")
     ap.add_argument("--world", type=int, default=64,
                     help="ranks to probe on the store (default 64)")
     ap.add_argument("--steps", type=int, default=None,
@@ -116,39 +152,71 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--timeout", type=float, default=10.0)
     args = ap.parse_args(argv)
 
-    addrs = list(args.addrs)
-    if args.store:
-        try:
-            addrs += resolve_from_store(args.store, args.world)
-        except Exception as e:  # noqa: BLE001
-            print(f"tracefleet: store resolution failed ({e}); "
-                  "pass addresses explicitly", file=sys.stderr)
-    addrs = list(dict.fromkeys(addrs))
-    if not addrs:
-        ap.error("no group addresses (pass host:port args or --store)")
+    def resolve() -> List[str]:
+        addrs = list(args.addrs)
+        if args.fleet:
+            try:
+                addrs += resolve_from_fleet(args.fleet,
+                                            timeout=args.timeout)
+            except Exception as e:  # noqa: BLE001
+                print(f"tracefleet: fleet resolution failed ({e}); "
+                      "is fleet telemetry on?", file=sys.stderr)
+        if args.store:
+            try:
+                addrs += resolve_from_store(args.store, args.world)
+            except Exception as e:  # noqa: BLE001
+                print(f"tracefleet: store resolution failed ({e}); "
+                      "pass addresses explicitly", file=sys.stderr)
+        return list(dict.fromkeys(addrs))
 
-    traces, names = [], []
-    for addr in addrs:
-        try:
-            traces.append(fetch_trace(addr, steps=args.steps,
-                                      auth_token=args.auth_token,
-                                      timeout=args.timeout))
-            names.append(addr)
-        except Exception as e:  # noqa: BLE001 — a dead group must not
-            # blank the rest of the fleet's timeline
-            print(f"tracefleet: {addr}: fetch failed ({e}); skipping",
-                  file=sys.stderr)
-    if not traces:
-        print("tracefleet: no group produced a trace", file=sys.stderr)
-        return 1
+    def scrape_and_merge(addrs: List[str]) -> int:
+        """One scrape round: fetch every reachable group, merge,
+        atomically replace the output. Returns merged group count."""
+        traces, names = [], []
+        for addr in addrs:
+            try:
+                traces.append(fetch_trace(addr, steps=args.steps,
+                                          auth_token=args.auth_token,
+                                          timeout=args.timeout))
+                names.append(addr)
+            except Exception as e:  # noqa: BLE001 — a dead group must
+                # not blank the rest of the fleet's timeline
+                print(f"tracefleet: {addr}: fetch failed ({e}); "
+                      "skipping", file=sys.stderr)
+        if not traces:
+            return 0
+        merged = merge_traces(traces, names=names)
+        # tmp + rename: a live Perfetto reload (or a concurrent
+        # --watch reader) must never see a torn half-written file.
+        tmp = args.out + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(merged, f)
+        os.replace(tmp, args.out)
+        n_events = len(merged["traceEvents"])
+        print(f"tracefleet: merged {len(traces)}/{len(addrs)} "
+              f"group(s), {n_events} events -> {args.out} "
+              f"(load in https://ui.perfetto.dev)")
+        return len(traces)
 
-    merged = merge_traces(traces, names=names)
-    with open(args.out, "w") as f:
-        json.dump(merged, f)
-    n_events = len(merged["traceEvents"])
-    print(f"tracefleet: merged {len(traces)}/{len(addrs)} group(s), "
-          f"{n_events} events -> {args.out} "
-          f"(load in https://ui.perfetto.dev)")
+    addrs = resolve()
+    if not addrs and not (args.watch and args.fleet):
+        ap.error("no group addresses "
+                 "(pass host:port args, --fleet, or --store)")
+
+    if args.watch is None:
+        return 0 if scrape_and_merge(addrs) else 1
+
+    # Live mode: keep re-resolving (a --fleet fleet grows/shrinks as
+    # groups come and go) and re-merging until interrupted. An
+    # all-groups-down round keeps the last good merge on disk.
+    interval = max(args.watch, 0.5)
+    try:
+        while True:
+            scrape_and_merge(addrs)
+            time.sleep(interval)
+            addrs = resolve()
+    except KeyboardInterrupt:
+        pass
     return 0
 
 

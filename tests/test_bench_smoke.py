@@ -216,3 +216,56 @@ class TestPublishFanoutSmoke:
         # the flight recorder would dump ("" = off).
         assert isinstance(row["tracing_enabled"], bool)
         assert "flight_dir" in row
+
+
+class TestBenchdiffSmoke:
+    """Native-free smoke of scripts/benchdiff.py — the bench
+    trajectory's regression gate (docs/design/fleet_health.md). The
+    deeper unit battery (direction vocabulary, wrapper parsing,
+    trajectory gating) is tier-1 in tests/test_fleet.py."""
+
+    def _write(self, path, rows):
+        import json as _json
+
+        path.write_text(
+            "\n".join(_json.dumps(r) for r in rows) + "\n")
+
+    def test_regression_exits_nonzero(self, tmp_path):
+        sys.path.insert(0, str(Path(__file__).resolve().parent.parent
+                              / "scripts"))
+        try:
+            import benchdiff
+        finally:
+            sys.path.pop(0)
+        old = tmp_path / "BENCH_r01.json"
+        new = tmp_path / "BENCH_r02.json"
+        self._write(old, [{"metric": "multigroup_steps_per_s",
+                           "value": 1.0, "unit": "steps/s",
+                           "stages_ms": {"ring": 100.0}}])
+        self._write(new, [{"metric": "multigroup_steps_per_s",
+                           "value": 0.5, "unit": "steps/s",
+                           "stages_ms": {"ring": 240.0}}])
+        assert benchdiff.main([str(old), str(new)]) == 1
+        # within threshold -> clean exit
+        self._write(new, [{"metric": "multigroup_steps_per_s",
+                           "value": 0.97, "unit": "steps/s",
+                           "stages_ms": {"ring": 104.0}}])
+        assert benchdiff.main([str(old), str(new)]) == 0
+
+    def test_real_trajectory_parses(self):
+        """The repo's own BENCH_r*.json trajectory must stay parseable
+        (the driver-wrapper spelling) — rows keyed by metric with
+        numeric fields."""
+        sys.path.insert(0, str(Path(__file__).resolve().parent.parent
+                              / "scripts"))
+        try:
+            import benchdiff
+        finally:
+            sys.path.pop(0)
+        repo = Path(__file__).resolve().parent.parent
+        files = benchdiff.trajectory_files(str(repo))
+        if len(files) < 2:
+            pytest.skip("no bench trajectory in the working tree")
+        rows = benchdiff.parse_bench_file(files[-1])
+        assert rows, "newest bench file yielded no rows"
+        assert all("metric" in r for r in rows.values())

@@ -88,7 +88,19 @@ DOCUMENTED_KEYS = frozenset([
     "preempt_deadline_expired_total", "graceful_exits_total",
     "prejoin_heals_total", "joins_coalesced_total",
     "reconfigures_per_min",
+    # fleet health plane (docs/design/fleet_health.md): the
+    # lighthouse's per-requester hint, refreshed every quorum round
+    "fleet_p95_ms", "straggler_score", "fleet_groups",
+    "slo_breach", "slo_breaches_total",
 ])
+
+# Latency-reservoir quantile keys rendered as ONE Prometheus summary
+# family (torchft_quorum_ms{quantile="..."} + _sum/_count) instead of
+# bare torchft_<key> gauges — tracing.SUMMARY_SPECS. They stay plain
+# numeric keys in Manager.metrics() (the JSON surface is unchanged);
+# only the text exposition differs. quorum_ms_max keeps its own gauge
+# (summaries have no max slot).
+SUMMARY_CONSUMED_KEYS = frozenset(["quorum_ms_p50", "quorum_ms_p95"])
 
 # String-valued diagnostics, SPLIT from the numeric dict at the source
 # (Manager.metrics_info): the Prometheus /metrics endpoint renders them
@@ -96,7 +108,7 @@ DOCUMENTED_KEYS = frozenset([
 # no per-key carve-outs.
 DOCUMENTED_INFO_KEYS = frozenset([
     "policy_name", "policy_last_reason", "ckpt_last_error",
-    "flight_last_path", "ring_topology",
+    "flight_last_path", "ring_topology", "straggler_stage",
 ])
 
 # Span context tags every exported trace event must carry (the fleet
@@ -200,7 +212,7 @@ class TestPrometheusExposition:
                 labels={"replica_id": m.replica_id()})
         finally:
             m.shutdown()
-        for key in DOCUMENTED_KEYS:
+        for key in DOCUMENTED_KEYS - SUMMARY_CONSUMED_KEYS:
             assert f"torchft_{key}{{" in text, (
                 f"/metrics lost sample torchft_{key}")
         assert 'torchft_info{' in text
@@ -208,6 +220,16 @@ class TestPrometheusExposition:
             assert f'{key}="' in text, (
                 f"torchft_info lost label {key}")
         assert 'replica_id="metrics-schema"' in text
+        # The reservoir quantiles render as ONE summary family now.
+        assert "# TYPE torchft_quorum_ms summary" in text
+        assert 'quantile="0.5"' in text and 'quantile="0.95"' in text
+        assert "torchft_quorum_ms_sum{" in text
+        assert "torchft_quorum_ms_count{" in text
+        # ...while the exact max stays its own gauge, and the bare
+        # quantile gauges are GONE (consumed, not duplicated).
+        assert "torchft_quorum_ms_max{" in text
+        assert "torchft_quorum_ms_p50{" not in text
+        assert "torchft_quorum_ms_p95{" not in text
 
     def test_counter_vs_gauge_rule(self):
         text = tracing.prometheus_text(
@@ -215,6 +237,46 @@ class TestPrometheusExposition:
         assert "# TYPE torchft_x_total counter" in text
         assert "# TYPE torchft_y_count counter" in text
         assert "# TYPE torchft_z_ms_last gauge" in text
+
+    def test_help_and_type_on_every_family(self):
+        """Prometheus exposition-format conformance: every sample line
+        belongs to a family that was preceded by # HELP and # TYPE
+        lines (scrapers surface HELP text; some strict parsers reject
+        TYPE-less families)."""
+        m = make_manager()
+        try:
+            text = tracing.prometheus_text(
+                m.metrics(), m.metrics_info(),
+                labels={"replica_id": m.replica_id()})
+        finally:
+            m.shutdown()
+        helped, typed = set(), set()
+        for line in text.splitlines():
+            if line.startswith("# HELP "):
+                helped.add(line.split()[2])
+            elif line.startswith("# TYPE "):
+                typed.add(line.split()[2])
+            elif line and not line.startswith("#"):
+                name = line.split("{", 1)[0].split(" ", 1)[0]
+                base = name
+                # summary sub-samples belong to the base family
+                for suffix in ("_sum", "_count"):
+                    if name.endswith(suffix) and \
+                            name[: -len(suffix)] in typed:
+                        base = name[: -len(suffix)]
+                assert base in typed, f"{name} has no # TYPE"
+                assert base in helped, f"{name} has no # HELP"
+
+    def test_summary_quantile_values_match_metrics(self):
+        """The summary's quantile samples carry the reservoir's p50/p95
+        values verbatim — renamed, not recomputed."""
+        text = tracing.prometheus_text(
+            {"quorum_ms_p50": 12.5, "quorum_ms_p95": 99.25,
+             "quorum_ms_total": 250.0, "quorum_count": 20})
+        assert 'torchft_quorum_ms{quantile="0.5"} 12.5' in text
+        assert 'torchft_quorum_ms{quantile="0.95"} 99.25' in text
+        assert "torchft_quorum_ms_sum 250.0" in text
+        assert "torchft_quorum_ms_count 20.0" in text
 
     def test_large_counters_keep_full_precision(self):
         """A %g-style 6-sig-digit render freezes counters past 1e6

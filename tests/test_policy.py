@@ -221,7 +221,16 @@ class TestPolicyController:
         assert sig.comm_frac > 0.0
         assert set(sig.as_dict()) == {
             "failures_in_window", "window", "failure_rate",
-            "comm_frac", "quiet_boundaries", "churn_rate"}
+            "comm_frac", "quiet_boundaries", "churn_rate",
+            "fleet_p95_ms", "straggler_score"}
+        # Fleet hints flow through note_boundary into the signals
+        # (docs/design/fleet_health.md); absent they stay 0.0.
+        assert sig.fleet_p95_ms == 0.0
+        assert sig.straggler_score == 0.0
+        c.note_boundary(True, fleet_p95_ms=1234.5, straggler_score=2.5)
+        sig = c.last_signals
+        assert sig.fleet_p95_ms == 1234.5
+        assert sig.straggler_score == 2.5
 
 
 # -------------------------------------------------------------- int8 wire

@@ -36,6 +36,8 @@ from torchft_tpu.data import (BatchIterator, DistributedSampler,
                               ElasticBatchIterator, ElasticLoader,
                               ElasticSampler)
 from torchft_tpu.degraded import DegradedModeDriver, live_devices
+from torchft_tpu.fleet import (FleetAggregator, SLOConfig, SLOEngine,
+                               StepDigest)
 from torchft_tpu.local_sgd import (DiLoCoTrainer, StreamingDiLoCoTrainer,
                                    diloco_outer_optimizer)
 from torchft_tpu.manager import Manager, PreemptedExit, WorldSizeMode
@@ -84,6 +86,10 @@ __all__ = [
     "ElasticSampler",
     "diloco_outer_optimizer",
     "DummyCommunicator",
+    "FleetAggregator",
+    "SLOConfig",
+    "SLOEngine",
+    "StepDigest",
     "ErrorSwallowingCommunicator",
     "FlightRecorder",
     "FTOptimizer",

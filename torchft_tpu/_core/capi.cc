@@ -49,7 +49,8 @@ void* tft_lighthouse_new(const char* bind, uint64_t min_replicas,
                          int64_t eviction_staleness_factor,
                          const char* auth_token, int32_t fast_path,
                          const char* standby_of, int64_t replicate_ms,
-                         int64_t join_window_ms, char** err) {
+                         int64_t join_window_ms, const char* slo_spec,
+                         char** err) {
   try {
     LighthouseOpt opt;
     opt.bind = bind;
@@ -64,6 +65,7 @@ void* tft_lighthouse_new(const char* bind, uint64_t min_replicas,
     opt.standby_of = standby_of ? standby_of : "";
     opt.replicate_ms = replicate_ms;
     opt.join_window_ms = join_window_ms;
+    opt.slo_spec = slo_spec ? slo_spec : "";
     return new Lighthouse(opt);
   } catch (const std::exception& e) {
     fail(err, e.what());
@@ -110,6 +112,38 @@ void tft_manager_set_status(void* h, const char* metrics_json,
                             int64_t aborted_steps) {
   ((ManagerServer*)h)->set_status(metrics_json, heal_count, committed_steps,
                                   aborted_steps);
+}
+
+// Per-step telemetry digest (docs/design/fleet_health.md): scalar args,
+// not JSON — the C++ side has no JSON parser and the digest is a fixed
+// small schema. Mirrors proto StepDigest field for field.
+void tft_manager_set_digest(void* h, int64_t step, double step_wall_ms,
+                            double fetch_ms, double ring_ms,
+                            double put_ms, double vote_ms,
+                            double heal_bytes_inflight,
+                            double publish_bytes_inflight,
+                            int64_t policy_rung,
+                            double capacity_fraction,
+                            double churn_per_min, int32_t healing,
+                            double heal_last_ms, double publish_last_ms,
+                            const char* trace_addr) {
+  StepDigest d;
+  d.set_step(step);
+  d.set_step_wall_ms(step_wall_ms);
+  d.set_fetch_ms(fetch_ms);
+  d.set_ring_ms(ring_ms);
+  d.set_put_ms(put_ms);
+  d.set_vote_ms(vote_ms);
+  d.set_heal_bytes_inflight(heal_bytes_inflight);
+  d.set_publish_bytes_inflight(publish_bytes_inflight);
+  d.set_policy_rung(policy_rung);
+  d.set_capacity_fraction(capacity_fraction);
+  d.set_churn_per_min(churn_per_min);
+  d.set_healing(healing != 0);
+  d.set_heal_last_ms(heal_last_ms);
+  d.set_publish_last_ms(publish_last_ms);
+  d.set_trace_addr(trace_addr ? trace_addr : "");
+  ((ManagerServer*)h)->set_digest(d);
 }
 
 void tft_manager_farewell(void* h) { ((ManagerServer*)h)->farewell(); }
@@ -197,6 +231,16 @@ struct TftQuorumResult {
   int32_t heal;
   int32_t fast_path;
   int64_t epoch;
+  // Fleet health hint (docs/design/fleet_health.md); zero/empty when the
+  // fleet reports no digests. Layout mirrored by _native._CQuorumResult.
+  double fleet_p50_ms;
+  double fleet_p95_ms;
+  double fleet_max_ms;
+  int64_t fleet_groups;
+  double straggler_score;
+  char* straggler_stage;
+  char* straggler_id;
+  char* slo_breach;
 };
 
 void* tft_manager_client_new(const char* addr, int64_t connect_timeout_ms,
@@ -237,6 +281,14 @@ int tft_manager_client_quorum(void* h, int64_t rank, int64_t step,
   out->heal = r.heal();
   out->fast_path = r.fast_path();
   out->epoch = r.epoch();
+  out->fleet_p50_ms = r.fleet().fleet_p50_ms();
+  out->fleet_p95_ms = r.fleet().fleet_p95_ms();
+  out->fleet_max_ms = r.fleet().fleet_max_ms();
+  out->fleet_groups = r.fleet().digest_groups();
+  out->straggler_score = r.fleet().straggler_score();
+  out->straggler_stage = dup_str(r.fleet().straggler_stage());
+  out->straggler_id = dup_str(r.fleet().straggler_id());
+  out->slo_breach = dup_str(r.fleet().slo_breach());
   return 0;
 }
 

@@ -639,6 +639,37 @@ static void announce_beat(const std::string& lh_addr, const std::string& id,
                 2'000));
 }
 
+// join_beat with a telemetry digest attached (the fleet health plane's
+// piggyback, docs/design/fleet_health.md).
+static LighthouseQuorumResponse join_digest(const std::string& lh_addr,
+                                            const std::string& id,
+                                            int64_t step, double wall_ms,
+                                            double ring_ms = 0.0,
+                                            bool healing = false) {
+  RpcClient c(lh_addr, 2'000);
+  LighthouseQuorumRequest req;
+  *req.mutable_requester() = member(id, step);
+  auto* b = req.mutable_beat();
+  b->set_replica_id(id);
+  b->set_joining(true);
+  auto* d = b->mutable_digest();
+  d->set_step(step);
+  d->set_step_wall_ms(wall_ms);
+  d->set_fetch_ms(wall_ms * 0.25);
+  d->set_ring_ms(ring_ms);
+  d->set_put_ms(1.0);
+  d->set_vote_ms(2.0);
+  d->set_capacity_fraction(1.0);
+  d->set_healing(healing);
+  d->set_trace_addr("http://" + id + ":1");
+  std::string resp, err;
+  assert(c.call(kLighthouseQuorum, req.SerializeAsString(), &resp, &err,
+                20'000));
+  LighthouseQuorumResponse r;
+  assert(r.ParseFromString(resp));
+  return r;
+}
+
 static std::set<std::string> ids_of(const Quorum& q) {
   std::set<std::string> out;
   for (const auto& m : q.participants()) out.insert(m.replica_id());
@@ -1184,6 +1215,93 @@ static void test_farewell_invalidates_fast_path_cache() {
          (long long)waited);
 }
 
+// --------------------------------------------- fleet health plane tests
+// (docs/design/fleet_health.md; the aggregation math itself has a
+// larger battery against the Python mirror in tests/test_fleet.py)
+
+// Digests piggybacked on quorum beats feed the per-requester FleetHint:
+// the artificially slow group must lead the straggler ranking with its
+// slow stage attributed, breach the step-p95 SLO (echoed to IT alone),
+// and every group must see the same fleet quantiles.
+static void test_fleet_digest_hint_and_slo() {
+  LighthouseOpt lopt;
+  lopt.bind = "127.0.0.1:0";
+  lopt.min_replicas = 3;
+  lopt.join_timeout_ms = 500;
+  lopt.quorum_tick_ms = 10;
+  lopt.heartbeat_fresh_ms = 2'000;
+  lopt.slo_spec = "step_p95_ms=1000";
+  Lighthouse lh(lopt);
+
+  // Round 1: digests land as the beats are recorded (before the
+  // quorum serve), but the aggregate the hint reads is cached, so the
+  // authoritative assertions run against round 2.
+  {
+    std::vector<std::thread> ts;
+    ts.emplace_back([&] { join_digest(lh.address(), "a", 1, 100.0); });
+    ts.emplace_back([&] { join_digest(lh.address(), "b", 1, 110.0); });
+    ts.emplace_back([&] {
+      join_digest(lh.address(), "c", 1, 3000.0, /*ring_ms=*/2000.0);
+    });
+    for (auto& t : ts) t.join();
+  }
+  usleep(300'000);  // let the aggregate cache (200ms) expire
+
+  LighthouseQuorumResponse ra =
+      join_digest(lh.address(), "a", 2, 100.0);
+  LighthouseQuorumResponse rc =
+      join_digest(lh.address(), "c", 2, 3000.0, 2000.0);
+  assert(ra.fleet().digest_groups() == 3);
+  assert(ra.fleet().fleet_p95_ms() == 3000.0);
+  assert(ra.fleet().straggler_id() == "c");
+  // a is near the median: its own score is small and it breaches no SLO.
+  assert(ra.fleet().straggler_score() < 5.0);
+  assert(ra.fleet().slo_breach().empty());
+  // c leads the ranking, its slow stage is the ring, and the step-p95
+  // breach is echoed to IT (the flight dump lands on the straggler).
+  assert(rc.fleet().straggler_score() > 10.0);
+  assert(rc.fleet().straggler_stage() == "ring");
+  assert(rc.fleet().slo_breach().find("step_p95") != std::string::npos);
+  printf("test_fleet_digest_hint_and_slo ok (straggler score %.1f)\n",
+         rc.fleet().straggler_score());
+}
+
+// A digest-less fleet serves zero hints (raw clients stay bit-exact),
+// and a farewell withdraws the leaver from the aggregates immediately —
+// no departed group lingers as a phantom straggler.
+static void test_fleet_farewell_and_digestless() {
+  LighthouseOpt lopt;
+  lopt.bind = "127.0.0.1:0";
+  lopt.min_replicas = 1;
+  lopt.join_timeout_ms = 300;
+  lopt.quorum_tick_ms = 10;
+  lopt.heartbeat_fresh_ms = 2'000;
+  Lighthouse lh(lopt);
+
+  // Digest-less round: the hint is all-zero/empty.
+  LighthouseQuorumResponse r0 = join_beat(lh.address(), "a", 1);
+  assert(r0.fleet().digest_groups() == 0);
+  assert(r0.fleet().straggler_id().empty());
+
+  join_digest(lh.address(), "a", 2, 100.0);
+  {
+    std::vector<std::thread> ts;
+    ts.emplace_back([&] { join_digest(lh.address(), "a", 3, 100.0); });
+    ts.emplace_back([&] { join_digest(lh.address(), "b", 3, 900.0); });
+    for (auto& t : ts) t.join();
+  }
+  usleep(300'000);
+  LighthouseQuorumResponse r1 = join_digest(lh.address(), "a", 4, 100.0);
+  assert(r1.fleet().digest_groups() == 2);
+
+  announce_beat(lh.address(), "b", /*joining=*/false, /*leaving=*/true);
+  usleep(300'000);
+  LighthouseQuorumResponse r2 = join_digest(lh.address(), "a", 5, 100.0);
+  assert(r2.fleet().digest_groups() == 1);
+  assert(r2.fleet().straggler_id() == "a");
+  printf("test_fleet_farewell_and_digestless ok\n");
+}
+
 int main() {
   test_quorum_changed();
   test_store();
@@ -1203,6 +1321,8 @@ int main() {
   test_fast_vs_slow_identical_decisions();
   test_join_coalescing_window();
   test_farewell_invalidates_fast_path_cache();
+  test_fleet_digest_hint_and_slo();
+  test_fleet_farewell_and_digestless();
   test_standby_replication_and_promotion();
   test_manager_lighthouse_failover();
   printf("ALL CORE TESTS PASSED\n");

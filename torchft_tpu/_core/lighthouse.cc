@@ -1,7 +1,9 @@
 #include "lighthouse.h"
 
 #include <algorithm>
+#include <cmath>
 #include <cstdio>
+#include <cstdlib>
 #include <cstring>
 #include <set>
 #include <sstream>
@@ -129,6 +131,156 @@ void BeatTable::prune(int64_t now, int64_t keep_ms,
   }
 }
 
+// ---------------------------------------------------------------- digests
+
+void DigestTable::record(const std::string& id, const StepDigest& d,
+                         int64_t now) {
+  Shard& s = shard_for(id);
+  std::lock_guard<std::mutex> lk(s.mu);
+  auto& ring = s.rings[id];
+  ring.push_back(Entry{d, now});
+  while (ring.size() > kRing) ring.pop_front();
+}
+
+void DigestTable::erase(const std::string& id) {
+  Shard& s = shard_for(id);
+  std::lock_guard<std::mutex> lk(s.mu);
+  s.rings.erase(id);
+}
+
+void DigestTable::prune(int64_t now, int64_t keep_ms) {
+  for (Shard& s : shards_) {
+    std::lock_guard<std::mutex> lk(s.mu);
+    for (auto it = s.rings.begin(); it != s.rings.end();) {
+      if (it->second.empty() ||
+          now - it->second.back().recorded_ms > keep_ms)
+        it = s.rings.erase(it);
+      else
+        ++it;
+    }
+  }
+}
+
+std::map<std::string, DigestTable::Entry> DigestTable::latest(
+    int64_t now, int64_t stale_ms) const {
+  std::map<std::string, Entry> out;
+  for (const Shard& s : shards_) {
+    std::lock_guard<std::mutex> lk(s.mu);
+    for (const auto& [id, ring] : s.rings) {
+      if (ring.empty()) continue;
+      const Entry& e = ring.back();
+      if (now - e.recorded_ms > stale_ms) continue;
+      out[id] = e;
+    }
+  }
+  return out;
+}
+
+// ------------------------------------------------------------ fleet math
+// Mirrors torchft_tpu.fleet (the tier-1-testable Python spelling): the
+// two implementations must rank and attribute identically — change them
+// together (docs/design/fleet_health.md).
+
+namespace {
+
+// 1/Phi^-1(3/4): MAD -> sigma consistency constant (fleet.MAD_SIGMA).
+constexpr double kMadSigma = 1.4826;
+const char* kDigestStages[4] = {"fetch", "ring", "put", "vote"};
+
+double stage_value(const StepDigest& d, int i) {
+  switch (i) {
+    case 0: return d.fetch_ms();
+    case 1: return d.ring_ms();
+    case 2: return d.put_ms();
+    default: return d.vote_ms();
+  }
+}
+
+double median_of(std::vector<double> v) {
+  if (v.empty()) return 0.0;
+  std::sort(v.begin(), v.end());
+  size_t n = v.size();
+  return n % 2 ? v[n / 2] : 0.5 * (v[n / 2 - 1] + v[n / 2]);
+}
+
+double percentile_of(std::vector<double> v, double q) {
+  if (v.empty()) return 0.0;
+  std::sort(v.begin(), v.end());
+  size_t idx = (size_t)((double)v.size() * q);
+  if (idx >= v.size()) idx = v.size() - 1;
+  return v[idx];
+}
+
+// Healers / degraded-capacity groups are excluded from the straggler
+// baseline (their slowness is explained) — fleet.StepDigest
+// .baseline_eligible.
+bool baseline_eligible(const StepDigest& d) {
+  return !d.healing() && d.capacity_fraction() >= 0.999;
+}
+
+// Slowest-stage attribution vs the fleet's per-stage medians; ties
+// break in protocol order, all-under-median falls back to the group's
+// own largest stage (fleet.attribute_stage).
+std::string attribute_stage(const StepDigest& d,
+                            const double (&med)[4]) {
+  int best = -1;
+  double best_excess = -1e300;
+  for (int i = 0; i < 4; i++) {
+    double excess = stage_value(d, i) - med[i];
+    if (excess > best_excess + 1e-12) {
+      best = i;
+      best_excess = excess;
+    }
+  }
+  if (best < 0 || best_excess <= 0.0) {
+    int biggest = 0;
+    for (int i = 1; i < 4; i++)
+      if (stage_value(d, i) > stage_value(d, biggest)) biggest = i;
+    return stage_value(d, biggest) > 0.0 ? kDigestStages[biggest] : "";
+  }
+  return kDigestStages[best];
+}
+
+double round3(double v) { return std::floor(v * 1e3 + 0.5) / 1e3; }
+
+std::string fmt_double(double v) {
+  char buf[64];
+  snprintf(buf, sizeof buf, "%.6g", v);
+  return buf;
+}
+
+}  // namespace
+
+SLOConfig SLOConfig::parse(const std::string& spec) {
+  // Same grammar as fleet.SLOConfig.from_spec; unknown keys are
+  // IGNORED here (a C++ server must not die on a spec written for a
+  // newer build — the Python CLI validates strictly before passing).
+  SLOConfig cfg;
+  std::string rest = spec;
+  for (char& c : rest)
+    if (c == ',') c = ';';
+  while (!rest.empty()) {
+    size_t semi = rest.find(';');
+    std::string part =
+        semi == std::string::npos ? rest : rest.substr(0, semi);
+    rest = semi == std::string::npos ? "" : rest.substr(semi + 1);
+    size_t eq = part.find('=');
+    if (eq == std::string::npos) continue;
+    // Trim spaces around the key.
+    std::string key = part.substr(0, eq);
+    size_t b = key.find_first_not_of(' ');
+    size_t e = key.find_last_not_of(' ');
+    key = b == std::string::npos ? "" : key.substr(b, e - b + 1);
+    double val = atof(part.substr(eq + 1).c_str());
+    if (key == "step_p95_ms") cfg.step_p95_ms = val;
+    else if (key == "commit_rate") cfg.commit_rate = val;
+    else if (key == "heal_ms") cfg.heal_ms = val;
+    else if (key == "publish_lag_ms") cfg.publish_lag_ms = val;
+    else if (key == "staleness_ms") cfg.staleness_ms = val;
+  }
+  return cfg;
+}
+
 // ------------------------------------------------------------- lighthouse
 
 Lighthouse::Lighthouse(const LighthouseOpt& opt) : opt_(opt) {
@@ -148,6 +300,16 @@ Lighthouse::Lighthouse(const LighthouseOpt& opt) : opt_(opt) {
           .count()
       << 8;
   boot_id_ = quorum_id_;  // frozen incarnation identity (see lighthouse.h)
+  slo_ = SLOConfig::parse(opt_.slo_spec);
+  // The staleness SLO must be able to SEE a silent group: one older
+  // than digest_stale_ms is dropped from the aggregates entirely, so a
+  // threshold at/past the retention window could never breach (and an
+  // active breach would self-clear while the group is still silent).
+  // Widen retention to 2x the threshold so the breach fires and holds
+  // for a full staleness window before the group ages out.
+  if (slo_.staleness_ms >= 0)
+    opt_.digest_stale_ms = std::max(
+        opt_.digest_stale_ms, (int64_t)(2 * slo_.staleness_ms));
   promoted_.store(opt_.standby_of.empty());
   server_ = std::make_unique<RpcServer>(
       opt.bind,
@@ -429,6 +591,10 @@ bool Lighthouse::tick() {
     int64_t now = now_ms();
     int64_t keep_ms = std::max<int64_t>(10'000, 20 * opt_.heartbeat_fresh_ms);
     beats_.prune(now, keep_ms, prev_ids_);
+    // Silent groups fall out of the fleet aggregates the same way
+    // (latest() already filters by staleness; pruning bounds memory
+    // across a long job's churn of uuid-suffixed replica ids).
+    digests_.prune(now, opt_.digest_stale_ms);
   }
   if (!quorum_valid_locked()) return false;
   Quorum q;
@@ -487,10 +653,316 @@ void Lighthouse::record_beat(const LighthouseHeartbeatRequest& r) {
   if (r.replica_id().empty()) return;
   if (r.leaving()) {
     beats_.farewell(r.replica_id(), now_ms());
+    // A clean goodbye withdraws the group from the fleet aggregates
+    // immediately — no departed group may linger as a phantom
+    // straggler (docs/design/fleet_health.md).
+    digests_.erase(r.replica_id());
   } else {
     beats_.record(r.replica_id(), now_ms(), r.joining(), r.heal_count(),
                   r.committed_steps(), r.aborted_steps());
+    if (r.has_digest()) digests_.record(r.replica_id(), r.digest(),
+                                        now_ms());
   }
+}
+
+// --------------------------------------------------- fleet health plane
+
+std::shared_ptr<const FleetAggregate> Lighthouse::fleet_aggregate(
+    int64_t now) {
+  std::lock_guard<std::mutex> lk(fleet_mu_);
+  if (fleet_cache_ && fleet_cache_ms_ >= 0 &&
+      now - fleet_cache_ms_ < kFleetCacheMs)
+    return fleet_cache_;
+
+  auto agg = std::make_shared<FleetAggregate>();
+  agg->computed_ms = now;
+  auto latest = digests_.latest(now, opt_.digest_stale_ms);
+  agg->groups_n = (int64_t)latest.size();
+  // Garbage-collect SLO dedup entries for groups that left the
+  // aggregate (farewell/staleness): under long uuid-suffixed spot
+  // churn the map would otherwise fill to its backstop and evict the
+  // map-ordered FIRST key — possibly a LIVE group's, whose unchanged
+  // breach would then re-count as new. Keys are "slo|group".
+  for (auto it = slo_seen_.begin(); it != slo_seen_.end();) {
+    size_t bar = it->first.find('|');
+    std::string gid =
+        bar == std::string::npos ? "" : it->first.substr(bar + 1);
+    if (latest.count(gid) == 0)
+      it = slo_seen_.erase(it);
+    else
+      ++it;
+  }
+
+  // Baseline median/MAD (fleet.robust_zscores) + per-stage medians.
+  std::vector<double> walls;
+  std::vector<double> stage_vals[4];
+  for (const auto& [id, e] : latest) {
+    if (!baseline_eligible(e.d)) continue;
+    walls.push_back(e.d.step_wall_ms());
+    for (int i = 0; i < 4; i++)
+      stage_vals[i].push_back(stage_value(e.d, i));
+  }
+  agg->baseline_n = (int64_t)walls.size();
+  agg->p50 = round3(percentile_of(walls, 0.50));
+  agg->p95 = round3(percentile_of(walls, 0.95));
+  agg->max = walls.empty()
+                 ? 0.0
+                 : round3(*std::max_element(walls.begin(), walls.end()));
+  for (int i = 0; i < 4; i++)
+    agg->stage_median[i] = round3(median_of(stage_vals[i]));
+  double med = median_of(walls);
+  std::vector<double> dev;
+  dev.reserve(walls.size());
+  for (double w : walls) dev.push_back(std::fabs(w - med));
+  double denom = kMadSigma * median_of(dev);
+
+  for (const auto& [id, e] : latest) {
+    FleetAggregate::Group g;
+    g.replica_id = id;
+    g.d = e.d;
+    g.age_ms = now - e.recorded_ms;
+    g.baseline = baseline_eligible(e.d);
+    if (g.baseline) {
+      // Zero dispersion (uniform fleet / single group) -> all scores
+      // 0.0, never NaN (fleet.robust_zscores).
+      g.score = denom > 1e-9
+                    ? std::floor((e.d.step_wall_ms() - med) / denom *
+                                     1e4 + 0.5) / 1e4
+                    : 0.0;
+      g.stage = attribute_stage(e.d, agg->stage_median);
+    } else {
+      g.score = 0.0;
+      g.stage = e.d.healing() ? "heal" : "degraded";
+    }
+    agg->groups.push_back(std::move(g));
+  }
+  std::sort(agg->groups.begin(), agg->groups.end(),
+            [](const FleetAggregate::Group& a,
+               const FleetAggregate::Group& b) {
+              if (a.score != b.score) return a.score > b.score;
+              return a.replica_id < b.replica_id;
+            });
+  for (const auto& g : agg->groups) {
+    if (!g.baseline) continue;
+    // Ranked worst-first; the first baseline group is the straggler.
+    agg->straggler_id = g.replica_id;
+    agg->straggler_score = g.score;
+    agg->straggler_stage = g.stage;
+    break;
+  }
+
+  // SLO evaluation (fleet.SLOEngine.evaluate): attach current breaches
+  // per group, dedup NEW ones per (slo, group, step) into the bounded
+  // event log, and refresh the gauges. Commit-rate reads the beat
+  // counters that ride the same RPC.
+  if (slo_.enabled()) {
+    int64_t active = 0;
+    auto breach = [&](FleetAggregate::Group& g, const char* slo,
+                      double value, double threshold) {
+      g.slo_breaches.push_back(slo);
+      active++;
+      std::string key = std::string(slo) + "|" + g.replica_id;
+      auto it = slo_seen_.find(key);
+      if (it != slo_seen_.end() && it->second == g.d.step()) return;
+      slo_seen_[key] = g.d.step();
+      if (slo_seen_.size() > 4096)  // bounded dedup memory
+        slo_seen_.erase(slo_seen_.begin());
+      slo_breaches_total_++;
+      std::string ev = "{\"slo\":\"" + std::string(slo) +
+                       "\",\"replica_id\":\"" +
+                       json_escape(g.replica_id) +
+                       "\",\"step\":" + std::to_string(g.d.step()) +
+                       ",\"value\":" + fmt_double(value) +
+                       ",\"threshold\":" + fmt_double(threshold) + "}";
+      slo_events_.push_back(ev);
+      while (slo_events_.size() > 64) slo_events_.pop_front();
+      fprintf(stderr,
+              "torchft_tpu lighthouse: SLO BREACH %s on %s "
+              "(value %.3f, threshold %.3f, step %lld)\n",
+              slo, g.replica_id.c_str(), value, threshold,
+              (long long)g.d.step());
+      fflush(stderr);
+    };
+    for (auto& g : agg->groups) {
+      if (slo_.step_p95_ms >= 0 && agg->p95 > slo_.step_p95_ms &&
+          g.replica_id == agg->straggler_id)
+        breach(g, "step_p95", agg->p95, slo_.step_p95_ms);
+      if (slo_.heal_ms >= 0 && g.d.heal_last_ms() > slo_.heal_ms)
+        breach(g, "heal", g.d.heal_last_ms(), slo_.heal_ms);
+      if (slo_.publish_lag_ms >= 0 &&
+          g.d.publish_last_ms() > slo_.publish_lag_ms)
+        breach(g, "publish_lag", g.d.publish_last_ms(),
+               slo_.publish_lag_ms);
+      if (slo_.staleness_ms >= 0 &&
+          (double)g.age_ms > slo_.staleness_ms)
+        breach(g, "staleness", (double)g.age_ms, slo_.staleness_ms);
+      if (slo_.commit_rate >= 0) {
+        BeatTable::Beat b;
+        if (beats_.lookup(g.replica_id, &b)) {
+          int64_t total = b.committed_steps + b.aborted_steps;
+          if (total >= slo_.min_commit_samples) {
+            double rate = (double)b.committed_steps / (double)total;
+            if (rate < slo_.commit_rate)
+              breach(g, "commit_rate", rate, slo_.commit_rate);
+          }
+        }
+      }
+    }
+    slo_active_ = active;
+  }
+
+  fleet_cache_ = agg;
+  fleet_cache_ms_ = now;
+  return agg;
+}
+
+void Lighthouse::fill_fleet_hint(const std::string& id, FleetHint* out) {
+  auto agg = fleet_aggregate(now_ms());
+  if (agg->groups_n == 0) return;  // digest-less fleet: zero hint
+  out->set_fleet_p50_ms(agg->p50);
+  out->set_fleet_p95_ms(agg->p95);
+  out->set_fleet_max_ms(agg->max);
+  out->set_digest_groups(agg->groups_n);
+  out->set_straggler_id(agg->straggler_id);
+  for (const auto& g : agg->groups) {
+    if (g.replica_id != id) continue;
+    out->set_straggler_score(g.score);
+    out->set_straggler_stage(g.stage);
+    std::string joined;
+    for (const auto& s : g.slo_breaches) {
+      if (!joined.empty()) joined += ",";
+      joined += s;
+    }
+    out->set_slo_breach(joined);
+    break;
+  }
+}
+
+std::string Lighthouse::fleet_status_json(const FleetAggregate& agg) {
+  std::string out = "{\"format\":\"tft-fleet-1\",\"computed_ms\":" +
+                    std::to_string(agg.computed_ms) +
+                    ",\"fleet\":{\"groups\":" +
+                    std::to_string(agg.groups_n) +
+                    ",\"baseline_groups\":" +
+                    std::to_string(agg.baseline_n) +
+                    ",\"p50_ms\":" + fmt_double(agg.p50) +
+                    ",\"p95_ms\":" + fmt_double(agg.p95) +
+                    ",\"max_ms\":" + fmt_double(agg.max) +
+                    ",\"stage_median_ms\":{";
+  for (int i = 0; i < 4; i++) {
+    if (i) out += ",";
+    out += "\"" + std::string(kDigestStages[i]) +
+           "\":" + fmt_double(agg.stage_median[i]);
+  }
+  int64_t slo_active, slo_total;
+  std::string events;
+  {
+    std::lock_guard<std::mutex> lk(fleet_mu_);
+    slo_active = slo_active_;
+    slo_total = slo_breaches_total_;
+    bool first = true;
+    for (const auto& ev : slo_events_) {
+      if (!first) events += ",";
+      first = false;
+      events += ev;
+    }
+  }
+  out += "}},\"straggler\":{\"replica_id\":\"" +
+         json_escape(agg.straggler_id) +
+         "\",\"score\":" + fmt_double(agg.straggler_score) +
+         ",\"stage\":\"" + json_escape(agg.straggler_stage) +
+         "\"},\"slo\":{\"active\":" + std::to_string(slo_active) +
+         ",\"breaches_total\":" + std::to_string(slo_total) +
+         ",\"events\":[" + events;
+  out += "]},\"groups\":[";
+  for (size_t i = 0; i < agg.groups.size(); i++) {
+    const auto& g = agg.groups[i];
+    if (i) out += ",";
+    out += "{\"replica_id\":\"" + json_escape(g.replica_id) +
+           "\",\"step\":" + std::to_string(g.d.step()) +
+           ",\"age_ms\":" + std::to_string(g.age_ms) +
+           ",\"step_wall_ms\":" + fmt_double(round3(g.d.step_wall_ms())) +
+           ",\"stage_ms\":{";
+    for (int s = 0; s < 4; s++) {
+      if (s) out += ",";
+      out += "\"" + std::string(kDigestStages[s]) +
+             "\":" + fmt_double(round3(stage_value(g.d, s)));
+    }
+    out += "},\"straggler_score\":" + fmt_double(g.score) +
+           ",\"straggler_stage\":\"" + json_escape(g.stage) +
+           "\",\"healing\":" + (g.d.healing() ? "true" : "false") +
+           ",\"capacity_fraction\":" +
+           fmt_double(g.d.capacity_fraction()) +
+           ",\"policy_rung\":" + std::to_string(g.d.policy_rung()) +
+           ",\"churn_per_min\":" + fmt_double(g.d.churn_per_min()) +
+           ",\"heal_bytes_inflight\":" +
+           fmt_double(g.d.heal_bytes_inflight()) +
+           ",\"publish_bytes_inflight\":" +
+           fmt_double(g.d.publish_bytes_inflight()) +
+           ",\"heal_last_ms\":" + fmt_double(g.d.heal_last_ms()) +
+           ",\"publish_last_ms\":" + fmt_double(g.d.publish_last_ms()) +
+           ",\"baseline\":" + (g.baseline ? "true" : "false") +
+           ",\"slo_breach\":[";
+    for (size_t b = 0; b < g.slo_breaches.size(); b++) {
+      if (b) out += ",";
+      out += "\"" + json_escape(g.slo_breaches[b]) + "\"";
+    }
+    out += "],\"trace_addr\":\"" + json_escape(g.d.trace_addr()) +
+           "\"}";
+  }
+  out += "]}";
+  return out;
+}
+
+std::string Lighthouse::fleet_metrics_text(const FleetAggregate& agg) {
+  // Same names torchft_tpu.fleet.status_prometheus renders — the two
+  // expositions must not drift (frozen by tests/test_fleet.py).
+  int64_t slo_active_snapshot, slo_total_snapshot;
+  {
+    std::lock_guard<std::mutex> lk(fleet_mu_);
+    slo_active_snapshot = slo_active_;
+    slo_total_snapshot = slo_breaches_total_;
+  }
+  std::ostringstream os;
+  os << "# HELP torchft_fleet_groups groups contributing digests\n"
+     << "# TYPE torchft_fleet_groups gauge\n"
+     << "torchft_fleet_groups " << fmt_double((double)agg.groups_n)
+     << "\n"
+     << "# HELP torchft_fleet_step_ms fleet step-wall quantiles (ms)\n"
+     << "# TYPE torchft_fleet_step_ms summary\n"
+     << "torchft_fleet_step_ms{quantile=\"0.5\"} " << fmt_double(agg.p50)
+     << "\n"
+     << "torchft_fleet_step_ms{quantile=\"0.95\"} "
+     << fmt_double(agg.p95) << "\n"
+     << "# HELP torchft_fleet_step_ms_max slowest group step wall (ms)\n"
+     << "# TYPE torchft_fleet_step_ms_max gauge\n"
+     << "torchft_fleet_step_ms_max " << fmt_double(agg.max) << "\n"
+     << "# HELP torchft_fleet_slo_breach (slo, group) pairs out of SLO\n"
+     << "# TYPE torchft_fleet_slo_breach gauge\n"
+     << "torchft_fleet_slo_breach "
+     << fmt_double((double)slo_active_snapshot) << "\n"
+     << "# HELP torchft_fleet_slo_breaches_total breaches detected\n"
+     << "# TYPE torchft_fleet_slo_breaches_total counter\n"
+     << "torchft_fleet_slo_breaches_total "
+     << fmt_double((double)slo_total_snapshot) << "\n"
+     << "# HELP torchft_fleet_stage_median_ms fleet per-stage medians\n"
+     << "# TYPE torchft_fleet_stage_median_ms gauge\n";
+  for (int i = 0; i < 4; i++)
+    os << "torchft_fleet_stage_median_ms{stage=\"" << kDigestStages[i]
+       << "\"} " << fmt_double(agg.stage_median[i]) << "\n";
+  os << "# HELP torchft_fleet_straggler_score robust z of step wall vs "
+        "the fleet\n"
+     << "# TYPE torchft_fleet_straggler_score gauge\n"
+     << "# HELP torchft_fleet_group_step_ms group step wall (ms)\n"
+     << "# TYPE torchft_fleet_group_step_ms gauge\n";
+  for (const auto& g : agg.groups) {
+    std::string rid = json_escape(g.replica_id);
+    os << "torchft_fleet_straggler_score{replica_id=\"" << rid
+       << "\"} " << fmt_double(g.score) << "\n"
+       << "torchft_fleet_group_step_ms{replica_id=\"" << rid << "\"} "
+       << fmt_double(round3(g.d.step_wall_ms())) << "\n";
+  }
+  return os.str();
 }
 
 bool Lighthouse::handle_quorum(const LighthouseQuorumRequest& r,
@@ -550,6 +1022,11 @@ bool Lighthouse::handle_quorum(const LighthouseQuorumRequest& r,
     fast_path_hits_++;
     fast_round_step_ = std::max(fast_round_step_, me.step());
     fill_response_locked(out, /*fast=*/true);
+    // Fleet health hint (docs/design/fleet_health.md): cached-aggregate
+    // read under fleet_mu_ + leaf digest locks only — the fast path's
+    // latency budget never pays for aggregation (bounded by the
+    // kFleetCacheMs recompute cap).
+    fill_fleet_hint(me.replica_id(), out->mutable_fleet());
     return true;
   }
 
@@ -576,6 +1053,7 @@ bool Lighthouse::handle_quorum(const LighthouseQuorumRequest& r,
   }
   slow_path_served_++;
   fill_response_locked(out, /*fast=*/false);
+  fill_fleet_hint(me.replica_id(), out->mutable_fleet());
   return true;
 }
 
@@ -850,6 +1328,22 @@ std::string Lighthouse::handle_http(const std::string& request) {
     body = status_json(st);
     content_type = "application/json";
   } else
+  // GET /fleet/status.json → the fleet health aggregate (per-group
+  // digests, straggler ranking + attribution, SLO state) — the
+  // operator's "which group is slowing the quorum, and why" endpoint
+  // (docs/design/fleet_health.md). Never takes the quorum mutex.
+  if (request.rfind("GET /fleet/status.json", 0) == 0) {
+    auto agg = fleet_aggregate(now_ms());
+    body = fleet_status_json(*agg);
+    content_type = "application/json";
+  } else
+  // GET /fleet/metrics → the same aggregate as Prometheus text
+  // exposition (scrape config in docs/design/fleet_health.md).
+  if (request.rfind("GET /fleet/metrics", 0) == 0) {
+    auto agg = fleet_aggregate(now_ms());
+    body = fleet_metrics_text(*agg);
+    content_type = "text/plain; version=0.0.4; charset=utf-8";
+  } else
   // POST /replica/{id}/kill → Kill RPC to that member's manager.
   if (request.rfind("POST /replica/", 0) == 0) {
     const size_t id_start = strlen("POST /replica/");
@@ -933,6 +1427,30 @@ std::string Lighthouse::handle_http(const std::string& request) {
                  ? std::string("none registered")
                  : html_escape(st.standby_address()))
          << "</p>";
+    }
+    {
+      // Fleet health row (docs/design/fleet_health.md): one line of
+      // the aggregate + links to the machine endpoints; the full
+      // straggler table lives in `lighthouse.py --dashboard`.
+      auto agg = fleet_aggregate(now_ms());
+      os << "<p>fleet telemetry: " << agg->groups_n
+         << " group(s) reporting";
+      if (agg->groups_n > 0) {
+        char line[160];
+        snprintf(line, sizeof line,
+                 " &middot; step p50/p95/max %.0f/%.0f/%.0fms",
+                 agg->p50, agg->p95, agg->max);
+        os << line;
+        if (!agg->straggler_id.empty())
+          os << " &middot; straggler: "
+             << html_escape(agg->straggler_id) << " ("
+             << html_escape(agg->straggler_stage.empty()
+                                ? std::string("-")
+                                : agg->straggler_stage)
+             << ")";
+      }
+      os << " &middot; <a href='/fleet/status.json'>status</a> "
+         << "<a href='/fleet/metrics'>metrics</a></p>";
     }
     os << "<table border=1 cellpadding=4><tr><th>replica</th><th>step</th>"
        << "<th>world</th><th>heartbeat age</th><th>heals</th>"

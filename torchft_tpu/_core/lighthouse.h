@@ -28,6 +28,7 @@
 #include <array>
 #include <atomic>
 #include <condition_variable>
+#include <deque>
 #include <functional>
 #include <map>
 #include <memory>
@@ -35,6 +36,7 @@
 #include <set>
 #include <string>
 #include <thread>
+#include <vector>
 
 #include "rpc.h"
 #include "torchft.pb.h"
@@ -100,6 +102,15 @@ struct LighthouseOpt {
   // window also caps the extra latency a lone joiner pays. 0 (default)
   // disables: every joiner cuts its own round (pre-churn behavior).
   int64_t join_window_ms = 0;
+  // Fleet SLO spec (docs/design/fleet_health.md): "key=value" pairs
+  // joined by ';' or ',' — step_p95_ms / commit_rate / heal_ms /
+  // publish_lag_ms / staleness_ms (the same grammar
+  // torchft_tpu.fleet.SLOConfig.from_spec parses). Empty = no SLOs.
+  std::string slo_spec;
+  // A group whose newest digest is older than this drops out of the
+  // fleet aggregates (a departed/silent group must not linger as a
+  // phantom straggler).
+  int64_t digest_stale_ms = 60'000;
 };
 
 // Sharded liveness table: beat writes (the per-member hot path — 64+ clients
@@ -169,6 +180,83 @@ class BeatTable {
   std::atomic<int64_t> departed_seq_{0};
 };
 
+// Per-group telemetry digest rings (docs/design/fleet_health.md),
+// lock-striped beside the BeatTable with the same leaf-lock discipline:
+// digest writes ride the quorum-RPC beat of 64+ clients, so they must
+// never serialize on the quorum mutex. Bounded: kRing digests per group,
+// groups pruned on farewell/staleness.
+class DigestTable {
+ public:
+  static constexpr size_t kRing = 8;
+  struct Entry {
+    StepDigest d;
+    int64_t recorded_ms = 0;
+  };
+
+  void record(const std::string& id, const StepDigest& d, int64_t now);
+  void erase(const std::string& id);
+  // Drop groups whose newest digest is staler than keep_ms.
+  void prune(int64_t now, int64_t keep_ms);
+  // Latest digest per group, freshest-within-stale_ms only.
+  std::map<std::string, Entry> latest(int64_t now,
+                                      int64_t stale_ms) const;
+
+ private:
+  static constexpr size_t kShards = 16;
+  struct Shard {
+    mutable std::mutex mu;
+    std::map<std::string, std::deque<Entry>> rings;
+  };
+  Shard& shard_for(const std::string& id) {
+    return shards_[std::hash<std::string>{}(id) % kShards];
+  }
+  const Shard& shard_for(const std::string& id) const {
+    return shards_[std::hash<std::string>{}(id) % kShards];
+  }
+  std::array<Shard, kShards> shards_;
+};
+
+// Parsed SLO thresholds (< 0 = disabled), mirroring
+// torchft_tpu.fleet.SLOConfig.
+struct SLOConfig {
+  double step_p95_ms = -1;
+  double commit_rate = -1;
+  double heal_ms = -1;
+  double publish_lag_ms = -1;
+  double staleness_ms = -1;
+  int64_t min_commit_samples = 8;
+  static SLOConfig parse(const std::string& spec);
+  bool enabled() const {
+    return step_p95_ms >= 0 || commit_rate >= 0 || heal_ms >= 0 ||
+           publish_lag_ms >= 0 || staleness_ms >= 0;
+  }
+};
+
+// One computed fleet aggregate (the /fleet/status.json shape). The math
+// mirrors torchft_tpu.fleet.FleetAggregator.aggregate exactly — robust
+// z-scores vs the non-healing full-capacity baseline's median/MAD,
+// slowest-stage attribution vs per-stage fleet medians.
+struct FleetAggregate {
+  struct Group {
+    std::string replica_id;
+    StepDigest d;
+    int64_t age_ms = 0;
+    double score = 0.0;
+    std::string stage;  // attribution; "heal"/"degraded" when excluded
+    bool baseline = false;
+    std::vector<std::string> slo_breaches;  // SLOs THIS group breaches
+  };
+  int64_t computed_ms = 0;
+  int64_t groups_n = 0;
+  int64_t baseline_n = 0;
+  double p50 = 0.0, p95 = 0.0, max = 0.0;
+  double stage_median[4] = {0, 0, 0, 0};  // fetch, ring, put, vote
+  std::string straggler_id;
+  double straggler_score = 0.0;
+  std::string straggler_stage;
+  std::vector<Group> groups;  // score-ranked, worst first
+};
+
 class Lighthouse {
  public:
   explicit Lighthouse(const LighthouseOpt& opt);
@@ -191,6 +279,17 @@ class Lighthouse {
   bool handle_quorum(const LighthouseQuorumRequest& r,
                      LighthouseQuorumResponse* out, std::string* err);
   void record_beat(const LighthouseHeartbeatRequest& r);
+  // --- fleet health plane (docs/design/fleet_health.md) -----------------
+  // Recompute-or-reuse the cached fleet aggregate (bounded staleness;
+  // guarded by fleet_mu_ — NEVER the quorum mutex: digest reads take
+  // only the striped leaf locks, so 64+ quorum serves never convoy on
+  // aggregation). Also runs the SLO evaluation (breach events, dedup,
+  // gauges) when thresholds are configured.
+  std::shared_ptr<const FleetAggregate> fleet_aggregate(int64_t now);
+  // Fill the per-requester hint from the (cached) aggregate.
+  void fill_fleet_hint(const std::string& id, FleetHint* out);
+  std::string fleet_status_json(const FleetAggregate& agg);
+  std::string fleet_metrics_text(const FleetAggregate& agg);
   // Requires mu_ held. Forms a quorum if valid; returns true if one formed.
   bool tick();
   bool quorum_valid_locked() const;
@@ -264,6 +363,21 @@ class Lighthouse {
   std::string standby_addr_;
   BeatTable beats_;
   bool shutdown_ = false;
+
+  // --- fleet health plane (docs/design/fleet_health.md) -----------------
+  DigestTable digests_;
+  SLOConfig slo_;
+  std::mutex fleet_mu_;  // guards the aggregate cache + SLO dedup/events
+  std::shared_ptr<const FleetAggregate> fleet_cache_;
+  int64_t fleet_cache_ms_ = -1;
+  static constexpr int64_t kFleetCacheMs = 200;  // recompute cadence cap
+  // SLO breach dedup per (slo, group, step) — the flight recorder's
+  // (reason, step) discipline, fleet-side — plus the bounded event log
+  // /fleet/status.json serves and the exposition gauges.
+  std::map<std::string, int64_t> slo_seen_;  // "slo|group" -> last step
+  std::deque<std::string> slo_events_;       // JSON objects, newest last
+  int64_t slo_breaches_total_ = 0;
+  int64_t slo_active_ = 0;
 
   // Standby machinery. promoted_ is true from birth on a primary; on a
   // standby it flips once the primary is provably dead and gates Quorum

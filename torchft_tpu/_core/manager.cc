@@ -88,6 +88,12 @@ void ManagerServer::set_status(const std::string& metrics_json,
   aborted_steps_ = aborted_steps;
 }
 
+void ManagerServer::set_digest(const StepDigest& d) {
+  std::lock_guard<std::mutex> lk(mu_);
+  digest_ = d;
+  has_digest_ = true;
+}
+
 // GET /metrics.json on the manager RPC port: the Python Manager's last
 // pushed metrics snapshot (empty object before the first commit). The
 // lighthouse serves cluster-level status the same one-port way.
@@ -197,6 +203,8 @@ void ManagerServer::heartbeat_loop() {
   while (true) {
     bool joining;
     int64_t heals, committed, aborted, cadence, last_ok;
+    bool send_digest;
+    StepDigest digest;
     std::string addr;
     {
       std::unique_lock<std::mutex> lk(mu_);
@@ -209,6 +217,8 @@ void ManagerServer::heartbeat_loop() {
       heals = heal_count_;
       committed = committed_steps_;
       aborted = aborted_steps_;
+      send_digest = has_digest_;
+      if (send_digest) digest = digest_;
       cadence = opt_.heartbeat_ms;
       if (!joining && last_fast_path_ && keepalive_ms_ > cadence)
         cadence = keepalive_ms_;
@@ -235,6 +245,10 @@ void ManagerServer::heartbeat_loop() {
       r.set_heal_count(heals);
       r.set_committed_steps(committed);
       r.set_aborted_steps(aborted);
+      // Keepalive beats re-carry the last digest so a group parked in
+      // a long step (compiling, healing) keeps its fleet-health row
+      // fresh instead of aging into the staleness SLO.
+      if (send_digest) *r.mutable_digest() = digest;
       std::string resp, err;
       if (client->call(kLighthouseHeartbeat, r.SerializeAsString(), &resp,
                        &err, 1'000)) {
@@ -395,6 +409,11 @@ bool ManagerServer::handle_quorum(const ManagerQuorumRequest& r,
       beat->set_heal_count(heal_count_);
       beat->set_committed_steps(committed_steps_);
       beat->set_aborted_steps(aborted_steps_);
+      // Telemetry piggyback (docs/design/fleet_health.md): the digest
+      // the Python Manager pushed at the last commit boundary rides
+      // the beat — fleet health costs zero extra RPCs. Absent until
+      // the first set_digest (legacy/raw clients stay bit-exact).
+      if (has_digest_) *beat->mutable_digest() = digest_;
     }
     std::string announce_addr = current_lighthouse_locked();
     lk.unlock();
@@ -501,6 +520,7 @@ bool ManagerServer::handle_quorum(const ManagerQuorumRequest& r,
     } else {
       round->quorum = lout.quorum();
       round->fast_path = lout.fast_path();
+      round->fleet = lout.fleet();
       last_fast_path_ = lout.fast_path();
       keepalive_ms_ = lout.keepalive_ms();
       last_beat_ok_ms_ = now_ms();  // the request piggybacked our beat
@@ -558,6 +578,9 @@ bool ManagerServer::compute_response(const QuorumRound& round, int64_t rank,
   out->set_quorum_id(round.quorum.quorum_id());
   out->set_fast_path(round.fast_path);
   out->set_epoch(round.quorum.epoch());
+  // Fleet health hint, identical for every local rank of the group
+  // (the lighthouse computed it for this replica_id).
+  *out->mutable_fleet() = round.fleet;
   out->set_recover_manager_address(primary->address());
   // Rendezvous store for this rank's cross-group communicator = the
   // primary's store, namespaced by quorum_id downstream (the PrefixStore

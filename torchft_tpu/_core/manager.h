@@ -80,6 +80,13 @@ class ManagerServer {
   void set_status(const std::string& metrics_json, int64_t heal_count,
                   int64_t committed_steps, int64_t aborted_steps);
 
+  // Per-step telemetry digest (docs/design/fleet_health.md), pushed by
+  // the Python Manager once per commit boundary; piggybacks on the
+  // quorum RPC beat (and the periodic keepalive beat) so the lighthouse
+  // can aggregate fleet health with ZERO extra RPCs. Never calling this
+  // keeps the wire bit-exact with digest-less builds.
+  void set_digest(const StepDigest& d);
+
   // Times this manager re-dialed a DIFFERENT lighthouse endpoint (primary
   // death -> standby, or rotation through a configured candidate list).
   // Surfaced in Manager.metrics() as `lighthouse_redials`.
@@ -133,6 +140,9 @@ class ManagerServer {
     bool done = false;
     Quorum quorum;
     bool fast_path = false;  // the lighthouse served this round from cache
+    // Fleet health hint from the lighthouse response, forwarded to every
+    // local rank of the group (docs/design/fleet_health.md).
+    FleetHint fleet;
     std::string error;
   };
   std::map<int64_t, std::shared_ptr<QuorumRound>> quorum_rounds_;  // by step
@@ -189,6 +199,10 @@ class ManagerServer {
   int64_t heal_count_ = 0;
   int64_t committed_steps_ = 0;
   int64_t aborted_steps_ = 0;
+  // Last telemetry digest push (see set_digest); attached to outgoing
+  // beats only once set (has_digest_ false = bit-exact legacy beats).
+  StepDigest digest_;
+  bool has_digest_ = false;
 
   std::unique_ptr<RpcServer> server_;
   std::thread heartbeat_thread_;

@@ -98,7 +98,7 @@ def _load() -> ctypes.CDLL:
     lib.tft_free.restype = None
 
     lib.tft_lighthouse_new.argtypes = [c, u64, i64, i64, i64, i64, i64, c,
-                                       i32, c, i64, i64,
+                                       i32, c, i64, i64, c,
                                        ctypes.POINTER(vp)]
     lib.tft_lighthouse_new.restype = vp
     lib.tft_lighthouse_address.argtypes = [vp]
@@ -115,6 +115,11 @@ def _load() -> ctypes.CDLL:
     lib.tft_manager_free.argtypes = [vp]
     lib.tft_manager_set_status.argtypes = [vp, c, i64, i64, i64]
     lib.tft_manager_set_status.restype = None
+    dbl = ctypes.c_double
+    lib.tft_manager_set_digest.argtypes = [
+        vp, i64, dbl, dbl, dbl, dbl, dbl, dbl, dbl, i64, dbl, dbl, i32,
+        dbl, dbl, c]
+    lib.tft_manager_set_digest.restype = None
     lib.tft_manager_farewell.argtypes = [vp]
     lib.tft_manager_farewell.restype = None
     lib.tft_manager_hard_stop.argtypes = [vp]
@@ -178,6 +183,16 @@ class _CQuorumResult(ctypes.Structure):
         ("heal", ctypes.c_int32),
         ("fast_path", ctypes.c_int32),
         ("epoch", ctypes.c_int64),
+        # Fleet health hint (docs/design/fleet_health.md) — must mirror
+        # capi.cc's TftQuorumResult layout exactly.
+        ("fleet_p50_ms", ctypes.c_double),
+        ("fleet_p95_ms", ctypes.c_double),
+        ("fleet_max_ms", ctypes.c_double),
+        ("fleet_groups", ctypes.c_int64),
+        ("straggler_score", ctypes.c_double),
+        ("straggler_stage", ctypes.c_void_p),
+        ("straggler_id", ctypes.c_void_p),
+        ("slo_breach", ctypes.c_void_p),
     ]
 
 
@@ -227,7 +242,8 @@ class Lighthouse:
                  fast_path: bool = True,
                  standby_of: str = "",
                  replicate_ms: int = 100,
-                 join_window_ms: int = 0):
+                 join_window_ms: int = 0,
+                 slo: str = ""):
         """``heartbeat_fresh_ms``/``heartbeat_grace_factor``: a previous
         member absent from the join round but heartbeating within
         ``heartbeat_fresh_ms`` extends the straggler wait to
@@ -265,7 +281,22 @@ class Lighthouse:
         round, the cut holds open this long from the first joiner's
         arrival so a join storm is admitted as ONE membership delta
         (reconfigures scale with windows, not joiners; the
-        ``joins_coalesced`` status counter observes it). 0 disables."""
+        ``joins_coalesced`` status counter observes it). 0 disables.
+
+        ``slo``: fleet SLO spec (docs/design/fleet_health.md) —
+        ``key=value`` pairs joined by ``;``/``,`` over ``step_p95_ms``
+        / ``commit_rate`` / ``heal_ms`` / ``publish_lag_ms`` /
+        ``staleness_ms``; a breach lands a fleet event, flips the
+        ``slo_breach`` gauge on ``GET /fleet/metrics``, and is echoed
+        to the guilty group in its quorum response (triggering its
+        local flight-recorder dump). Empty = no SLOs. Validated
+        STRICTLY here (unknown key / bad number raises ValueError):
+        the C++ parser is lenient by design — atof() would turn a
+        typo'd threshold into an always-firing 0.0 SLO."""
+        if slo:
+            from torchft_tpu.fleet import SLOConfig
+
+            SLOConfig.from_spec(slo)
         err = ctypes.c_void_p()
         self._h = _check_handle(
             lib().tft_lighthouse_new(bind.encode(), min_replicas,
@@ -276,7 +307,7 @@ class Lighthouse:
                                      auth_token.encode(),
                                      1 if fast_path else 0,
                                      standby_of.encode(), replicate_ms,
-                                     join_window_ms,
+                                     join_window_ms, slo.encode(),
                                      ctypes.byref(err)), err)
 
     def address(self) -> str:
@@ -329,6 +360,32 @@ class ManagerServer:
         lib().tft_manager_set_status(self._h, metrics_json.encode(),
                                      heal_count, committed_steps,
                                      aborted_steps)
+
+    def set_digest(self, step: int, step_wall_ms: float,
+                   fetch_ms: float = 0.0, ring_ms: float = 0.0,
+                   put_ms: float = 0.0, vote_ms: float = 0.0,
+                   heal_bytes_inflight: float = 0.0,
+                   publish_bytes_inflight: float = 0.0,
+                   policy_rung: int = -1,
+                   capacity_fraction: float = 1.0,
+                   churn_per_min: float = 0.0,
+                   healing: bool = False,
+                   heal_last_ms: float = 0.0,
+                   publish_last_ms: float = 0.0,
+                   trace_addr: str = "") -> None:
+        """Push the per-step telemetry digest
+        (docs/design/fleet_health.md): it piggybacks on this server's
+        quorum RPC beat (and keepalive beats), feeding the lighthouse's
+        fleet aggregates at zero extra RPCs. Never calling this keeps
+        beats bit-exact with digest-less builds."""
+        lib().tft_manager_set_digest(
+            self._h, int(step), float(step_wall_ms), float(fetch_ms),
+            float(ring_ms), float(put_ms), float(vote_ms),
+            float(heal_bytes_inflight), float(publish_bytes_inflight),
+            int(policy_rung), float(capacity_fraction),
+            float(churn_per_min), 1 if healing else 0,
+            float(heal_last_ms), float(publish_last_ms),
+            trace_addr.encode())
 
     def lighthouse_redials(self) -> int:
         """Times this manager re-dialed a DIFFERENT lighthouse endpoint
@@ -521,6 +578,19 @@ class QuorumResult:
     heal: bool
     fast_path: bool = False
     epoch: int = 0
+    # Fleet health hint (docs/design/fleet_health.md): fleet step-wall
+    # quantiles, this group's robust-z straggler score + slowest-stage
+    # attribution, the fleet's worst group, and any SLOs THIS group is
+    # currently breaching (comma-joined; "" = inside SLOs). All
+    # zero/empty when the fleet reports no digests.
+    fleet_p50_ms: float = 0.0
+    fleet_p95_ms: float = 0.0
+    fleet_max_ms: float = 0.0
+    fleet_groups: int = 0
+    straggler_score: float = 0.0
+    straggler_stage: str = ""
+    straggler_id: str = ""
+    slo_breach: str = ""
 
 
 class ManagerClient(_RetryingNativeClient):
@@ -573,6 +643,14 @@ class ManagerClient(_RetryingNativeClient):
             heal=bool(res.heal),
             fast_path=bool(res.fast_path),
             epoch=res.epoch,
+            fleet_p50_ms=res.fleet_p50_ms,
+            fleet_p95_ms=res.fleet_p95_ms,
+            fleet_max_ms=res.fleet_max_ms,
+            fleet_groups=res.fleet_groups,
+            straggler_score=res.straggler_score,
+            straggler_stage=_take_str(res.straggler_stage),
+            straggler_id=_take_str(res.straggler_id),
+            slo_breach=_take_str(res.slo_breach),
         )
 
     def checkpoint_address(self, rank: int, timeout_ms: int = 10_000) -> str:

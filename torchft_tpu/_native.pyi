@@ -23,6 +23,7 @@ class Lighthouse:
         standby_of: str = ...,
         replicate_ms: int = ...,
         join_window_ms: int = ...,
+        slo: str = ...,
     ) -> None: ...
     def address(self) -> str: ...
     def status(self, timeout_ms: int = ...) -> dict: ...
@@ -46,6 +47,24 @@ class ManagerServer:
         heal_count: int = ...,
         committed_steps: int = ...,
         aborted_steps: int = ...,
+    ) -> None: ...
+    def set_digest(
+        self,
+        step: int,
+        step_wall_ms: float,
+        fetch_ms: float = ...,
+        ring_ms: float = ...,
+        put_ms: float = ...,
+        vote_ms: float = ...,
+        heal_bytes_inflight: float = ...,
+        publish_bytes_inflight: float = ...,
+        policy_rung: int = ...,
+        capacity_fraction: float = ...,
+        churn_per_min: float = ...,
+        healing: bool = ...,
+        heal_last_ms: float = ...,
+        publish_last_ms: float = ...,
+        trace_addr: str = ...,
     ) -> None: ...
     def lighthouse_redials(self) -> int: ...
     def lighthouse_addr(self) -> str: ...
@@ -78,6 +97,14 @@ class QuorumResult:
     heal: bool
     fast_path: bool = ...
     epoch: int = ...
+    fleet_p50_ms: float = ...
+    fleet_p95_ms: float = ...
+    fleet_max_ms: float = ...
+    fleet_groups: int = ...
+    straggler_score: float = ...
+    straggler_stage: str = ...
+    straggler_id: str = ...
+    slo_breach: str = ...
 
 class ManagerClient:
     def __init__(self, address: str, connect_timeout_ms: int = ...,

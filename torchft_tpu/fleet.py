@@ -1,0 +1,606 @@
+"""Fleet health plane: digest aggregation, straggler attribution, SLOs
+(docs/design/fleet_health.md).
+
+At 64-256 replica groups the per-group surfaces (``/metrics.json``,
+``/trace.json``) answer "what is THIS group doing" but not the question
+an operator actually asks: *which group is slowing the quorum, why, and
+is the job inside its SLOs?* This module is the pure-Python spelling of
+the fleet health plane the Lighthouse runs natively
+(``_core/lighthouse.cc``):
+
+* :class:`StepDigest` — the compact per-step metric digest every
+  manager piggybacks on its quorum RPC beat (step wall, stage splits
+  from the tracer, heal/publish activity, policy rung, capacity,
+  churn). Mirrors proto ``StepDigest`` field for field.
+* :class:`FleetAggregator` — bounded per-group digest rings plus the
+  ranking/attribution math: fleet p50/p95/max step time, per-stage
+  fleet medians, and a robust-z **straggler score** per group
+  attributed to its slowest stage. This is the SAME math
+  ``lighthouse.cc`` serves at ``GET /fleet/status.json`` — kept here in
+  Python so it is tier-1-testable without the native toolchain, and so
+  the nightly churn soak can cross-check the native endpoint against
+  it.
+* :class:`SLOEngine` — declarative thresholds (``TORCHFT_SLO`` /
+  ``--slo``) evaluated against the aggregate; a breach names the
+  guilty group so the flight-recorder dump lands on the straggler
+  itself, deduped per (slo, group, step).
+* Renderers — ``status_prometheus`` (the ``GET /fleet/metrics``
+  exposition), :func:`format_fleet_table` (the ``lighthouse.py
+  --dashboard`` terminal view), :func:`resolve_trace_addrs` (the
+  ``scripts/tracefleet.py --fleet`` address resolution).
+
+Observability first: the straggler score and SLO hints are SIGNALS
+(``PolicySignals.fleet_p95_ms`` / ``straggler_score``, flight dumps) —
+nothing here evicts a group.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import time
+from collections import OrderedDict, deque
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Tuple
+
+FLEET_FORMAT = "tft-fleet-1"
+
+# Stage split carried by every digest, in protocol order. The
+# attribution tie-break follows this order too ("fetch" wins a tie) —
+# frozen by tests/test_fleet.py.
+DIGEST_STAGES = ("fetch", "ring", "put", "vote")
+
+# Robust z-score scale: 1/Phi^-1(3/4), the consistency constant that
+# makes MAD estimate sigma under normality. The SAME constant is spelled
+# in lighthouse.cc's aggregator — the two implementations must rank
+# identically.
+MAD_SIGMA = 1.4826
+
+# The declarative SLO knobs (docs/design/fleet_health.md). Spec string:
+# "step_p95_ms=2500;commit_rate=0.95;heal_ms=60000;publish_lag_ms=5000;
+#  staleness_ms=30000" — ';' or ',' separated, unknown keys rejected.
+SLO_KEYS = ("step_p95_ms", "commit_rate", "heal_ms", "publish_lag_ms",
+            "staleness_ms")
+
+
+def _now_ms() -> int:
+    return time.monotonic_ns() // 1_000_000
+
+
+@dataclass
+class StepDigest:
+    """One group's per-step telemetry digest (proto ``StepDigest``).
+
+    Attached to the quorum RPC beat once per commit boundary by
+    ``Manager._publish_status`` — a few dozen bytes, absent entirely
+    when fleet telemetry is off (raw clients stay bit-exact)."""
+
+    replica_id: str = ""
+    step: int = 0
+    step_wall_ms: float = 0.0
+    # Stage splits, from the tracer's per-step span totals
+    # (``Tracer.stage_totals``): fetch = fetch_dispatch + fetch_wait.
+    fetch_ms: float = 0.0
+    ring_ms: float = 0.0
+    put_ms: float = 0.0
+    vote_ms: float = 0.0
+    heal_bytes_inflight: float = 0.0
+    publish_bytes_inflight: float = 0.0
+    policy_rung: int = -1
+    capacity_fraction: float = 1.0
+    churn_per_min: float = 0.0
+    healing: bool = False
+    # Last heal / publish wall this boundary (0 when none happened):
+    # the heal-duration and publish-lag SLO inputs.
+    heal_last_ms: float = 0.0
+    publish_last_ms: float = 0.0
+    # The group's checkpoint-server base address — where /trace.json
+    # and /metrics live. Lets tracefleet resolve the fleet from
+    # /fleet/status.json with no quorum-store access.
+    trace_addr: str = ""
+
+    def stage_ms(self) -> Dict[str, float]:
+        return {"fetch": self.fetch_ms, "ring": self.ring_ms,
+                "put": self.put_ms, "vote": self.vote_ms}
+
+    def baseline_eligible(self) -> bool:
+        """Whether this digest may shape the fleet baseline: healers
+        and degraded-capacity groups are legitimately slow, so they are
+        EXCLUDED from the median/MAD (and never ranked straggler) —
+        their slowness is already explained."""
+        return not self.healing and self.capacity_fraction >= 0.999
+
+
+def _median(vals: List[float]) -> float:
+    if not vals:
+        return 0.0
+    s = sorted(vals)
+    n = len(s)
+    mid = n // 2
+    return s[mid] if n % 2 else 0.5 * (s[mid - 1] + s[mid])
+
+
+def _percentile(vals: List[float], q: float) -> float:
+    if not vals:
+        return 0.0
+    s = sorted(vals)
+    return s[min(len(s) - 1, int(len(s) * q))]
+
+
+def robust_zscores(values: List[float]) -> List[float]:
+    """Robust z-score of each value vs the set's median, scaled by
+    ``MAD_SIGMA * MAD``. A zero MAD (uniform fleet, or a single group)
+    yields all-zero scores — never a NaN/inf: an undispersed fleet has
+    no straggler, and the score must stay a safe PolicySignals input."""
+    if not values:
+        return []
+    med = _median(values)
+    mad = _median([abs(v - med) for v in values])
+    denom = MAD_SIGMA * mad
+    if denom <= 1e-9:
+        return [0.0 for _ in values]
+    return [(v - med) / denom for v in values]
+
+
+def attribute_stage(stage_ms: Dict[str, float],
+                    stage_median_ms: Dict[str, float]) -> str:
+    """Name the stage most responsible for a group's slowness: the one
+    with the largest excess over the fleet's per-stage median (ties
+    break in DIGEST_STAGES protocol order). Falls back to the group's
+    own largest stage when it beats every median (then nothing is "in
+    excess", but the answer to "where does its time go" still is its
+    biggest stage)."""
+    best, best_excess = "", float("-inf")
+    for s in DIGEST_STAGES:
+        excess = stage_ms.get(s, 0.0) - stage_median_ms.get(s, 0.0)
+        if excess > best_excess + 1e-12:
+            best, best_excess = s, excess
+    if best_excess <= 0.0:
+        biggest = max(DIGEST_STAGES,
+                      key=lambda s: (stage_ms.get(s, 0.0),
+                                     -DIGEST_STAGES.index(s)))
+        return biggest if stage_ms.get(biggest, 0.0) > 0.0 else ""
+    return best
+
+
+class FleetAggregator:
+    """Bounded per-group digest rings + the fleet aggregate.
+
+    The native Lighthouse keeps the authoritative copy (lock-striped
+    beside its ``BeatTable``); this mirror carries the identical math
+    for tier-1 tests, the dashboard renderer, and soak cross-checks.
+    Not thread-safe — callers (tests, the dashboard poller) own the
+    synchronization.
+
+    Args:
+        ring: digests retained per group (the per-group history the
+            dashboard's trend column reads; aggregates use the latest).
+        stale_ms: a group whose newest digest is older than this is
+            dropped from aggregates (and pruned) — a departed group
+            must not linger as a phantom straggler.
+        slo: when given, retention widens to ``2 * slo.staleness_ms``
+            if that exceeds ``stale_ms`` — the staleness SLO must be
+            able to SEE a silent group (one already dropped from the
+            aggregates could never breach). Mirrors the native
+            lighthouse's constructor behavior.
+    """
+
+    def __init__(self, ring: int = 8, stale_ms: int = 60_000,
+                 slo: Optional["SLOConfig"] = None) -> None:
+        self._ring = max(int(ring), 1)
+        if slo is not None and slo.staleness_ms is not None:
+            stale_ms = max(int(stale_ms), int(2 * slo.staleness_ms))
+        self._stale_ms = max(int(stale_ms), 1)
+        # replica_id -> deque[(recorded_ms, StepDigest)]
+        self._groups: "OrderedDict[str, deque]" = OrderedDict()
+        # replica_id -> (committed_steps, aborted_steps) — the beat
+        # counters the commit-rate SLO reads (ride the same RPC).
+        self._commit_counts: Dict[str, Tuple[int, int]] = {}
+
+    def ingest(self, digest: StepDigest,
+               now_ms: Optional[int] = None) -> None:
+        if not digest.replica_id:
+            return
+        now = _now_ms() if now_ms is None else int(now_ms)
+        ring = self._groups.get(digest.replica_id)
+        if ring is None:
+            ring = self._groups[digest.replica_id] = deque(
+                maxlen=self._ring)
+        ring.append((now, digest))
+
+    def note_commit_counts(self, replica_id: str, committed: int,
+                           aborted: int) -> None:
+        self._commit_counts[replica_id] = (int(committed), int(aborted))
+
+    def remove(self, replica_id: str) -> None:
+        """Drop a departed group immediately (farewell / eviction): its
+        history must not shape the baseline or linger in aggregates."""
+        self._groups.pop(replica_id, None)
+        self._commit_counts.pop(replica_id, None)
+
+    def prune(self, now_ms: Optional[int] = None) -> None:
+        now = _now_ms() if now_ms is None else int(now_ms)
+        for rid in [rid for rid, ring in self._groups.items()
+                    if not ring or now - ring[-1][0] > self._stale_ms]:
+            self.remove(rid)
+
+    def group_ids(self) -> List[str]:
+        return list(self._groups)
+
+    def commit_counts(self) -> Dict[str, Tuple[int, int]]:
+        return dict(self._commit_counts)
+
+    # ------------------------------------------------------------ aggregate
+
+    def aggregate(self, now_ms: Optional[int] = None) -> Dict[str, Any]:
+        """The fleet aggregate (the ``GET /fleet/status.json`` shape).
+
+        Latest fresh digest per group; baseline = non-healing,
+        full-capacity groups (see ``StepDigest.baseline_eligible``).
+        Scores are robust z vs the BASELINE's median/MAD; non-baseline
+        groups score 0.0 with their exclusion reason as the
+        attribution (``heal`` / ``degraded``) — their slowness is
+        explained, and ranking them would bury the real straggler."""
+        now = _now_ms() if now_ms is None else int(now_ms)
+        latest: "OrderedDict[str, Tuple[int, StepDigest]]" = OrderedDict()
+        for rid in sorted(self._groups):
+            ring = self._groups[rid]
+            if not ring:
+                continue
+            rec_ms, d = ring[-1]
+            if now - rec_ms > self._stale_ms:
+                continue
+            latest[rid] = (rec_ms, d)
+
+        baseline = [(rid, d) for rid, (_, d) in latest.items()
+                    if d.baseline_eligible()]
+        walls = [d.step_wall_ms for _, d in baseline]
+        scores = robust_zscores(walls)
+        score_by_id = {rid: sc for (rid, _), sc in zip(baseline, scores)}
+        stage_median = {
+            s: _median([d.stage_ms()[s] for _, d in baseline])
+            for s in DIGEST_STAGES}
+
+        groups: List[Dict[str, Any]] = []
+        for rid, (rec_ms, d) in latest.items():
+            in_baseline = d.baseline_eligible()
+            score = score_by_id.get(rid, 0.0)
+            if in_baseline:
+                stage = attribute_stage(d.stage_ms(), stage_median)
+            else:
+                stage = "heal" if d.healing else "degraded"
+            groups.append({
+                "replica_id": rid,
+                "step": d.step,
+                "age_ms": now - rec_ms,
+                "step_wall_ms": round(d.step_wall_ms, 3),
+                "stage_ms": {k: round(v, 3)
+                             for k, v in d.stage_ms().items()},
+                "straggler_score": round(score, 4),
+                "straggler_stage": stage,
+                "healing": bool(d.healing),
+                "capacity_fraction": d.capacity_fraction,
+                "policy_rung": d.policy_rung,
+                "churn_per_min": d.churn_per_min,
+                "heal_bytes_inflight": d.heal_bytes_inflight,
+                "publish_bytes_inflight": d.publish_bytes_inflight,
+                "heal_last_ms": d.heal_last_ms,
+                "publish_last_ms": d.publish_last_ms,
+                "baseline": in_baseline,
+                "trace_addr": d.trace_addr,
+            })
+        groups.sort(key=lambda g: (-g["straggler_score"],
+                                   g["replica_id"]))
+
+        straggler = {"replica_id": "", "score": 0.0, "stage": ""}
+        ranked = [g for g in groups if g["baseline"]]
+        if ranked:
+            # groups is already sorted (score desc, id asc): the first
+            # baseline row IS the straggler — same tie-break as the
+            # native aggregator and as this very table's ordering.
+            top = ranked[0]
+            straggler = {"replica_id": top["replica_id"],
+                         "score": top["straggler_score"],
+                         "stage": top["straggler_stage"]}
+        return {
+            "format": FLEET_FORMAT,
+            "computed_ms": now,
+            "fleet": {
+                "groups": len(latest),
+                "baseline_groups": len(baseline),
+                "p50_ms": round(_percentile(walls, 0.50), 3),
+                "p95_ms": round(_percentile(walls, 0.95), 3),
+                "max_ms": round(max(walls), 3) if walls else 0.0,
+                "stage_median_ms": {k: round(v, 3)
+                                    for k, v in stage_median.items()},
+            },
+            "straggler": straggler,
+            "groups": groups,
+        }
+
+
+# -------------------------------------------------------------------- SLOs
+
+
+@dataclass
+class SLOConfig:
+    """Declarative fleet SLO thresholds; ``None`` disables a check.
+
+    * ``step_p95_ms`` — fleet p95 step wall; a breach is attributed to
+      the current straggler group (the dump lands on the guilty group).
+    * ``commit_rate`` — per-group committed/(committed+aborted) floor,
+      judged only past ``min_commit_samples`` boundaries.
+    * ``heal_ms`` — per-group last-heal duration ceiling.
+    * ``publish_lag_ms`` — per-group last publish-to-visible wall
+      ceiling.
+    * ``staleness_ms`` — per-group digest age ceiling (a group that
+      stopped reporting is itself an incident).
+    """
+
+    step_p95_ms: Optional[float] = None
+    commit_rate: Optional[float] = None
+    heal_ms: Optional[float] = None
+    publish_lag_ms: Optional[float] = None
+    staleness_ms: Optional[float] = None
+    min_commit_samples: int = 8
+
+    @classmethod
+    def from_spec(cls, spec: str) -> "SLOConfig":
+        """Parse the ``TORCHFT_SLO`` / ``--slo`` spec string (the SAME
+        grammar lighthouse.cc parses): ``key=value`` pairs joined by
+        ``;`` or ``,``. Unknown keys raise — a typo'd SLO silently
+        never firing is worse than a startup error."""
+        cfg = cls()
+        for part in spec.replace(",", ";").split(";"):
+            part = part.strip()
+            if not part:
+                continue
+            key, sep, val = part.partition("=")
+            key, val = key.strip(), val.strip()
+            if not sep or key not in SLO_KEYS:
+                raise ValueError(
+                    f"bad SLO spec entry {part!r} (known keys: "
+                    f"{', '.join(SLO_KEYS)})")
+            # Plain NON-NEGATIVE decimal only: Python's float() accepts
+            # spellings ("2_500", "nan") the C++ side's atof reads
+            # DIFFERENTLY, and a negative threshold means "disabled"
+            # to the C++ parser (< 0) but would read as a live
+            # always-breaching bound here — the strict gate must
+            # reject anything the two parsers could disagree on.
+            # Disable an SLO by omitting its key.
+            if not re.fullmatch(
+                    r"[+]?(\d+\.?\d*|\.\d+)([eE][+-]?\d+)?", val):
+                raise ValueError(
+                    f"bad SLO threshold {val!r} for {key} "
+                    "(plain non-negative decimal required; omit the "
+                    "key to disable)")
+            setattr(cfg, key, float(val))
+        return cfg
+
+    @classmethod
+    def from_env(cls) -> "SLOConfig":
+        return cls.from_spec(os.environ.get("TORCHFT_SLO", ""))
+
+    def spec(self) -> str:
+        parts = [f"{k}={getattr(self, k):g}" for k in SLO_KEYS
+                 if getattr(self, k) is not None]
+        return ";".join(parts)
+
+    def enabled(self) -> bool:
+        return any(getattr(self, k) is not None for k in SLO_KEYS)
+
+
+class SLOEngine:
+    """Evaluate an :class:`SLOConfig` against a fleet aggregate.
+
+    ``evaluate`` returns only NEW breaches — deduped per
+    ``(slo, replica_id, step)`` exactly like the flight recorder's
+    per-(reason, step) dedup, so a breach that persists across quorum
+    rounds of the same step emits one event, not one per round. The
+    live ``active`` set (every (slo, group) currently out of SLO) backs
+    the ``slo_breach`` gauge."""
+
+    def __init__(self, config: SLOConfig) -> None:
+        self.config = config
+        self.breaches_total = 0
+        self.active: List[Dict[str, Any]] = []
+        self._seen: "OrderedDict[Tuple[str, str, int], None]" = \
+            OrderedDict()
+
+    def _breach(self, slo: str, replica_id: str, step: int,
+                value: float, threshold: float) -> Dict[str, Any]:
+        return {"slo": slo, "replica_id": replica_id, "step": int(step),
+                "value": round(float(value), 3),
+                "threshold": float(threshold)}
+
+    def evaluate(self, status: Dict[str, Any],
+                 commit_counts: Optional[Dict[str, Tuple[int, int]]]
+                 = None) -> List[Dict[str, Any]]:
+        cfg = self.config
+        active: List[Dict[str, Any]] = []
+        by_id = {g["replica_id"]: g for g in status.get("groups", [])}
+        # GC dedup entries for groups that left the aggregate
+        # (farewell/staleness) — same discipline as lighthouse.cc, so
+        # churn of uuid-suffixed ids can't squeeze live groups' keys
+        # out of the bounded dedup memory.
+        for key in [k for k in self._seen if k[1] not in by_id]:
+            del self._seen[key]
+
+        if cfg.step_p95_ms is not None:
+            p95 = status["fleet"]["p95_ms"]
+            if p95 > cfg.step_p95_ms:
+                guilty = status["straggler"]["replica_id"]
+                g = by_id.get(guilty, {})
+                active.append(self._breach(
+                    "step_p95", guilty, g.get("step", 0), p95,
+                    cfg.step_p95_ms))
+        for g in by_id.values():
+            rid, step = g["replica_id"], g.get("step", 0)
+            if cfg.heal_ms is not None and \
+                    g.get("heal_last_ms", 0.0) > cfg.heal_ms:
+                active.append(self._breach(
+                    "heal", rid, step, g["heal_last_ms"], cfg.heal_ms))
+            if cfg.publish_lag_ms is not None and \
+                    g.get("publish_last_ms", 0.0) > cfg.publish_lag_ms:
+                active.append(self._breach(
+                    "publish_lag", rid, step, g["publish_last_ms"],
+                    cfg.publish_lag_ms))
+            if cfg.staleness_ms is not None and \
+                    g.get("age_ms", 0) > cfg.staleness_ms:
+                active.append(self._breach(
+                    "staleness", rid, step, g["age_ms"],
+                    cfg.staleness_ms))
+            if cfg.commit_rate is not None and commit_counts:
+                committed, aborted = commit_counts.get(rid, (0, 0))
+                total = committed + aborted
+                if total >= cfg.min_commit_samples:
+                    rate = committed / total
+                    if rate < cfg.commit_rate:
+                        active.append(self._breach(
+                            "commit_rate", rid, step, rate,
+                            cfg.commit_rate))
+
+        self.active = active
+        fresh: List[Dict[str, Any]] = []
+        for b in active:
+            key = (b["slo"], b["replica_id"], b["step"])
+            if key in self._seen:
+                continue
+            self._seen[key] = None
+            while len(self._seen) > 1024:  # bounded dedup memory
+                self._seen.popitem(last=False)
+            fresh.append(b)
+        self.breaches_total += len(fresh)
+        return fresh
+
+    def breaches_for(self, replica_id: str) -> List[str]:
+        """SLO names currently breached BY this group — what the
+        lighthouse echoes in that group's quorum response (the hint
+        that triggers the local flight dump)."""
+        return sorted({b["slo"] for b in self.active
+                       if b["replica_id"] == replica_id})
+
+
+# --------------------------------------------------------------- renderers
+
+
+def status_prometheus(status: Dict[str, Any],
+                      slo_active: int = 0,
+                      slo_breaches_total: int = 0) -> str:
+    """Render a fleet aggregate as Prometheus text exposition — the
+    ``GET /fleet/metrics`` body (lighthouse.cc emits the same names)."""
+    # The one label-escaping spelling (backslash, quote, AND newline —
+    # a raw newline splits the sample line and breaks the scrape).
+    from torchft_tpu.tracing import _escape_label
+
+    f = status["fleet"]
+    lines = [
+        "# HELP torchft_fleet_groups groups contributing digests",
+        "# TYPE torchft_fleet_groups gauge",
+        f"torchft_fleet_groups {float(f['groups'])!r}",
+        "# HELP torchft_fleet_step_ms fleet step-wall quantiles (ms)",
+        "# TYPE torchft_fleet_step_ms summary",
+        f'torchft_fleet_step_ms{{quantile="0.5"}} {float(f["p50_ms"])!r}',
+        f'torchft_fleet_step_ms{{quantile="0.95"}} '
+        f'{float(f["p95_ms"])!r}',
+        "# HELP torchft_fleet_step_ms_max slowest group step wall (ms)",
+        "# TYPE torchft_fleet_step_ms_max gauge",
+        f"torchft_fleet_step_ms_max {float(f['max_ms'])!r}",
+        "# HELP torchft_fleet_slo_breach (slo, group) pairs out of SLO",
+        "# TYPE torchft_fleet_slo_breach gauge",
+        f"torchft_fleet_slo_breach {float(slo_active)!r}",
+        "# HELP torchft_fleet_slo_breaches_total breaches detected",
+        "# TYPE torchft_fleet_slo_breaches_total counter",
+        f"torchft_fleet_slo_breaches_total "
+        f"{float(slo_breaches_total)!r}",
+        "# HELP torchft_fleet_stage_median_ms fleet per-stage medians",
+        "# TYPE torchft_fleet_stage_median_ms gauge",
+    ]
+    for stage in DIGEST_STAGES:
+        lines.append(
+            f'torchft_fleet_stage_median_ms{{stage="{stage}"}} '
+            f'{float(f["stage_median_ms"].get(stage, 0.0))!r}')
+    lines += [
+        "# HELP torchft_fleet_straggler_score robust z of step wall "
+        "vs the fleet",
+        "# TYPE torchft_fleet_straggler_score gauge",
+        "# HELP torchft_fleet_group_step_ms group step wall (ms)",
+        "# TYPE torchft_fleet_group_step_ms gauge",
+    ]
+    for g in status.get("groups", []):
+        rid = _escape_label(str(g["replica_id"]))
+        lines.append(
+            f'torchft_fleet_straggler_score{{replica_id="{rid}"}} '
+            f'{float(g["straggler_score"])!r}')
+        lines.append(
+            f'torchft_fleet_group_step_ms{{replica_id="{rid}"}} '
+            f'{float(g["step_wall_ms"])!r}')
+    return "\n".join(lines) + "\n"
+
+
+def format_fleet_table(status: Dict[str, Any],
+                       breaches: Optional[List[Dict[str, Any]]]
+                       = None) -> str:
+    """Terminal fleet table (``lighthouse.py --dashboard``): one row
+    per group, straggler-ranked, worst first."""
+    f = status["fleet"]
+    out = [
+        f"fleet: {f['groups']} group(s) "
+        f"({f['baseline_groups']} in baseline)  "
+        f"step p50={f['p50_ms']:.0f}ms p95={f['p95_ms']:.0f}ms "
+        f"max={f['max_ms']:.0f}ms",
+    ]
+    s = status.get("straggler", {})
+    if s.get("replica_id"):
+        out.append(f"straggler: {s['replica_id']} "
+                   f"(score {s['score']:+.2f}, stage "
+                   f"{s['stage'] or '-'})")
+    hdr = (f"{'group':<20} {'step':>7} {'wall ms':>9} {'score':>7} "
+           f"{'stage':<8} {'fetch':>8} {'ring':>8} {'put':>8} "
+           f"{'vote':>8} {'cap':>5} {'age':>7}")
+    out += [hdr, "-" * len(hdr)]
+    for g in status.get("groups", []):
+        st = g["stage_ms"]
+        flag = " HEAL" if g["healing"] else (
+            " DEG" if g["capacity_fraction"] < 0.999 else "")
+        out.append(
+            f"{g['replica_id']:<20.20} {g['step']:>7} "
+            f"{g['step_wall_ms']:>9.1f} {g['straggler_score']:>+7.2f} "
+            f"{(g['straggler_stage'] or '-'):<8} "
+            f"{st.get('fetch', 0.0):>8.1f} {st.get('ring', 0.0):>8.1f} "
+            f"{st.get('put', 0.0):>8.1f} {st.get('vote', 0.0):>8.1f} "
+            f"{g['capacity_fraction']:>5.2f} "
+            f"{g['age_ms'] / 1e3:>6.1f}s{flag}")
+    for b in breaches or []:
+        out.append(f"SLO BREACH: {b['slo']} on {b['replica_id']} "
+                   f"(value {b['value']}, threshold {b['threshold']}, "
+                   f"step {b['step']})")
+    return "\n".join(out)
+
+
+def fetch_fleet_status(lighthouse_addr: str,
+                       timeout: float = 10.0) -> Dict[str, Any]:
+    """GET a lighthouse's ``/fleet/status.json`` (plain HTTP — no
+    native client needed). Accepts ``host:port`` or a full URL; shared
+    by ``lighthouse.py --dashboard`` and ``tracefleet --fleet``."""
+    import json as _json
+    import urllib.request
+
+    url = (lighthouse_addr if "://" in lighthouse_addr
+           else f"http://{lighthouse_addr}")
+    url = url.rstrip("/") + "/fleet/status.json"
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return _json.loads(resp.read())
+
+
+def resolve_trace_addrs(status: Dict[str, Any]) -> List[str]:
+    """Per-group ``/trace.json`` base addresses from a fleet status —
+    ``scripts/tracefleet.py --fleet``'s resolver (no quorum-store
+    access: the digest carries each group's checkpoint-server address).
+    Dead/silent groups simply have no entry."""
+    out: List[str] = []
+    for g in status.get("groups", []):
+        addr = g.get("trace_addr") or ""
+        if addr and addr not in out:
+            out.append(addr)
+    return out

@@ -70,7 +70,33 @@ def main(argv: list[str] | None = None) -> None:
     parser.add_argument("--address-file", default="",
                         help="write the bound host:port to this file once "
                         "listening (for scripts/tests that bind port 0)")
+    parser.add_argument("--slo",
+                        default=os.environ.get("TORCHFT_SLO", ""),
+                        help="fleet SLO spec "
+                        "(docs/design/fleet_health.md), e.g. "
+                        "'step_p95_ms=2500;commit_rate=0.95;"
+                        "heal_ms=60000;publish_lag_ms=5000;"
+                        "staleness_ms=30000' (env TORCHFT_SLO); a "
+                        "breach lands a fleet event, flips the "
+                        "slo_breach gauge on /fleet/metrics, and is "
+                        "echoed to the guilty group (triggering its "
+                        "flight-recorder dump)")
+    parser.add_argument("--dashboard", action="store_true",
+                        help="render the live fleet health table "
+                        "(straggler-ranked groups, stage attribution, "
+                        "SLO breaches) to stdout while serving — the "
+                        "terminal spelling of GET /fleet/status.json")
+    parser.add_argument("--dashboard-interval", type=float, default=2.0,
+                        help="fleet table refresh seconds "
+                        "(with --dashboard)")
     args = parser.parse_args(argv)
+
+    # Validate the SLO spec STRICTLY up front (the C++ parser ignores
+    # unknown keys by design — a typo'd threshold silently never firing
+    # is the worst failure mode an SLO can have).
+    from torchft_tpu import fleet as fleet_mod
+
+    fleet_mod.SLOConfig.from_spec(args.slo)
 
     logging.basicConfig(level=logging.INFO)
     lh = Lighthouse(
@@ -86,6 +112,7 @@ def main(argv: list[str] | None = None) -> None:
         standby_of=args.standby_of,
         replicate_ms=args.replicate_ms,
         join_window_ms=args.join_window_ms,
+        slo=args.slo,
     )
     if args.address_file:
         tmp = args.address_file + ".tmp"
@@ -99,7 +126,27 @@ def main(argv: list[str] | None = None) -> None:
     stop = threading.Event()
     signal.signal(signal.SIGINT, lambda *_: stop.set())
     signal.signal(signal.SIGTERM, lambda *_: stop.set())
-    stop.wait()
+    if args.dashboard:
+        # Poll our own /fleet/status.json and render the straggler
+        # table — "which group is slowing the quorum, and why" at a
+        # glance (docs/design/fleet_health.md). Errors (no digests
+        # yet, transient scrape failures) never kill the server loop.
+        interval = max(args.dashboard_interval, 0.2)
+        while not stop.wait(interval):
+            try:
+                status = fleet_mod.fetch_fleet_status(lh.address(),
+                                                      timeout=5.0)
+                print("\033[2J\033[H"  # clear + home (ANSI)
+                      + fleet_mod.format_fleet_table(status)
+                      + f"\nslo: active="
+                        f"{status.get('slo', {}).get('active', 0)} "
+                        f"breaches_total="
+                        f"{status.get('slo', {}).get('breaches_total', 0)}",
+                      flush=True)
+            except Exception as e:  # noqa: BLE001
+                logging.debug("fleet dashboard refresh failed: %s", e)
+    else:
+        stop.wait()
     lh.shutdown()
 
 

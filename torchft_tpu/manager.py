@@ -43,11 +43,11 @@ import sys
 import threading
 import time
 import uuid
-from collections import deque
+from collections import OrderedDict, deque
 from concurrent.futures import Future, ThreadPoolExecutor
 from datetime import timedelta  # noqa: F401  (kept for API familiarity)
 from enum import Enum
-from typing import Any, Callable, Dict, Optional, TypeVar, cast
+from typing import Any, Callable, Dict, Optional, Tuple, TypeVar, cast
 
 import numpy as np
 import jax
@@ -328,6 +328,19 @@ class Manager:
             steps/s (bench ``multigroup_8mb_trace_ab``).
         trace_steps: span-ring depth in steps (env
             ``TORCHFT_TRACE_STEPS``, default 64).
+        fleet_telemetry: quorum-piggybacked fleet health telemetry
+            (:mod:`torchft_tpu.fleet`, docs/design/fleet_health.md).
+            Default on (env ``TORCHFT_FLEET_TELEMETRY=0`` disables —
+            the bench ``multigroup_8mb_fleet_ab`` A/B's knob): once per
+            commit boundary a compact digest (step wall, tracer stage
+            splits, heal/publish activity, policy rung, capacity,
+            churn) rides the quorum RPC beat; the lighthouse
+            aggregates the fleet (``GET /fleet/status.json`` /
+            ``/fleet/metrics``) and echoes per-group hints back —
+            ``fleet_p95_ms`` / ``straggler_score`` gauges feeding
+            :class:`~torchft_tpu.policy.PolicySignals`, and SLO-breach
+            hints that trigger a local flight-recorder dump on the
+            straggler group itself. Signals only; nothing auto-evicts.
     """
 
     def __init__(
@@ -366,6 +379,7 @@ class Manager:
         event_history: Optional[int] = None,
         tracing: Optional[bool] = None,
         trace_steps: Optional[int] = None,
+        fleet_telemetry: Optional[bool] = None,
         _manager_client: Optional[ManagerClient] = None,
     ) -> None:
         self._comm = comm
@@ -474,6 +488,37 @@ class Manager:
             heal_striped = os.environ.get(
                 "TORCHFT_HEAL_STRIPED", "1").strip() not in ("0", "false")
         self._heal_striped = bool(heal_striped)
+        # --- fleet health plane (docs/design/fleet_health.md) ------------
+        # When on (default; TORCHFT_FLEET_TELEMETRY=0 opts out — the
+        # bench A/B's knob), a compact per-step digest (step wall,
+        # tracer stage splits, heal/publish activity, policy rung,
+        # capacity, churn) is pushed to the C++ manager server once per
+        # commit boundary and piggybacks on the quorum RPC beat; the
+        # lighthouse aggregates the fleet and echoes a per-group hint
+        # (fleet p95, straggler score/attribution, SLO breaches) back in
+        # every quorum response. Off, set_digest is never called and the
+        # wire stays bit-exact with digest-less builds.
+        if fleet_telemetry is None:
+            fleet_telemetry = os.environ.get(
+                "TORCHFT_FLEET_TELEMETRY", "1").strip().lower() \
+                not in ("0", "false")
+        self._fleet_telemetry = bool(fleet_telemetry)
+        # Previous-boundary counter snapshot the digest's deltas (stage
+        # walls, last heal/publish duration) derive from; None before
+        # the first boundary.
+        self._digest_prev: Optional[Dict[str, float]] = None
+        # Latest fleet-hint strings (the numeric halves live in
+        # _metrics): this group's slowest-stage attribution and the
+        # fleet's current worst group.
+        self._fleet_stage = ""
+        self._fleet_straggler_id = ""
+        # (slo, step) pairs already counted/logged: the hint echoes
+        # ACTIVE breaches on every quorum round for as long as they
+        # persist, so without this dedup (the flight recorder's
+        # (reason, step) discipline, applied to the event log and the
+        # counter too) a breached p95 would mint one event per round.
+        self._slo_seen: "OrderedDict[Tuple[str, int], None]" = \
+            OrderedDict()
         # Cached StoreClient for the quorum's shared store (healset donor
         # publication/listing), keyed by host:port so a lighthouse
         # failover re-dials.
@@ -664,6 +709,18 @@ class Manager:
             "graceful_exits_total": 0.0,
             "prejoin_heals_total": 0.0,
             "joins_coalesced_total": 0.0,
+            # Fleet health plane (docs/design/fleet_health.md): the
+            # lighthouse's per-requester hint, refreshed every quorum
+            # round — fleet p95 step wall, this group's robust-z
+            # straggler score, groups contributing digests, whether
+            # this group is currently out of any SLO (gauge), and the
+            # cumulative SLO breaches echoed to this group. All zero
+            # with no digests / no native control plane.
+            "fleet_p95_ms": 0.0,
+            "straggler_score": 0.0,
+            "fleet_groups": 0.0,
+            "slo_breach": 0.0,
+            "slo_breaches_total": 0.0,
         }
         self._metrics_lock = threading.Lock()
         if self._controller is not None:
@@ -1038,6 +1095,14 @@ class Manager:
             quorum_id=q.quorum_id,
             epoch=epoch if isinstance(epoch, int) else 0)
 
+        # Fleet health hint (docs/design/fleet_health.md): the
+        # lighthouse's aggregate view of THIS group, echoed on every
+        # round. Signals only — gauges for metrics()/PolicySignals, and
+        # a flight dump when the fleet detected an SLO breach on us (the
+        # fleet anomaly lands as a local Perfetto trace naming the
+        # guilty stage).
+        self._consume_fleet_hint(q)
+
         # Coordination facts for the adaptive-policy commit hook: the
         # quorum store the decision key rides on, and whether anyone in
         # the quorum is healing this round (max_world < replica_world ⇒
@@ -1281,6 +1346,64 @@ class Manager:
             # (reference manager.py:391-396).
             self.load_state_dict(state["torchft"])
             self._pending_state_dict = state
+
+    def _consume_fleet_hint(self, q: Any) -> None:
+        """Digest the lighthouse's fleet health hint from one quorum
+        response (docs/design/fleet_health.md).
+
+        Gauges (``fleet_p95_ms`` / ``straggler_score`` /
+        ``fleet_groups`` / ``slo_breach``) refresh every round and feed
+        the next boundary's :class:`~torchft_tpu.policy.PolicySignals`;
+        a non-empty ``slo_breach`` (the fleet says THIS group is out of
+        SLO) logs a fleet event and triggers one flight-recorder dump
+        per breached SLO, deduped per (slo, step) by the recorder's
+        (reason, step) discipline — so the fleet-detected anomaly lands
+        as a local Perfetto trace on the guilty group only.
+
+        isinstance guards everywhere: duck-typed/MagicMock clients (and
+        pre-fleet ones) must read as hint-less, never crash or poison
+        the numeric metrics dict."""
+        def _num(name: str) -> float:
+            v = getattr(q, name, 0.0)
+            return (float(v) if isinstance(v, (int, float))
+                    and not isinstance(v, bool) else 0.0)
+
+        def _s(name: str) -> str:
+            v = getattr(q, name, "")
+            return v if isinstance(v, str) else ""
+
+        groups = _num("fleet_groups")
+        breach = _s("slo_breach")
+        score = _num("straggler_score")
+        breaches = [s.strip() for s in breach.split(",") if s.strip()]
+        with self._metrics_lock:
+            self._metrics["fleet_groups"] = groups
+            self._metrics["fleet_p95_ms"] = _num("fleet_p95_ms")
+            self._metrics["straggler_score"] = score
+            self._metrics["slo_breach"] = 1.0 if breaches else 0.0
+            # The hint repeats ACTIVE breaches every round; only count
+            # each (slo, step) once (the flight recorder's
+            # (reason, step) dedup, applied to counter + event too).
+            fresh = [s for s in breaches
+                     if (s, self._step) not in self._slo_seen]
+            for s in fresh:
+                self._slo_seen[(s, self._step)] = None
+            while len(self._slo_seen) > 1024:  # bounded dedup memory
+                self._slo_seen.popitem(last=False)
+            self._metrics["slo_breaches_total"] += len(fresh)
+            self._fleet_stage = _s("straggler_stage")
+            self._fleet_straggler_id = _s("straggler_id")
+        if not fresh:
+            return
+        self._log_event(event="slo_breach", step=self._step,
+                        slos=",".join(fresh),
+                        straggler_score=round(score, 3),
+                        stage=self._fleet_stage)
+        for slo in fresh:
+            self._flight_dump(f"slo_breach_{slo}", slo=slo,
+                              straggler_score=round(score, 4),
+                              stage=self._fleet_stage,
+                              fleet_p95_ms=_num("fleet_p95_ms"))
 
     def _resolve_checkpoint_addr(self, manager_addr: str) -> str:
         """Resolve a peer manager's checkpoint-server URL for this
@@ -3163,6 +3286,8 @@ class Manager:
             rc = self._metrics["reconfigure_count"]
             ar = self._metrics["allreduce_ms_total"]
             churn_per_min = self._churn_per_min_locked(now)
+            fleet_p95 = self._metrics["fleet_p95_ms"]
+            straggler = self._metrics["straggler_score"]
         prev = self._policy_prev_counters
         reconfigured = prev is not None and rc > prev["rc"]
         comm_frac = 0.0
@@ -3173,7 +3298,8 @@ class Manager:
         self._policy_prev_counters = {"rc": rc, "ar": ar, "t": now}
         proposal = self._controller.note_boundary(
             decision, reconfigured=reconfigured, comm_frac=comm_frac,
-            churn_rate=churn_per_min)
+            churn_rate=churn_per_min,
+            fleet_p95_ms=fleet_p95, straggler_score=straggler)
         with self._metrics_lock:  # gauge
             self._metrics["failure_rate"] = \
                 self._controller.last_signals.failure_rate
@@ -3339,8 +3465,74 @@ class Manager:
                 int(mx["committed_steps"]),
                 int(mx["aborted_steps"]),
             )
+            self._push_digest(mx)
         except Exception:  # noqa: BLE001
             logger.debug("status publish failed", exc_info=True)
+
+    def _push_digest(self, mx: Dict[str, float]) -> None:
+        """Refresh the per-step telemetry digest on the C++ manager
+        server (docs/design/fleet_health.md); it piggybacks on the next
+        quorum RPC beat — fleet health costs zero extra RPCs.
+
+        Called once per commit boundary from ``_publish_status`` with
+        that boundary's metrics snapshot. Step wall is the monotonic
+        time between boundaries; stage splits come from the tracer's
+        per-step span totals (zeros when tracing is off — the wall
+        still reports); heal/publish durations are this boundary's
+        counter deltas. Skipped entirely when ``fleet_telemetry`` is
+        off or the control plane is duck-typed (no ``set_digest``)."""
+        if not self._fleet_telemetry or self._manager_server is None:
+            return
+        set_digest = getattr(self._manager_server, "set_digest", None)
+        if set_digest is None:  # duck-typed/mocked control plane
+            return
+        now = time.monotonic()
+        prev = self._digest_prev
+        snap = {
+            "t": now,
+            "heal_ms_total": mx.get("heal_ms_total", 0.0),
+            "heal_count": mx.get("heal_count", 0.0),
+            "publish_ms_total": mx.get("publish_ms_total", 0.0),
+            "publish_count": mx.get("publish_count", 0.0),
+        }
+        self._digest_prev = snap
+        if prev is None:
+            return  # the first boundary has no wall to report yet
+
+        def delta(key: str, count_key: str) -> float:
+            # The duration of this boundary's heal/publish, 0 when none
+            # happened (the count gate keeps a clock-skewed ms delta
+            # from minting a phantom event).
+            if snap[count_key] <= prev[count_key]:
+                return 0.0
+            return max(snap[key] - prev[key], 0.0)
+
+        stages = self._tracer.stage_totals(self._step)
+        try:
+            set_digest(
+                step=self._step,
+                step_wall_ms=max(now - prev["t"], 0.0) * 1e3,
+                fetch_ms=stages.get("fetch_dispatch", 0.0)
+                + stages.get("fetch_wait", 0.0),
+                ring_ms=stages.get("ring", 0.0),
+                put_ms=stages.get("put", 0.0),
+                vote_ms=stages.get("vote", 0.0),
+                heal_bytes_inflight=mx.get(
+                    "heal_last_bytes_committed", 0.0),
+                publish_bytes_inflight=mx.get(
+                    "publish_payload_bytes_last", 0.0),
+                policy_rung=int(mx.get("policy_current", -1.0)),
+                capacity_fraction=self._capacity_fraction,
+                churn_per_min=mx.get("reconfigures_per_min", 0.0),
+                healing=bool(self._healing
+                             or not self.is_participating()),
+                heal_last_ms=delta("heal_ms_total", "heal_count"),
+                publish_last_ms=delta("publish_ms_total",
+                                      "publish_count"),
+                trace_addr=self._ckpt_server.address(),
+            )
+        except Exception:  # noqa: BLE001 — observability never fails
+            logger.debug("digest push failed", exc_info=True)
 
     def metrics(self) -> Dict[str, float]:
         """Snapshot of counters + cumulative timings (ms): quorum rounds,
@@ -3443,15 +3635,20 @@ class Manager:
         FT policy and why it was last switched), ``ckpt_last_error``
         (the attached durable writer's sticky last failure, ``""`` when
         clean), ``flight_last_path`` (newest flight-recorder dump,
-        ``""`` before the first), and ``ring_topology`` (the
+        ``""`` before the first), ``ring_topology`` (the
         communicator's wire-op transport — ``"flat"`` or
         ``"hier:<hosts>x<per_host>"``,
-        docs/design/hier_transport.md)."""
+        docs/design/hier_transport.md), and ``straggler_stage`` (the
+        fleet hint's slowest-stage attribution for THIS group, ``""``
+        when unremarkable / no fleet telemetry,
+        docs/design/fleet_health.md)."""
         last_err = ""
         if self._ckpt_writer is not None:
             last_err = self._ckpt_writer.last_error() or ""
         topo_fn = getattr(self._comm, "ring_topology", None)
         topo = topo_fn() if callable(topo_fn) else "flat"
+        with self._metrics_lock:
+            fleet_stage = self._fleet_stage
         return {
             "policy_name": self._policy.name,
             "policy_last_reason": self._policy_last_reason,
@@ -3461,6 +3658,7 @@ class Manager:
             # isinstance guard: duck-typed/MagicMock comms must not
             # leak a non-string into the strings-only dict.
             "ring_topology": topo if isinstance(topo, str) else "flat",
+            "straggler_stage": fleet_stage,
         }
 
     # ------------------------------------------------- durable checkpoints

@@ -221,6 +221,14 @@ class PolicySignals:
     # — under spot churn this is the failure REGIME signal (groups are
     # coming and going) even when every individual boundary commits.
     churn_rate: float = 0.0
+    # Fleet health hints (docs/design/fleet_health.md), echoed by the
+    # lighthouse on every quorum round: the FLEET's p95 step wall and
+    # THIS group's robust-z straggler score. A controller previously saw
+    # only its own group's failure rate/churn; these give it the fleet's
+    # regime (Chameleon, arxiv 2508.21613: real-time policy selection is
+    # only as good as its signals). Both 0.0 without fleet telemetry.
+    fleet_p95_ms: float = 0.0
+    straggler_score: float = 0.0
 
     def as_dict(self) -> Dict[str, float]:
         return {
@@ -230,6 +238,8 @@ class PolicySignals:
             "comm_frac": round(self.comm_frac, 4),
             "quiet_boundaries": float(self.quiet_boundaries),
             "churn_rate": round(self.churn_rate, 4),
+            "fleet_p95_ms": round(self.fleet_p95_ms, 3),
+            "straggler_score": round(self.straggler_score, 4),
         }
 
 
@@ -311,7 +321,9 @@ class PolicyController:
     # ---------------------------------------------------------- decision
 
     def note_boundary(self, committed: bool, reconfigured: bool = False,
-                      comm_frac: float = 0.0, churn_rate: float = 0.0
+                      comm_frac: float = 0.0, churn_rate: float = 0.0,
+                      fleet_p95_ms: float = 0.0,
+                      straggler_score: float = 0.0
                       ) -> Optional[Tuple[int, str, PolicySignals]]:
         """Record one commit boundary; return ``(target_rung, reason,
         signals)`` when the ladder should move, else ``None``. The
@@ -331,7 +343,9 @@ class PolicyController:
             failures_in_window=fails, window=len(self._recent),
             failure_rate=fails / max(len(self._recent), 1),
             comm_frac=self._comm_ema, quiet_boundaries=self._quiet,
-            churn_rate=max(churn_rate, 0.0))
+            churn_rate=max(churn_rate, 0.0),
+            fleet_p95_ms=max(fleet_p95_ms, 0.0),
+            straggler_score=float(straggler_score))
         self.last_signals = sig
         if self._since_switch < self.cooldown:
             return None

@@ -266,6 +266,23 @@ class Tracer:
         with self._lock:
             return [s.as_dict() for s in list(self._open.values())]
 
+    def stage_totals(self, step: Optional[Any] = None
+                     ) -> Dict[str, float]:
+        """Summed span wall (ms) per stage for one step of the ring
+        (default: the newest step) — the fleet telemetry digest's
+        stage-split source (docs/design/fleet_health.md). Empty when
+        the step has no spans (tracing off, or nothing recorded)."""
+        with self._lock:
+            if step is None:
+                if not self._ring:
+                    return {}
+                step = next(reversed(self._ring))
+            out: Dict[str, float] = {}
+            for rec in self._ring.get(step, ()):
+                out[rec["stage"]] = (out.get(rec["stage"], 0.0)
+                                     + max(rec["dur_ns"], 0) / 1e6)
+            return out
+
     def chrome_trace(self, steps: Optional[int] = None) -> Dict[str, Any]:
         """The ring as a Chrome trace-event JSON object
         (Perfetto-loadable): completed spans are ``ph: "X"`` complete
@@ -379,6 +396,20 @@ def _metric_name(key: str) -> str:
     return "torchft_" + _NAME_OK.sub("_", key)
 
 
+# Metric families rendered as proper Prometheus SUMMARIES instead of
+# bare per-quantile gauges: {summary name: (quantile -> source key,
+# _sum source key, _count source key)}. The quantile source keys are
+# consumed (they do not ALSO render as torchft_<key> gauges); the
+# sum/count sources still render under their own documented names —
+# they are read by bench/dashboards directly. The exact max stays its
+# own gauge (summaries have no max slot). Frozen by
+# tests/test_metrics_schema.py.
+SUMMARY_SPECS: Dict[str, tuple] = {
+    "quorum_ms": ({"0.5": "quorum_ms_p50", "0.95": "quorum_ms_p95"},
+                  "quorum_ms_total", "quorum_count"),
+}
+
+
 def prometheus_text(numeric: Dict[str, Any],
                     info: Optional[Dict[str, str]] = None,
                     labels: Optional[Dict[str, str]] = None) -> str:
@@ -386,15 +417,43 @@ def prometheus_text(numeric: Dict[str, Any],
     Prometheus text exposition: every key becomes
     ``torchft_<key>{<labels>}``, typed ``counter`` when the name ends
     in ``_total``/``_count`` (the repo's counter spelling) and
-    ``gauge`` otherwise. String diagnostics (``Manager.metrics_info()``)
-    render as ONE ``torchft_info`` info-style metric whose value is 1
-    and whose labels carry the strings — the Prometheus idiom for
-    non-numeric facts, and the reason the numeric dict must stay
-    numeric at the source."""
+    ``gauge`` otherwise, with ``# HELP``/``# TYPE`` lines on every
+    family. Latency-reservoir quantile triples listed in
+    ``SUMMARY_SPECS`` render as ONE Prometheus ``summary`` family
+    (``torchft_quorum_ms{quantile="0.5"} ... torchft_quorum_ms_sum /
+    _count``) instead of bare gauges, so PromQL's
+    ``histogram/summary`` tooling works on them. String diagnostics
+    (``Manager.metrics_info()``) render as ONE ``torchft_info``
+    info-style metric whose value is 1 and whose labels carry the
+    strings — the Prometheus idiom for non-numeric facts, and the
+    reason the numeric dict must stay numeric at the source."""
     base = "".join(f'{k}="{_escape_label(v)}",'
                    for k, v in sorted((labels or {}).items()))
     lines: List[str] = []
+    consumed: set = set()
+    for sname, (quantiles, sum_key, count_key) in \
+            sorted(SUMMARY_SPECS.items()):
+        if not all(k in numeric for k in quantiles.values()):
+            continue
+        name = _metric_name(sname)
+        lines.append(f"# HELP {name} torchft_tpu {sname} summary")
+        lines.append(f"# TYPE {name} summary")
+        for q in sorted(quantiles, key=float):
+            key = quantiles[q]
+            consumed.add(key)
+            pairs = base + f'quantile="{q}",'
+            lines.append(
+                f"{name}{{{pairs[:-1]}}} {float(numeric[key])!r}")
+        label_s = f"{{{base[:-1]}}}" if base else ""
+        if sum_key in numeric:
+            lines.append(
+                f"{name}_sum{label_s} {float(numeric[sum_key])!r}")
+        if count_key in numeric:
+            lines.append(
+                f"{name}_count{label_s} {float(numeric[count_key])!r}")
     for key in sorted(numeric):
+        if key in consumed:
+            continue  # rendered as a summary quantile above
         val = numeric[key]
         if isinstance(val, bool) or not isinstance(val, (int, float)):
             continue  # defensively skip anything non-numeric
