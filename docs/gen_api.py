@@ -33,6 +33,7 @@ MODULES = [
     ("torchft_tpu.backends.mesh", "On-device full-membership backend"),
     ("torchft_tpu.checkpointing", "Live peer-to-peer healing transfer"),
     ("torchft_tpu.checkpoint_io", "Durable checkpoint save/load"),
+    ("torchft_tpu.ram_ckpt", "RAM checkpoint tier + async demotion"),
     ("torchft_tpu.serving", "Live weight publication + relay fan-out"),
     ("torchft_tpu.tracing", "Per-step tracing + flight recorder"),
     ("torchft_tpu.fleet", "Fleet health plane (straggler/SLO mirror)"),

@@ -30,6 +30,13 @@ within-threshold drift are reported, never fatal. A metric present
 only on one side is reported as added/removed, never fatal (benches
 grow with the repo).
 
+Rows are compared only when their provenance stamps agree: a metric
+pair whose ``schema`` tags differ (rows predating the stamp are
+schema v1), whose ``platform``/``device_kind`` changed (a TPU round
+followed by a CPU-only rig is a rig change, not a regression), or
+where either side is an error stub (a bench that could not run) is
+reported as ``skipped`` and never gated.
+
 Directory mode diffs every adjacent pair of the sorted trajectory but
 gates (exit code) only the NEWEST pair by default — an old, already
 shipped regression should not permanently fail the gate; pass
@@ -127,17 +134,45 @@ def parse_bench_file(path: str) -> Dict[str, Dict[str, Any]]:
     return {str(r["metric"]): r for r in rows if "metric" in r}
 
 
+def _incomparable(o_row: Dict[str, Any],
+                  n_row: Dict[str, Any]) -> Optional[str]:
+    """Why this metric pair must NOT be gated, or None if comparable.
+
+    Rows carry provenance stamps (bench.py ``_provenance()``) exactly
+    so a rig change reads as a rig change: a TPU round followed by a
+    CPU-only round would otherwise gate as a catastrophic "regression"
+    and permanently fail the trajectory. Error-stub rows (a bench that
+    could not run, e.g. no native toolchain) are placeholders, not
+    measurements."""
+    if "error" in o_row or "error" in n_row:
+        return "error row"
+    o_schema = o_row.get("schema", "tft-bench-1")
+    n_schema = n_row.get("schema", "tft-bench-1")
+    if o_schema != n_schema:
+        return f"schema changed: {o_schema} -> {n_schema}"
+    for k in ("platform", "device_kind"):
+        ov, nv = o_row.get(k), n_row.get(k)
+        if ov is not None and nv is not None and ov != nv:
+            return f"rig changed: {k} {ov} -> {nv}"
+    return None
+
+
 def diff_rows(old: Dict[str, Dict[str, Any]],
               new: Dict[str, Dict[str, Any]],
               threshold: float) -> Dict[str, List[Dict[str, Any]]]:
     """Compare two parsed bench files; returns {regressions,
-    improvements, changes, added, removed} entry lists."""
+    improvements, changes, skipped, added, removed} entry lists."""
     out: Dict[str, List[Dict[str, Any]]] = {
         "regressions": [], "improvements": [], "changes": [],
+        "skipped": [],
         "added": sorted(set(new) - set(old)),
         "removed": sorted(set(old) - set(new)),
     }
     for metric in sorted(set(old) & set(new)):
+        why = _incomparable(old[metric], new[metric])
+        if why is not None:
+            out["skipped"].append({"metric": metric, "reason": why})
+            continue
         o_f, n_f = _flatten(old[metric]), _flatten(new[metric])
         unit = str(new[metric].get("unit", old[metric].get("unit", "")))
         for key in sorted(set(o_f) & set(n_f)):
@@ -174,6 +209,8 @@ def report(label: str, diff: Dict[str, List[Any]],
     if verbose:
         for e in diff["changes"]:
             print(f"  changed     {_fmt(e)}")
+    for e in diff.get("skipped", []):
+        print(f"  skipped     {e['metric']} ({e['reason']})")
     for m in diff["added"]:
         print(f"  added       {m}")
     for m in diff["removed"]:

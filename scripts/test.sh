@@ -194,6 +194,30 @@ if [[ "${1:-}" == "churn" ]]; then
     exit 0
 fi
 
+# RAM checkpoint tier: the memory-tier arc's focused gate
+# (docs/design/memory_tier.md) — the in-memory v2 image codec (bitwise
+# vs the disk spelling, crc verify/reject), staged ranged peer pushes
+# over the heal transport, the RamReplicator demotion pipeline
+# (encode -> RAM -> K peers -> disk -> durable) with its stall
+# watchdog + fatal classification + sticky error latch, the chaos RAM
+# band (peer-RAM loss / replication blackhole / correlated K-peer
+# death latches), Manager coupling (commit-coupled dispatch + refusal
+# classes, healset peer discovery with tombstone filtering,
+# RAM-preferring prejoin/cold-start rungs, replication-set collapse
+# one-shot + flight dump), and the recovery-ladder bench gate
+# (bench_recovery_tiers ram_speedup >= 2x at tiny scale). Tier-1 and
+# native-free (FakeStore peers over local HTTP; not marked slow); run
+# this tier on ram_ckpt/checkpoint_io/checkpointing/manager/chaos
+# changes. The RAM-on/off churn-goodput soak is native-gated and rides
+# the nightly tier (tests/test_churn.py::TestChurnSoak).
+if [[ "${1:-}" == "ramckpt" ]]; then
+    stage ramckpt env JAX_PLATFORMS=cpu \
+        python -m pytest tests/test_ram_ckpt.py -q \
+        -m "ramckpt and not slow"
+    echo "== total: ${SECONDS}s"
+    exit 0
+fi
+
 # Fleet tier: the fleet health plane's focused gate
 # (docs/design/fleet_health.md) — the straggler-score/attribution
 # battery against the pure-Python aggregator mirror (known-skew fleets,

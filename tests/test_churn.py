@@ -1272,3 +1272,31 @@ class TestChurnSoak:
             seed=1234)
         assert sigkill["kills"] >= 1
         assert sigkill["bitwise_identical"]
+
+    def test_ram_tier_churn_goodput_ab(self):
+        """RAM-tier A/B under sustained churn (docs/design/memory_tier.md,
+        ISSUE-16 acceptance): the 20%/min leg must hold goodput with the
+        RAM tier armed — cross-replication at every commit boundary and
+        RAM-preferring cold starts must not cost throughput vs the
+        disk-only control, and the bitwise oracle must stay exact."""
+        import bench
+
+        off = bench.bench_churn_goodput(
+            churn_pct_per_min=20.0, leg="sigkill", duration_s=30.0,
+            seed=4321, replace_delay_s=1.0, ram_tier=False)
+        assert off["bitwise_identical"]
+        assert off["committed_batches_per_s"] > 0
+
+        on = bench.bench_churn_goodput(
+            churn_pct_per_min=20.0, leg="sigkill", duration_s=30.0,
+            seed=4321, replace_delay_s=1.0, ram_tier=True)
+        assert on["ram_tier"]
+        assert on["bitwise_identical"]
+        # Replication rides the commit boundary on every group, so it
+        # must be happening even when churn never fires a kill.
+        assert on["ram_replications"] >= 1
+        # Goodput gate: RAM-on holds >= 0.9x the disk-only control
+        # (replication is async off the step path; the tier may only
+        # ever make replacement FASTER, never training slower).
+        assert on["committed_batches_per_s"] >= (
+            0.9 * off["committed_batches_per_s"])

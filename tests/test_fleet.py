@@ -859,6 +859,37 @@ class TestBenchdiff:
         assert not d["regressions"]
         assert len(d["improvements"]) == 2
 
+    def test_provenance_mismatch_skips_not_gates(self):
+        """A rig/schema change or an error stub must read as skipped,
+        never as a regression: a TPU round followed by a CPU-only rig
+        would otherwise permanently fail the trajectory gate."""
+        bd = self._bd()
+        tpu = {"m": {"metric": "m", "steps_per_s": 100.0,
+                     "schema": "tft-bench-2", "platform": "tpu"}}
+        cpu = {"m": {"metric": "m", "steps_per_s": 1.0,
+                     "schema": "tft-bench-2", "platform": "cpu"}}
+        d = bd.diff_rows(tpu, cpu, threshold=0.10)
+        assert not d["regressions"]
+        assert d["skipped"] and "rig changed" in d["skipped"][0]["reason"]
+        # rows predating the provenance stamp are schema v1
+        v1 = {"m": {"metric": "m", "steps_per_s": 100.0}}
+        d = bd.diff_rows(v1, cpu, threshold=0.10)
+        assert not d["regressions"]
+        assert "schema changed" in d["skipped"][0]["reason"]
+        # an error stub is a placeholder, not a measurement
+        err = {"m": {"metric": "m", "steps_per_s": -1.0,
+                     "schema": "tft-bench-2", "platform": "cpu",
+                     "error": "native control plane unavailable"}}
+        d = bd.diff_rows(cpu, err, threshold=0.10)
+        assert not d["regressions"]
+        assert d["skipped"][0]["reason"] == "error row"
+        # same rig, same schema, no error: still gates normally
+        slow = {"m": {"metric": "m", "steps_per_s": 10.0,
+                      "schema": "tft-bench-2", "platform": "cpu"}}
+        d = bd.diff_rows(cpu, slow, threshold=0.10)
+        assert not d["skipped"]
+        assert len(d["improvements"]) == 1
+
     def test_trajectory_gates_newest_pair_only(self, tmp_path):
         bd = self._bd()
 

@@ -92,6 +92,27 @@ DOCUMENTED_KEYS = frozenset([
     # lighthouse's per-requester hint, refreshed every quorum round
     "fleet_p95_ms", "straggler_score", "fleet_groups",
     "slo_breach", "slo_breaches_total",
+    # RAM checkpoint tier (docs/design/memory_tier.md) — the Manager
+    # half only; the store/replicator counters merge in when the tier
+    # is armed (see test_ram_tier_merges_keys)
+    "ram_ckpt_heals_total", "ram_replicate_skipped",
+    "ram_replicate_errors_total", "ram_replica_collapses_total",
+])
+
+# Merged into metrics() only while the RAM tier is armed
+# (Manager.enable_ram_tier) — same conditional-merge contract as the
+# serving keys in test_attached_publisher_merges_serving_keys.
+RAM_TIER_KEYS = frozenset([
+    # RamCheckpointStore (peer-push acceptance side)
+    "ram_ckpt_images", "ram_ckpt_stored_bytes",
+    "ram_ckpt_accepts_total", "ram_ckpt_rejects_total",
+    "ram_ckpt_evictions_total", "ram_ckpt_losses_total",
+    # RamReplicator (push + demotion side)
+    "ram_ckpt_replications_total", "ram_ckpt_bytes_replicated_total",
+    "ram_ckpt_push_failures_total", "ram_ckpt_peers",
+    "ram_demote_errors", "ram_demote_fatal", "ram_demote_stalls",
+    "demote_stage_ms_total", "demote_encode_ms", "demote_ram_ms",
+    "demote_replicate_ms", "demote_disk_ms", "demote_durable_ms",
 ])
 
 # Latency-reservoir quantile keys rendered as ONE Prometheus summary
@@ -195,6 +216,29 @@ class TestMetricsSchema:
                 assert key in mx, key
             assert mx["publish_count"] == 1
             assert mx["publish_last_generation"] == 1
+        finally:
+            m.shutdown()
+
+    def test_ram_tier_merges_keys(self):
+        """Arming the RAM checkpoint tier must surface the store and
+        replicator counters in the same metrics() snapshot — and they
+        must be absent while the tier is off (the Manager half of the
+        schema stays unconditional either way)."""
+        m = make_manager()
+        try:
+            off = set(m.metrics())
+            leaked = RAM_TIER_KEYS & off
+            assert not leaked, (
+                f"RAM-tier key(s) {sorted(leaked)} present with the "
+                "tier disarmed — these are documented as merge-on-arm")
+            m.enable_ram_tier(peers=1)
+            mx = m.metrics()
+            missing = RAM_TIER_KEYS - set(mx)
+            assert not missing, sorted(missing)
+            for key in RAM_TIER_KEYS:
+                val = mx[key]
+                assert isinstance(val, (int, float)) and \
+                    not isinstance(val, bool), key
         finally:
             m.shutdown()
 

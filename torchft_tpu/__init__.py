@@ -46,6 +46,8 @@ from torchft_tpu.optim import (DelayedOptimizer, FTOptimizer,
 from torchft_tpu.policy import (LADDER, POLICIES, AdaptiveTrainer,
                                 FTPolicy, PhasedChaos, PolicyController,
                                 PolicySignals)
+from torchft_tpu.ram_ckpt import (RamCheckpointStore, RamReplicator,
+                                  encode_image)
 from torchft_tpu.communicator import Int8Wire
 from torchft_tpu.serving import (PublicationServer, StaleWeightsError,
                                  WeightPublisher, WeightRelay,
@@ -105,6 +107,9 @@ __all__ = [
     "PreemptedExit",
     "PublicationServer",
     "QuorumResult",
+    "RamCheckpointStore",
+    "RamReplicator",
+    "encode_image",
     "StaleWeightsError",
     "Store",
     "StoreClient",

@@ -86,6 +86,7 @@ __all__ = [
     "end",
     "disk_fault",
     "device_fault",
+    "ram_fault",
 ]
 
 
@@ -139,6 +140,21 @@ class EndpointChaos:
     # traces are unchanged while these rates are 0).
     chip_loss_rate: float = 0.0
     chip_return_rate: float = 0.0
+    # RAM checkpoint-tier faults (the ``ram`` channel, honored by
+    # :func:`ram_fault` — the memory-tier battery's injection point,
+    # docs/design/memory_tier.md):
+    #   ram_loss      — a stored peer-RAM image silently vanishes (host
+    #                   OOM-kill of the cache, reclaimed RAM); the store
+    #                   drops the image and the healer falls down a rung;
+    #   ram_blackhole — a replication push/serve stalls ``blackhole_ms``
+    #                   then times out (NIC partition on the replication
+    #                   path only — the disk rungs are unaffected).
+    # Correlated K-peer death reuses the kill latches
+    # (:meth:`ChaosSchedule.kill_endpoint` on ``ram:<name>``). Appended
+    # after the device bands (same determinism contract: existing
+    # channels' traces are unchanged while these rates are 0).
+    ram_loss_rate: float = 0.0
+    ram_blackhole_rate: float = 0.0
     max_faults: int = -1         # cap on hard faults per channel (-1 = inf)
 
 
@@ -280,7 +296,9 @@ class ChaosSchedule:
                                (cfg.flip_rate, "flip"),
                                (cfg.enospc_rate, "enospc"),
                                (cfg.chip_loss_rate, "chip_loss"),
-                               (cfg.chip_return_rate, "chip_return")):
+                               (cfg.chip_return_rate, "chip_return"),
+                               (cfg.ram_loss_rate, "ram_loss"),
+                               (cfg.ram_blackhole_rate, "ram_blackhole")):
                 acc += rate * scale
                 if u < acc:
                     fault = kind
@@ -656,6 +674,62 @@ def device_fault(endpoint: str, n_devices: int,
             sched.return_chip(endpoint,
                               lost[int(d.frac * len(lost)) % len(lost)])
     return sched.lost_chips(endpoint)
+
+
+# ------------------------------------------------------------ RAM faults
+
+
+def ram_fault(endpoint: str, op: str = "serve",
+              schedule: Optional[ChaosSchedule] = None
+              ) -> Optional[Decision]:
+    """Per-operation hook of the RAM checkpoint tier (channel ``ram``;
+    :mod:`torchft_tpu.ram_ckpt` calls it with endpoint ``ram:<name>`` on
+    every replication push, peer-image serve, and staged-PUT accept —
+    docs/design/memory_tier.md).
+
+    A dead latch (``kill_endpoint`` on the same name — the correlated
+    K-peer death band) refuses the op outright with
+    ``ConnectionRefusedError``, no RNG draw, like :func:`begin`.
+    Otherwise one decision is drawn: ``ram_blackhole``/``blackhole``
+    stall ``blackhole_ms`` then raise ``OSError(ETIMEDOUT)`` (transient
+    class — the replication stall watchdog's territory);
+    ``reset``/``short``/``kill`` raise ``ConnectionResetError`` (and
+    ``kill`` latches the endpoint dead, so the whole peer stays dark);
+    ``ram_loss`` is RETURNED for the store to act on — it needs the
+    stored image to drop (silent peer-RAM loss only the next heal
+    attempt can observe)."""
+    import errno
+
+    sched = schedule if schedule is not None else active()
+    if sched is None:
+        return None
+    if sched.is_dead(endpoint):
+        raise ConnectionRefusedError(
+            f"[chaos] {endpoint}/{op}: connection refused (peer RAM "
+            "host dead)")
+    if sched.config_for(endpoint) is None:
+        return None  # no decision draw (stream purity)
+    d = sched.decide(endpoint, op)
+    if d is None:
+        return None
+    if d.delay_ms > 0:
+        time.sleep(d.delay_ms / 1e3)
+    if d.fault in ("ram_blackhole", "blackhole"):
+        time.sleep(d.blackhole_ms / 1e3)
+        raise OSError(
+            errno.ETIMEDOUT,
+            f"[chaos] {endpoint}/{op}#{d.n}: RAM replication stalled, "
+            "timed out")
+    if d.fault == "kill":
+        sched.kill_endpoint(endpoint)
+        raise ConnectionResetError(
+            f"[chaos] {endpoint}/{op}#{d.n}: connection reset by peer "
+            "(peer RAM host died)")
+    if d.fault in ("reset", "short"):
+        raise ConnectionResetError(
+            f"[chaos] {endpoint}/{op}#{d.n}: connection reset by peer "
+            "(replication stream lost)")
+    return d
 
 
 # ------------------------------------------------------------- sockets

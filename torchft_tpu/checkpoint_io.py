@@ -45,6 +45,7 @@ Usage::
 from __future__ import annotations
 
 import errno
+import io
 import json
 import logging
 import os
@@ -237,30 +238,7 @@ def _write_v2(path: str, tree: Any, manager_state: Optional[dict],
 
     def body(f) -> None:
         nonlocal written
-
-        def w(buf) -> None:
-            nonlocal written
-            f.write(buf)
-            written += len(buf)
-            if _progress is not None:
-                _progress(written)
-
-        w(_CKPT_MAGIC)
-        w(len(head_bytes).to_bytes(4, "little"))
-        w(head_bytes)
-        w(plan.preamble)
-        digests = []
-        for _, mv in _iter_leaf_views(plan.array_leaves,
-                                      DEFAULT_BATCH_BYTES):
-            digests.append(zlib.crc32(mv))
-            w(mv)
-        mf = manifest_from(plan, digests)
-        mf["head_crc32"] = zlib.crc32(head_bytes)
-        mf["preamble_crc32"] = zlib.crc32(plan.preamble)
-        mf_bytes = json.dumps(mf).encode()
-        w(mf_bytes)
-        w(len(mf_bytes).to_bytes(4, "little"))
-        w(_END_MAGIC)
+        written = _write_v2_stream(f, plan, head_bytes, _progress)
 
     _atomic_publish(path, body)
 
@@ -268,6 +246,45 @@ def _write_v2(path: str, tree: Any, manager_state: Optional[dict],
         # Post-rename silent bit-flip: the save "succeeded", the bytes
         # rotted afterwards. Only digest verification can catch it.
         _flip_byte(path, fault.frac)
+    return written
+
+
+def _write_v2_stream(f, plan: Any, head_bytes: bytes,
+                     _progress: Optional[Callable[[int], None]] = None
+                     ) -> int:
+    """Stream the v2 byte format — magic, head, TFTPTREE payload, and
+    the trailing single-pass digest manifest — to ANY open binary
+    stream. Shared by the on-disk writer (:func:`_write_v2`, under
+    :func:`_atomic_publish`) and the RAM-tier image encoder
+    (:mod:`torchft_tpu.ram_ckpt`, into a ``BytesIO``): one spelling of
+    the format means a RAM image and a durable file are byte-identical,
+    so demotion is a plain byte copy and the heal path's crc oracle
+    applies to both. Returns the total bytes written."""
+    written = 0
+
+    def w(buf) -> None:
+        nonlocal written
+        f.write(buf)
+        written += len(buf)
+        if _progress is not None:
+            _progress(written)
+
+    w(_CKPT_MAGIC)
+    w(len(head_bytes).to_bytes(4, "little"))
+    w(head_bytes)
+    w(plan.preamble)
+    digests = []
+    for _, mv in _iter_leaf_views(plan.array_leaves,
+                                  DEFAULT_BATCH_BYTES):
+        digests.append(zlib.crc32(mv))
+        w(mv)
+    mf = manifest_from(plan, digests)
+    mf["head_crc32"] = zlib.crc32(head_bytes)
+    mf["preamble_crc32"] = zlib.crc32(plan.preamble)
+    mf_bytes = json.dumps(mf).encode()
+    w(mf_bytes)
+    w(len(mf_bytes).to_bytes(4, "little"))
+    w(_END_MAGIC)
     return written
 
 
@@ -570,6 +587,20 @@ def _read_trailer(f, file_size: int, payload_end: int) -> dict:
     return mf
 
 
+def _stream_size(f) -> int:
+    """Total byte length of an open binary stream: ``fstat`` for real
+    files, seek-to-end (position-restoring) for in-memory streams — the
+    RAM checkpoint tier verifies/loads ``BytesIO`` images through the
+    same code path as on-disk files."""
+    try:
+        return os.fstat(f.fileno()).st_size
+    except (OSError, AttributeError, io.UnsupportedOperation):
+        pos = f.tell()
+        size = f.seek(0, os.SEEK_END)
+        f.seek(pos)
+        return size
+
+
 def _open_verified(f) -> Tuple[dict, dict, int]:
     """Shared structural open for :func:`load`/:func:`verify`: parse +
     cross-check head and trailer manifest (head digest included).
@@ -578,7 +609,7 @@ def _open_verified(f) -> Tuple[dict, dict, int]:
     head, head_bytes = _read_head(f)
     payload_start = len(_CKPT_MAGIC) + 4 + len(head_bytes)
     payload_len = int(head.get("payload_len", -1))
-    file_size = os.fstat(f.fileno()).st_size
+    file_size = _stream_size(f)
     if payload_len < 0 or payload_start + payload_len > file_size:
         raise CheckpointCorruptError(
             f"truncated checkpoint (payload claims {payload_len}B, file "
@@ -624,29 +655,38 @@ def verify(path: str) -> dict:
     if head is not None:
         return _verify_set(path, head)
     with open(path, "rb") as f:
-        head, mf, _ = _open_verified(f)
-        preamble = _read_exact(f, int(mf["preamble_len"]), "preamble")
-        if "preamble_crc32" in mf and zlib.crc32(preamble) != int(
-                mf["preamble_crc32"]):
-            raise CheckpointCorruptError(
-                "payload preamble failed digest verification")
-        for e in mf["leaves"]:
-            if e.get("kind") != "array":
-                continue
-            remaining = int(e["nbytes"])
-            crc = 0
-            while remaining > 0:
-                chunk = f.read(min(remaining, 8 << 20))
-                if not chunk:
-                    raise CheckpointCorruptError(
-                        f"truncated checkpoint (leaf {e['key']!r})")
-                crc = zlib.crc32(chunk, crc)
-                remaining -= len(chunk)
-            if crc != int(e["crc32"]):
-                raise CheckpointCorruptError(
-                    f"leaf {e['key']!r} failed digest verification "
-                    f"(crc32 {crc:08x} != manifest {int(e['crc32']):08x})")
+        head = _verify_stream(f)
     head["path"] = path
+    return head
+
+
+def _verify_stream(f) -> dict:
+    """Full digest scan of an open v2 stream (head, preamble, every
+    array leaf's crc32 against the trailing manifest) — the body of
+    :func:`verify`, shared with the RAM tier so a peer-pushed image is
+    proven bitwise-correct before acceptance. Returns the head."""
+    head, mf, _ = _open_verified(f)
+    preamble = _read_exact(f, int(mf["preamble_len"]), "preamble")
+    if "preamble_crc32" in mf and zlib.crc32(preamble) != int(
+            mf["preamble_crc32"]):
+        raise CheckpointCorruptError(
+            "payload preamble failed digest verification")
+    for e in mf["leaves"]:
+        if e.get("kind") != "array":
+            continue
+        remaining = int(e["nbytes"])
+        crc = 0
+        while remaining > 0:
+            chunk = f.read(min(remaining, 8 << 20))
+            if not chunk:
+                raise CheckpointCorruptError(
+                    f"truncated checkpoint (leaf {e['key']!r})")
+            crc = zlib.crc32(chunk, crc)
+            remaining -= len(chunk)
+        if crc != int(e["crc32"]):
+            raise CheckpointCorruptError(
+                f"leaf {e['key']!r} failed digest verification "
+                f"(crc32 {crc:08x} != manifest {int(e['crc32']):08x})")
     return head
 
 
@@ -688,28 +728,40 @@ def _load_v2_tree(path: str, target_tree: Any,
     passes ``expect_set_id`` so a stale same-name shard from an older
     save generation fails the load instead of splicing in silently)."""
     with open(path, "rb") as f:
-        head, mf, payload_start = _open_verified(f)
-        if expect_set_id is not None and head.get("set_id") != \
-                expect_set_id:
-            raise CheckpointCorruptError(
-                f"shard {os.path.basename(path)} belongs to a different "
-                "save generation (set_id mismatch)")
-        # The payload preamble json carries 'py'-kind leaf VALUES inline
-        # (step counters, scalars): verify its digest too, or a bit flip
-        # there would load silently while every array leaf checks out.
-        preamble = _read_exact(f, int(mf["preamble_len"]), "preamble")
-        if "preamble_crc32" in mf and zlib.crc32(preamble) != int(
-                mf["preamble_crc32"]):
-            raise CheckpointCorruptError(
-                "payload preamble failed digest verification")
-        f.seek(payload_start)
-        digests = [int(e["crc32"]) for e in mf["leaves"]
-                   if e.get("kind") == "array"]
-        try:
-            return load_pytree_from(f, target_tree, device_put_fn=dput,
-                                    digests=digests)
-        except LeafDigestMismatch as e:
-            raise CheckpointCorruptError(str(e)) from e
+        return _load_v2_stream(f, target_tree, dput,
+                               expect_set_id=expect_set_id,
+                               what=os.path.basename(path))
+
+
+def _load_v2_stream(f, target_tree: Any, dput: Optional[Callable],
+                    expect_set_id: Optional[str] = None,
+                    what: str = "stream") -> Any:
+    """Digest-verified v2 load from an open binary stream — the body of
+    :func:`_load_v2_tree`, shared with the RAM tier
+    (:mod:`torchft_tpu.ram_ckpt`) so a stored image loads through
+    exactly the disk path's verification discipline."""
+    head, mf, payload_start = _open_verified(f)
+    if expect_set_id is not None and head.get("set_id") != \
+            expect_set_id:
+        raise CheckpointCorruptError(
+            f"shard {what} belongs to a different "
+            "save generation (set_id mismatch)")
+    # The payload preamble json carries 'py'-kind leaf VALUES inline
+    # (step counters, scalars): verify its digest too, or a bit flip
+    # there would load silently while every array leaf checks out.
+    preamble = _read_exact(f, int(mf["preamble_len"]), "preamble")
+    if "preamble_crc32" in mf and zlib.crc32(preamble) != int(
+            mf["preamble_crc32"]):
+        raise CheckpointCorruptError(
+            "payload preamble failed digest verification")
+    f.seek(payload_start)
+    digests = [int(e["crc32"]) for e in mf["leaves"]
+               if e.get("kind") == "array"]
+    try:
+        return load_pytree_from(f, target_tree, device_put_fn=dput,
+                                digests=digests)
+    except LeafDigestMismatch as e:
+        raise CheckpointCorruptError(str(e)) from e
 
 
 def _load_set(path: str, head: dict, target: Any,

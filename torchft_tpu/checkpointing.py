@@ -98,6 +98,10 @@ class LeafDigestError(ValueError):
 
 
 _RANGE_RE = re.compile(r"bytes=(\d+)-(\d+)?$")
+# Request-side Content-Range of a RAM-tier replication PUT:
+# ``bytes <start>-<end>/<total>`` (no wildcard forms — a pusher always
+# knows its image size).
+_CONTENT_RANGE_RE = re.compile(r"bytes (\d+)-(\d+)/(\d+)$")
 
 
 def _check_bearer_auth(handler: Any, token: Optional[str]) -> bool:
@@ -135,6 +139,34 @@ def _serve_ranged_body(handler: Any, state: Any, plan: Any,
     zero-copy memoryviews, and socket-write backpressure paces the
     fetches. Returns bytes written (0 for a 416)."""
     total = int(plan[1])
+    span = _negotiate_range(handler, total)
+    if span is None:
+        return 0
+    status, start, end = span
+    handler.send_response(status)
+    handler.send_header("Content-Type", "application/octet-stream")
+    handler.send_header("Content-Length", str(end - start))
+    if status == 206:
+        handler.send_header("Content-Range",
+                            f"bytes {start}-{end - 1}/{total}")
+    handler.end_headers()
+    handler.connection.settimeout(send_timeout_sec)
+    sent = 0
+    for chunk in iter_pytree_chunks(state, plan=plan, start=start,
+                                    end=end):
+        handler.wfile.write(chunk)
+        sent += len(chunk)
+    return sent
+
+
+def _negotiate_range(handler: Any, total: int
+                     ) -> Optional[Tuple[int, int, int]]:
+    """The ONE Range-header negotiation (shared by the live-plan body
+    server above and the RAM-tier image server): parse the request's
+    Range against ``total``, send the 416 itself (returning None), else
+    return ``(status, start, end)`` — 206 for a partial span, 200 for
+    the full stream (including an unparseable Range, which HTTP permits
+    ignoring)."""
     start, end = 0, total
     status = 200
     rng = handler.headers.get("Range")
@@ -149,10 +181,22 @@ def _serve_ranged_body(handler: Any, state: Any, plan: Any,
                 handler.send_header("Content-Range", f"bytes */{total}")
                 handler.send_header("Content-Length", "0")
                 handler.end_headers()
-                return 0
+                return None
             status = 206
-        # Unparseable Range: ignore it and serve the full stream with
-        # 200, as HTTP permits.
+    return status, start, end
+
+
+def _serve_ranged_bytes(handler: Any, view: memoryview,
+                        send_timeout_sec: float) -> int:
+    """Range-serve an immutable in-memory byte region (the RAM
+    checkpoint tier's payload serving — docs/design/memory_tier.md).
+    Same negotiation as :func:`_serve_ranged_body`; chunked memoryview
+    writes, so a healer's backpressure paces us without a full-copy."""
+    total = len(view)
+    span = _negotiate_range(handler, total)
+    if span is None:
+        return 0
+    status, start, end = span
     handler.send_response(status)
     handler.send_header("Content-Type", "application/octet-stream")
     handler.send_header("Content-Length", str(end - start))
@@ -162,8 +206,9 @@ def _serve_ranged_body(handler: Any, state: Any, plan: Any,
     handler.end_headers()
     handler.connection.settimeout(send_timeout_sec)
     sent = 0
-    for chunk in iter_pytree_chunks(state, plan=plan, start=start,
-                                    end=end):
+    step = 1 << 20
+    for off in range(start, end, step):
+        chunk = view[off:min(off + step, end)]
         handler.wfile.write(chunk)
         sent += len(chunk)
     return sent
@@ -660,6 +705,11 @@ class CheckpointServer:
         # exposition) on the same socket + auth gate. Snapshot reads of
         # immutable/locked state — like /publish, never step-gated.
         self._obs: Optional[Dict[str, Any]] = None
+        # Attached RAM checkpoint store (torchft_tpu.ram_ckpt,
+        # docs/design/memory_tier.md): serves stored peer images at
+        # /ramckpt/* and accepts replication PUTs. Images are immutable
+        # and pre-verified — like /publish, never step-gated.
+        self._ram_store: Optional[Any] = None
 
         ckpt_server = self
 
@@ -699,6 +749,16 @@ class CheckpointServer:
                         return
                     pub.handle_request(
                         self, send_timeout_sec=ckpt_server._send_timeout_sec)
+                    return
+                if self.path.startswith("/ramckpt/"):
+                    # RAM-tier images are immutable and pre-verified:
+                    # NOT step-gated by the heal serve window — a
+                    # commit in progress never blocks a replacement
+                    # healing from yesterday's committed image.
+                    if ckpt_server._shutdown:
+                        self.close_connection = True
+                        return
+                    ckpt_server._serve_ram(self)
                     return
                 prefix = "/checkpoint/"
                 if not self.path.startswith(prefix):
@@ -788,6 +848,19 @@ class CheckpointServer:
                         srv._inflight -= 1
                         srv._cond.notify_all()
 
+            def do_PUT(self) -> None:
+                # The RAM tier's push-side replication: ranged writes
+                # of a peer's v2 image against /ramckpt/{step}
+                # (docs/design/memory_tier.md). The assembled image is
+                # digest-verified BEFORE acceptance; a failed scan is a
+                # 422 and nothing is stored.
+                if not _check_bearer_auth(self, ckpt_server._auth_token):
+                    return
+                if ckpt_server._shutdown:
+                    self.close_connection = True
+                    return
+                ckpt_server._accept_ram_push(self)
+
         self._server = _CheckpointHTTPServer((bind_host, bind_port),
                                              Handler)
         self._thread = threading.Thread(
@@ -800,7 +873,8 @@ class CheckpointServer:
         # is a no-op without an active schedule).
         netloc = urllib.parse.urlparse(self.address()).netloc
         if netloc:
-            chaos.endpoint_reborn(f"heal:{netloc}", f"serve:{netloc}")
+            chaos.endpoint_reborn(f"heal:{netloc}", f"serve:{netloc}",
+                                  f"ram:{netloc}")
 
     def _capture_locked(self) -> Tuple[Any, Any]:
         """State + plan to stream for the current step. Requires _cond held.
@@ -928,6 +1002,150 @@ class CheckpointServer:
         :class:`~torchft_tpu.serving.WeightSubscriber` parents."""
         base = self.address()
         return base[:base.rindex("/checkpoint/")] + "/publish"
+
+    def attach_ram_store(self, store: Any) -> None:
+        """Attach a :class:`torchft_tpu.ram_ckpt.RamCheckpointStore`:
+        its verified images are then served at ``/ramckpt/{step}`` (+
+        ``/manifest``, ``/ramckpt/steps``) and peer replication PUTs
+        are accepted on this same socket and auth gate — the RAM tier
+        rides the existing striped heal transport, no second server."""
+        self._ram_store = store
+
+    def detach_ram_store(self) -> None:
+        """Withdraw the RAM tier (graceful preemption drain):
+        ``/ramckpt/*`` 404s from the next request on, so healers rotate
+        to surviving peers instead of a group that is about to exit."""
+        self._ram_store = None
+
+    def ram_address(self) -> str:
+        """Dialable base URL this server's RAM tier hangs off (append
+        ``/ramckpt/{step}``); peers derive the same base from a
+        checkpoint address with one ``rsplit`` — no extra registry."""
+        base = self.address()
+        return base[:base.rindex("/checkpoint/")]
+
+    def _serve_ram(self, handler: Any) -> None:
+        """Serve one /ramckpt GET (auth already checked):
+        ``/ramckpt/steps`` (stored steps, json), ``/ramckpt/{step}``
+        (the image's payload region, ranged — the exact stream a live
+        heal serves, so :meth:`load_from_address` works against it
+        unchanged), ``/ramckpt/{step}/manifest`` (the heal-protocol
+        digest manifest). Never step-gated; a missing image is a plain
+        404 the healer turns into falling down the recovery ladder."""
+        store = self._ram_store
+        if store is None:
+            handler.send_error(404, "no RAM checkpoint store attached")
+            return
+        path = handler.path.split("?", 1)[0].rstrip("/")
+        rest = path[len("/ramckpt"):].strip("/")
+        try:
+            if rest == "steps":
+                body = json.dumps({"steps": store.steps()}).encode()
+                handler.send_response(200)
+                handler.send_header("Content-Type", "application/json")
+                handler.send_header("Content-Length", str(len(body)))
+                handler.end_headers()
+                handler.connection.settimeout(self._send_timeout_sec)
+                handler.wfile.write(body)
+                return
+            want_manifest = rest.endswith("/manifest")
+            if want_manifest:
+                rest = rest[:-len("/manifest")]
+            try:
+                step = int(rest)
+            except ValueError:
+                handler.send_error(400, "bad step")
+                return
+            image = store.get(step)
+            if image is None:
+                handler.send_error(
+                    404, f"no RAM image for step {step}")
+                return
+            if want_manifest:
+                body = json.dumps(image.transfer_manifest()).encode()
+                handler.send_response(200)
+                handler.send_header("Content-Type", "application/json")
+                handler.send_header("Content-Length", str(len(body)))
+                handler.end_headers()
+                handler.connection.settimeout(self._send_timeout_sec)
+                handler.wfile.write(body)
+                return
+            _serve_ranged_bytes(handler, image.payload_view(),
+                                self._send_timeout_sec)
+        except Exception as e:  # noqa: BLE001 — surface, keep serving
+            logger.exception("ram checkpoint serve failed")
+            try:
+                handler.send_error(500, str(e))
+            except Exception:
+                pass
+
+    def _accept_ram_push(self, handler: Any) -> None:
+        """Accept one replication PUT chunk (auth already checked).
+        Status codes: 200 (chunk staged / image accepted — the json
+        body's ``complete`` flag says which), 404 (no store attached),
+        400 (malformed path/range), 422 (assembled image FAILED digest
+        verification — nothing stored), 503 (chaos transport fault on
+        the accept path)."""
+        from torchft_tpu.checkpoint_io import CheckpointCorruptError
+
+        store = self._ram_store
+        if store is None:
+            handler.send_error(404, "no RAM checkpoint store attached")
+            return
+        path = handler.path.split("?", 1)[0].rstrip("/")
+        if not path.startswith("/ramckpt/"):
+            handler.send_error(404, "unknown path")
+            return
+        try:
+            step = int(path[len("/ramckpt/"):])
+        except ValueError:
+            handler.send_error(400, "bad step")
+            return
+        try:
+            length = int(handler.headers.get("Content-Length", ""))
+        except ValueError:
+            handler.send_error(400, "missing Content-Length")
+            return
+        crng = handler.headers.get("Content-Range")
+        if crng is not None:
+            m = _CONTENT_RANGE_RE.match(crng.strip())
+            if m is None:
+                handler.send_error(400, "bad Content-Range")
+                return
+            start, last, total = (int(m.group(1)), int(m.group(2)),
+                                  int(m.group(3)))
+            if last - start + 1 != length:
+                handler.send_error(
+                    400, "Content-Range/Content-Length mismatch")
+                return
+        else:
+            start, total = 0, length
+        data = handler.rfile.read(length)
+        if len(data) != length:
+            handler.send_error(400, "short request body")
+            return
+        origin = handler.headers.get("X-TFT-Origin", "peer")
+        try:
+            image = store.stage_write(step, start, data, total,
+                                      origin=origin)
+        except CheckpointCorruptError as e:
+            handler.send_error(422, f"image failed verification: {e}")
+            return
+        except ValueError as e:
+            handler.send_error(400, str(e))
+            return
+        except (ConnectionError, OSError) as e:
+            # The chaos accept hook's transport faults (blackhole /
+            # reset / dead peer) — transient to the pusher.
+            handler.send_error(503, str(e))
+            return
+        body = json.dumps({"complete": image is not None}).encode()
+        handler.send_response(200)
+        handler.send_header("Content-Type", "application/json")
+        handler.send_header("Content-Length", str(len(body)))
+        handler.end_headers()
+        handler.connection.settimeout(self._send_timeout_sec)
+        handler.wfile.write(body)
 
     def allow_checkpoint(self, step: int) -> None:
         """Open the serve window for ``step`` (called at step start, while

@@ -380,6 +380,8 @@ class Manager:
         tracing: Optional[bool] = None,
         trace_steps: Optional[int] = None,
         fleet_telemetry: Optional[bool] = None,
+        ram_ckpt_peers: Optional[int] = None,
+        ram_demote_dir: Optional[str] = None,
         _manager_client: Optional[ManagerClient] = None,
     ) -> None:
         self._comm = comm
@@ -721,6 +723,18 @@ class Manager:
             "fleet_groups": 0.0,
             "slo_breach": 0.0,
             "slo_breaches_total": 0.0,
+            # RAM checkpoint tier (docs/design/memory_tier.md): heals
+            # served from a peer's RAM rung instead of disk, and
+            # commit-boundary replications refused because the state
+            # was mid-heal/errored/uncommitted/deferred (the
+            # ckpt_save_skipped analogue). The store/replicator's own
+            # counters (ram_ckpt_peers, ram_ckpt_bytes_replicated_total,
+            # demote_stage_ms_total, …) merge in via metrics() while
+            # the tier is enabled.
+            "ram_ckpt_heals_total": 0.0,
+            "ram_replicate_skipped": 0.0,
+            "ram_replicate_errors_total": 0.0,
+            "ram_replica_collapses_total": 0.0,
         }
         self._metrics_lock = threading.Lock()
         if self._controller is not None:
@@ -812,8 +826,9 @@ class Manager:
         self._durable_explicit = False
         self._shutdown_done = False
         # Facts of the last validated quorum round consumed by the
-        # drain's advertisement withdrawal: (store_address,
-        # replica_rank). None before the first round.
+        # drain's advertisement withdrawal and the RAM tier's peer
+        # discovery: (store_address, replica_rank, max_world_size).
+        # None before the first round.
         self._last_round_facts: Optional[tuple] = None
         # Churn-rate observability: monotonic stamps of recent ring
         # reconfigures (reconfigures_per_min gauge), and the previous
@@ -861,6 +876,33 @@ class Manager:
                                          "0.0.0.0")),
             auth_token=self._auth_token,
         )
+
+        # --- RAM checkpoint tier (docs/design/memory_tier.md) ------------
+        # Armed in _init_observability (the replica id must exist for
+        # the chaos scope + log attribution): at every commit boundary
+        # the committed snapshot is encoded once and cross-replicated to
+        # K peer hosts' RAM over the striped transport run in reverse,
+        # then demoted RAM -> local disk -> durable store off the
+        # training loop. 0 peers (the default) leaves the tier off and
+        # every path bit-exact with pre-tier builds.
+        self._ram_store: Optional[Any] = None
+        self._ram_replicator: Optional[Any] = None
+        self._ram_peers_k = 0
+        self._ram_demote_dir = (ram_demote_dir
+                                or os.environ.get("TORCHFT_RAM_DEMOTE_DIR")
+                                or None)
+        self._ram_prefix = "ckpt_"
+        # High-water mark of peers that accepted a replication — a drop
+        # to 0 afterwards is a replication-set collapse (flight dump).
+        self._ram_peers_seen = 0.0
+        self._ram_collapse_dumped = False
+        if ram_ckpt_peers is None:
+            try:
+                ram_ckpt_peers = int(
+                    os.environ.get("TORCHFT_RAM_CKPT_PEERS", "0"))
+            except ValueError:
+                ram_ckpt_peers = 0
+        self._ram_peers_pending = max(int(ram_ckpt_peers), 0)
 
         if _manager_client is not None:
             # Test hook: fully wired externally (mirrors patching
@@ -937,6 +979,9 @@ class Manager:
             attach(tracer=self._tracer, metrics_fn=self.metrics,
                    info_fn=self.metrics_info,
                    labels={"replica_id": self._replica_id})
+        if self._ram_peers_pending > 0:
+            self.enable_ram_tier(peers=self._ram_peers_pending,
+                                 demote_dir=self._ram_demote_dir)
 
     def _flight_dump(self, reason: str, **extra: Any) -> None:
         """Trigger a flight-recorder dump (no-op without
@@ -1000,6 +1045,16 @@ class Manager:
             # Backoff so a dead lighthouse doesn't turn the training loop
             # into a busy spin of doomed RPCs.
             time.sleep(min(0.05 * streak, 1.0))
+
+        # RAM checkpoint tier (docs/design/memory_tier.md): replicate
+        # the committed snapshot to K peer hosts' RAM HERE — the same
+        # post-apply edge the preemption drain lands on, and for the
+        # same reason: the caller has applied the committed update, so
+        # the image carries step N's metadata over step N's params.
+        # Refusal classes (mid-heal / errored / aborted / deferred)
+        # skip the boundary; the cost on the loop is one on-device
+        # snapshot — encode and the demotion ladder run behind it.
+        self._maybe_replicate_ram()
 
         if self._should_step:
             # Under the metrics lock so (participant_rank,
@@ -1110,11 +1165,12 @@ class Manager:
         # the "refused mid-heal, retried next boundary" rule).
         self._policy_round = (getattr(q, "store_address", "") or "",
                               q.replica_world_size, q.max_world_size)
-        # Facts the graceful drain's advertisement withdrawal needs
-        # after the quorum thread has moved on (store + our healset key
-        # rank, docs/design/churn.md).
+        # Facts the graceful drain's advertisement withdrawal and the
+        # RAM tier's peer discovery need after the quorum thread has
+        # moved on (store + our healset key rank + the rank space to
+        # scan, docs/design/churn.md + memory_tier.md).
         self._last_round_facts = (getattr(q, "store_address", "") or "",
-                                  q.replica_rank)
+                                  q.replica_rank, q.max_world_size)
 
         with self._metrics_lock:  # pair with participant_slot() snapshots
             if self._use_async_quorum:
@@ -2813,6 +2869,13 @@ class Manager:
             detach = getattr(self._ckpt_server, "detach_publication", None)
             if detach is not None:
                 detach()
+        if self._ram_store is not None:
+            # A draining group must stop serving/accepting the RAM
+            # rung too: peers' next probe 404s and rotates donors
+            # instead of striping a heal across a corpse.
+            detach = getattr(self._ckpt_server, "detach_ram_store", None)
+            if detach is not None:
+                detach()
         self._ckpt_server.disallow_checkpoint()
 
     # ------------------------------------------- join admission control
@@ -2881,37 +2944,76 @@ class Manager:
                                  exc_info=True)
             if not addrs:
                 return False
+            # RAM rung first (docs/design/memory_tier.md): donors whose
+            # RamCheckpointStore holds fleet_step serve the identical
+            # digest-manifested bytes from host RAM at …/ramckpt/{step}
+            # — the striped fetch below runs against them UNCHANGED
+            # (same crc oracle), just without a disk in the path. Probe
+            # only when this manager runs the tier itself; a probe miss
+            # or a RAM-leg failure falls back to the checkpoint tier.
+            ram_addrs: list = []
+            if self._ram_store is not None:
+                from torchft_tpu import ram_ckpt
+
+                for a in addrs:
+                    if "/checkpoint/" not in a:
+                        continue
+                    base = a.rsplit("/checkpoint/", 1)[0]
+                    if fleet_step in ram_ckpt.peer_steps(
+                            base, auth_token=self._auth_token):
+                        ram_addrs.append(f"{base}/ramckpt/{fleet_step}")
             target = self._manager_state_dict()
             stats: Dict[str, float] = {}
-            with self._tracer.span("prejoin_heal", donors=len(addrs),
-                                   fleet_step=fleet_step):
-                state = cast(
+
+            def _fetch(donor_addrs: list) -> Dict[str, Any]:
+                return cast(
                     Dict[str, Any],
                     CheckpointServer.load_from_address(
-                        addrs[0], target, stats=stats,
+                        donor_addrs[0], target, stats=stats,
                         auth_token=self._auth_token,
                         retry_policy=self._retry_policy,
                         retry_stats=self._retry_stats,
                         stall_timeout_sec=self._heal_stall_timeout_sec,
                         donors=lambda i: None,
                         max_donor_failovers=0,
-                        donor_addrs=(addrs if len(addrs) > 1 else None),
+                        donor_addrs=(donor_addrs
+                                     if len(donor_addrs) > 1 else None),
                         stripe_seed=_stripe_seed(self._replica_id),
                         tracer=self._tracer),
                 )
+
+            used_ram = bool(ram_addrs)
+            with self._tracer.span("prejoin_heal", donors=len(addrs),
+                                   fleet_step=fleet_step,
+                                   tier="ram" if used_ram else "disk"):
+                try:
+                    state = _fetch(ram_addrs if used_ram else addrs)
+                except Exception:  # noqa: BLE001 — rung fallback
+                    if not used_ram:
+                        raise
+                    logger.warning(
+                        "%s: RAM-rung pre-join heal failed; falling "
+                        "back to the checkpoint tier",
+                        self._replica_id, exc_info=True)
+                    used_ram = False
+                    state = _fetch(addrs)
             self.load_state_dict(state["torchft"])
             self._user_load_state_dict(state["user"])
             self._record(prejoin_heals_total=1,
-                         heal_bytes_total=stats.get("bytes", 0.0))
+                         heal_bytes_total=stats.get("bytes", 0.0),
+                         **({"ram_ckpt_heals_total": 1}
+                            if used_ram else {}))
             self._log_event(
                 event="prejoin_heal", step=self._step,
                 fleet_step=fleet_step, donors=len(addrs),
+                tier="ram" if used_ram else "disk",
                 bytes=stats.get("bytes", 0.0))
             logger.info(
                 "%s: pre-join heal adopted fleet step %d from %d "
-                "donor(s) (%d bytes) — joining the voting quorum "
-                "already current", self._replica_id, self._step,
-                len(addrs), int(stats.get("bytes", 0.0)))
+                "donor(s) (%d bytes, %s tier) — joining the voting "
+                "quorum already current", self._replica_id, self._step,
+                len(addrs), int(stats.get("bytes", 0.0)),
+                "RAM" if used_ram else "checkpoint")
             return True
         except Exception:  # noqa: BLE001 — backpressure is best-effort
             logger.warning("%s: pre-join heal failed; falling back to "
@@ -3508,29 +3610,39 @@ class Manager:
             return max(snap[key] - prev[key], 0.0)
 
         stages = self._tracer.stage_totals(self._step)
+        kwargs = dict(
+            step=self._step,
+            step_wall_ms=max(now - prev["t"], 0.0) * 1e3,
+            fetch_ms=stages.get("fetch_dispatch", 0.0)
+            + stages.get("fetch_wait", 0.0),
+            ring_ms=stages.get("ring", 0.0),
+            put_ms=stages.get("put", 0.0),
+            vote_ms=stages.get("vote", 0.0),
+            heal_bytes_inflight=mx.get(
+                "heal_last_bytes_committed", 0.0),
+            publish_bytes_inflight=mx.get(
+                "publish_payload_bytes_last", 0.0),
+            policy_rung=int(mx.get("policy_current", -1.0)),
+            capacity_fraction=self._capacity_fraction,
+            churn_per_min=mx.get("reconfigures_per_min", 0.0),
+            healing=bool(self._healing
+                         or not self.is_participating()),
+            heal_last_ms=delta("heal_ms_total", "heal_count"),
+            publish_last_ms=delta("publish_ms_total",
+                                  "publish_count"),
+            trace_addr=self._ckpt_server.address(),
+        )
         try:
-            set_digest(
-                step=self._step,
-                step_wall_ms=max(now - prev["t"], 0.0) * 1e3,
-                fetch_ms=stages.get("fetch_dispatch", 0.0)
-                + stages.get("fetch_wait", 0.0),
-                ring_ms=stages.get("ring", 0.0),
-                put_ms=stages.get("put", 0.0),
-                vote_ms=stages.get("vote", 0.0),
-                heal_bytes_inflight=mx.get(
-                    "heal_last_bytes_committed", 0.0),
-                publish_bytes_inflight=mx.get(
-                    "publish_payload_bytes_last", 0.0),
-                policy_rung=int(mx.get("policy_current", -1.0)),
-                capacity_fraction=self._capacity_fraction,
-                churn_per_min=mx.get("reconfigures_per_min", 0.0),
-                healing=bool(self._healing
-                             or not self.is_participating()),
-                heal_last_ms=delta("heal_ms_total", "heal_count"),
-                publish_last_ms=delta("publish_ms_total",
-                                      "publish_count"),
-                trace_addr=self._ckpt_server.address(),
-            )
+            try:
+                # RAM-tier fan-in rides the same digest (-1 = tier off)
+                # so the fleet plane sees a replication-set collapse;
+                # the TypeError retry keeps older control planes that
+                # predate the field working unchanged.
+                set_digest(ram_peers=int(mx["ram_ckpt_peers"])
+                           if "ram_ckpt_peers" in mx else -1,
+                           **kwargs)
+            except TypeError:
+                set_digest(**kwargs)
         except Exception:  # noqa: BLE001 — observability never fails
             logger.debug("digest push failed", exc_info=True)
 
@@ -3619,6 +3731,16 @@ class Manager:
         # what training is doing.
         if self._publisher is not None:
             out.update(self._publisher.metrics())
+        # RAM-tier counters (docs/design/memory_tier.md): the store's
+        # accept/reject/eviction/loss accounting and the replicator's
+        # replication/demotion pipeline (ram_ckpt_peers,
+        # ram_ckpt_bytes_replicated_total, demote_stage_ms_total, …) —
+        # present only while the tier is enabled, like the attached
+        # writer/publisher merges above.
+        if self._ram_store is not None:
+            out.update(self._ram_store.metrics())
+        if self._ram_replicator is not None:
+            out.update(self._ram_replicator.metrics())
         return out
 
     def metrics_info(self) -> Dict[str, str]:
@@ -3660,6 +3782,219 @@ class Manager:
             "ring_topology": topo if isinstance(topo, str) else "flat",
             "straggler_stage": fleet_stage,
         }
+
+    # ------------------------------------------------- RAM checkpoint tier
+    # docs/design/memory_tier.md: peer RAM is the first rung of the
+    # recovery ladder. At every commit boundary the committed snapshot is
+    # encoded ONCE into an in-memory v2 image (single-write-pass digests)
+    # and pushed to K peer hosts' RamCheckpointStores over the striped
+    # transport run in reverse; demotion RAM -> local disk -> durable
+    # store runs behind it on the AsyncCheckpointer discipline. A cold
+    # replacement heals from a peer's RAM at NIC speed (prejoin_heal /
+    # cold_start prefer the RAM rung); disk is the correlated-failure
+    # rung only.
+
+    def enable_ram_tier(self, peers: int = 2,
+                        demote_dir: Optional[str] = None,
+                        durable_dir: Optional[str] = None,
+                        prefix: str = "ckpt_",
+                        keep: int = 2,
+                        store: Optional[Any] = None) -> None:
+        """Arm the RAM checkpoint tier: attach a
+        :class:`~torchft_tpu.ram_ckpt.RamCheckpointStore` to this
+        manager's checkpoint server (``/ramckpt/*`` starts serving and
+        accepting peer pushes) and start commit-coupled replication to
+        ``peers`` peer hosts at every boundary (:meth:`step` dispatches
+        automatically; :meth:`replicate_ram` is the manual spelling).
+        ``demote_dir``/``durable_dir`` add the local-disk / durable
+        rungs of async demotion (files land as
+        ``{dir}/{prefix}{step}`` — :func:`torchft_tpu.checkpoint_io.
+        recover` and :meth:`cold_start` pick them up with no new scan
+        logic). Idempotent re-arm replaces the replicator config but
+        keeps an existing store's images."""
+        from torchft_tpu import ram_ckpt
+
+        scope = f"ram:{self._replica_id}"
+        try:  # chaos scope = the served endpoint's identity when known
+            import urllib.parse as _up
+
+            netloc = _up.urlsplit(self._ckpt_server.address()).netloc
+            if netloc:
+                scope = f"ram:{netloc}"
+        except Exception:  # noqa: BLE001 — duck-typed transports
+            pass
+        if store is None:
+            store = (self._ram_store
+                     or ram_ckpt.RamCheckpointStore(keep=keep,
+                                                    chaos_scope=scope))
+        self._ram_store = store
+        self._ram_peers_k = max(int(peers), 0)
+        self._ram_prefix = prefix
+        if demote_dir is not None:
+            self._ram_demote_dir = demote_dir
+        self._ram_replicator = ram_ckpt.RamReplicator(
+            store,
+            peers_fn=self._ram_peer_bases,
+            k=self._ram_peers_k,
+            demote_dir=self._ram_demote_dir,
+            durable_dir=durable_dir,
+            prefix=prefix,
+            auth_token=self._auth_token,
+            retry_policy=self._retry_policy,
+            retry_stats=self._retry_stats,
+            chaos_scope=scope,
+        )
+        attach = getattr(self._ckpt_server, "attach_ram_store", None)
+        if attach is not None:
+            attach(store)
+        logger.info(
+            "%s: RAM checkpoint tier armed (k=%d demote_dir=%s "
+            "durable_dir=%s)", self._replica_id, self._ram_peers_k,
+            self._ram_demote_dir, durable_dir)
+
+    def disable_ram_tier(self) -> None:
+        """Withdraw the RAM tier: drain the in-flight replication,
+        detach ``/ramckpt/*`` (peers' next probe 404s and rotates), and
+        stop dispatching at boundaries. The store's images are dropped
+        with it — a disabled tier must not serve stale steps."""
+        rep, self._ram_replicator = self._ram_replicator, None
+        self._ram_peers_k = 0
+        if rep is not None:
+            rep.shutdown()
+        detach = getattr(self._ckpt_server, "detach_ram_store", None)
+        if detach is not None:
+            detach()
+        if self._ram_store is not None:
+            self._ram_store.clear()
+        self._ram_store = None
+
+    def ram_tier_enabled(self) -> bool:
+        """True while commit boundaries replicate to peer RAM."""
+        return self._ram_replicator is not None
+
+    def _ram_peer_bases(self) -> list:
+        """Replication targets: every OTHER live group's checkpoint
+        server base, resolved from the same per-rank healset
+        advertisement keys striped heals read (``torchft/healset/{r}``,
+        value ``"{step}:{addr}"``) — one donor registry for both
+        directions of the byte path. Withdrawn groups' ``-1:``
+        tombstones parse to an addressless entry and drop out; unlike a
+        heal's donor filter, ANY live advertisement qualifies (the
+        pusher doesn't care what step the peer last served — it is
+        about to hand it a new one). Empty before the first quorum
+        round or on mocked control planes."""
+        facts = self._last_round_facts
+        if facts is None or len(facts) < 3:
+            return []
+        store_addr, my_rank, max_world = facts
+        bases: list = []
+        try:
+            store = self._store_client(store_addr)
+            if store is None:
+                return []
+            for r in range(int(max_world)):
+                if r == my_rank:
+                    continue
+                try:
+                    v = store.get(f"torchft/healset/{r}",
+                                  timeout_ms=200).decode()
+                except Exception:  # noqa: BLE001 — absent rank key
+                    continue
+                step_s, _, a = v.partition(":")
+                if step_s == "-1" or not a:
+                    continue  # withdrawn (tombstoned) or malformed
+                base = (a.rsplit("/checkpoint/", 1)[0]
+                        if "/checkpoint/" in a else a.rstrip("/"))
+                if base and base not in bases:
+                    bases.append(base)
+        except Exception:  # noqa: BLE001 — discovery is best-effort
+            logger.debug("ram peer discovery failed", exc_info=True)
+        return bases
+
+    def replicate_ram(self) -> Optional[Future]:
+        """Commit-coupled RAM replication: snapshot the committed state
+        and run the encode -> peer-push -> demote pipeline in the
+        background; returns the job's Future (peer-accept count) or
+        ``None`` when refused. Same refusal classes as
+        :meth:`save_durable` — a heal staged/unapplied, a latched
+        error, an aborted vote, or a deferred allreduce in flight mean
+        this state is NOT a settled committed step's, and an image of
+        it replicated to K hosts would multiply exactly the
+        inconsistency the tier exists to escape."""
+        if self._ram_replicator is None:
+            return None
+        with self._metrics_lock:
+            healing = self._healing
+        committed = self._should_step
+        deferred = self.deferred_pending()
+        if healing or self._errored is not None or not committed \
+                or deferred:
+            logger.warning(
+                "%s: skipping RAM replication at step %d (healing=%s "
+                "errored=%s committed=%s deferred=%s) — state is not a "
+                "settled committed step's", self._replica_id, self._step,
+                healing, self._errored is not None, committed, deferred)
+            self._record(ram_replicate_skipped=1)
+            self._log_event(
+                event="ram_replicate_skip", step=self._step,
+                healing=healing, errored=self._errored is not None,
+                committed=committed, deferred=deferred)
+            return None
+        meta = {
+            "committed": True,
+            "quorum_id": self._quorum_id,
+            "replica_id": self._replica_id,
+            "participants": self._participating_world_size,
+        }
+        # Spans the DISPATCH (on-device snapshot + enqueue); encode and
+        # every demotion stage run on the replicator's worker and are
+        # timed by its demote_*_ms counters.
+        with self._tracer.span("ram_replicate", step=self._step):
+            fut = self._ram_replicator.replicate_async(
+                self._user_state_dict(), self.state_dict(), meta=meta)
+        self._log_event(event="ram_replicate", step=self._step)
+        return fut
+
+    def _maybe_replicate_ram(self) -> None:
+        """:meth:`step`'s boundary hook: dispatch this boundary's
+        replication, surface the previous job's latched error into the
+        log/counters (the tier is best-effort — it must never take the
+        training loop down with it), and detect replication-set
+        collapse (peers accepting dropped to ZERO after replication had
+        been landing) with a one-shot flight dump: the operator's
+        signal that the fleet is one correlated failure away from the
+        disk rung."""
+        if self._ram_replicator is None:
+            return
+        m = self._ram_replicator.metrics()
+        peers_now = m.get("ram_ckpt_peers", 0.0)
+        if peers_now > 0:
+            self._ram_peers_seen = max(self._ram_peers_seen, peers_now)
+            self._ram_collapse_dumped = False
+        elif (self._ram_peers_seen > 0
+                and m.get("ram_ckpt_replications_total", 0.0) > 0
+                and not self._ram_collapse_dumped):
+            self._ram_collapse_dumped = True
+            self._record(ram_replica_collapses_total=1)
+            self._log_event(event="ram_replica_collapse",
+                            step=self._step,
+                            peers_seen=self._ram_peers_seen)
+            self._flight_dump("ram_replica_collapse",
+                              peers_seen=self._ram_peers_seen)
+            logger.error(
+                "%s: RAM replication set collapsed (previously %d "
+                "peer(s), now 0) — recovery is one correlated failure "
+                "from the disk rung", self._replica_id,
+                int(self._ram_peers_seen))
+        try:
+            self.replicate_ram()
+        except Exception:  # noqa: BLE001 — best-effort tier
+            self._record(ram_replicate_errors_total=1)
+            self._log_event(event="ram_replicate_error",
+                            step=self._step)
+            logger.warning(
+                "%s: RAM replication dispatch failed at step %d",
+                self._replica_id, self._step, exc_info=True)
 
     # ------------------------------------------------- durable checkpoints
 
@@ -3808,13 +4143,25 @@ class Manager:
         return self._ckpt_server.publish_address()
 
     def cold_start(self, directory: str, prefix: str = "ckpt_",
-                   ) -> Optional[str]:
+                   ram_peers: Optional[list] = None) -> Optional[str]:
         """Correlated-failure recovery: after a kill-all / preemption,
         restore this group from the newest **verified committed** durable
         snapshot under ``directory``
         (:func:`torchft_tpu.checkpoint_io.recover` — torn/corrupt files
         are quarantined, never loaded) and return its path, or ``None``
         for a fresh start.
+
+        ``ram_peers`` (checkpoint-server base URLs of surviving hosts,
+        docs/design/memory_tier.md) adds the RAM rung ABOVE the disk
+        scan: each peer's ``/ramckpt/steps`` is probed, and when a
+        surviving RAM image is at least as new as the newest verified
+        disk snapshot, the state heals from that peer's RAM over the
+        striped digest-verified fetch instead of the disk read — at
+        NIC speed, with the same bitwise oracle (the image IS a v2
+        stream; every leaf crc is checked before placement). Any RAM
+        failure falls back to disk: RAM is an accelerant, never a
+        correctness dependency — and a truly correlated failure (every
+        peer's RAM gone) lands on the disk rung by construction.
 
         Both the user pytree and the manager metadata (step /
         batches_committed) are restored, so the next :meth:`step` joins
@@ -3829,6 +4176,56 @@ class Manager:
         path = checkpoint_io.recover(directory, prefix=prefix,
                                      stats=stats)
         self._record(**stats)
+        disk_step = -1
+        if path is not None:
+            try:
+                disk_step = int(os.path.basename(path)[len(prefix):])
+            except ValueError:
+                disk_step = -1
+        if ram_peers:
+            from torchft_tpu import ram_ckpt
+
+            best_base, best_step = None, disk_step
+            for base in ram_peers:
+                steps = ram_ckpt.peer_steps(base,
+                                            auth_token=self._auth_token)
+                if steps and steps[-1] >= best_step:
+                    best_base, best_step = base, steps[-1]
+            if best_base is not None:
+                addr = f"{best_base.rstrip('/')}/ramckpt/{best_step}"
+                try:
+                    with self._tracer.span("cold_start_ram",
+                                           step=best_step):
+                        state = cast(
+                            Dict[str, Any],
+                            CheckpointServer.load_from_address(
+                                addr, self._manager_state_dict(),
+                                stats=stats,
+                                auth_token=self._auth_token,
+                                retry_policy=self._retry_policy,
+                                retry_stats=self._retry_stats,
+                                stall_timeout_sec=(
+                                    self._heal_stall_timeout_sec),
+                                tracer=self._tracer))
+                    self._user_load_state_dict(state["user"])
+                    self.load_state_dict(state["torchft"])
+                    self._record(ckpt_cold_starts=1,
+                                 ram_ckpt_heals_total=1)
+                    self._log_event(
+                        event="cold_start", recovered=True, tier="ram",
+                        path=addr, step=self._step,
+                        quarantined=stats.get(
+                            "ckpt_corrupt_quarantined", 0.0))
+                    logger.info(
+                        "%s cold-started from peer RAM %s at step %d "
+                        "(disk rung was step %d)", self._replica_id,
+                        addr, self._step, disk_step)
+                    return addr
+                except Exception:  # noqa: BLE001 — rung fallback
+                    logger.warning(
+                        "%s: RAM-rung cold start from %s failed; "
+                        "falling back to the disk rung",
+                        self._replica_id, addr, exc_info=True)
         if path is None:
             self._log_event(
                 event="cold_start", recovered=False,
@@ -4015,6 +4412,10 @@ class Manager:
             self._deferred = None
         if self._flight is not None:
             self._flight.close()  # off the atexit crash-dump registry
+        if self._ram_replicator is not None:
+            # Drain (or abandon, if stalled) the in-flight replication
+            # before the server that peers pull from goes away.
+            self._ram_replicator.shutdown()
         self._ckpt_server.shutdown()
         self._executor.shutdown(wait=False, cancel_futures=True)
         # No cancel_futures here: a queued finish_bucket must still run (it
