@@ -76,6 +76,24 @@ def _provenance() -> Dict[str, Any]:
     return dict(_PROVENANCE)
 
 
+def _ab_server_cores(fn, **kw):
+    """Run ``fn`` under both transport hosting cores — the legacy
+    threaded server first (``TORCHFT_ASYNC_SERVER=0``, read per server
+    start) and then the default async event loop — returning
+    ``(threaded, async_)`` results. The cut-over A/B of ISSUE 17: the
+    async leg must hold or beat the threaded leg on the same rig."""
+    prev = os.environ.get("TORCHFT_ASYNC_SERVER")
+    os.environ["TORCHFT_ASYNC_SERVER"] = "0"
+    try:
+        threaded = fn(**kw)
+    finally:
+        if prev is None:
+            os.environ.pop("TORCHFT_ASYNC_SERVER", None)
+        else:
+            os.environ["TORCHFT_ASYNC_SERVER"] = prev
+    return threaded, fn(**kw)
+
+
 def _emit(obj: Dict[str, Any]) -> None:
     # Provenance first: a row's OWN fields win, so scenarios that
     # override an ambient knob per-run (tracing_enabled in the trace
@@ -1564,6 +1582,113 @@ def bench_publish_fanout(payload_mb: float = 4.0, subscribers: int = 12,
     return out
 
 
+def bench_qos_contention(payload_mb: float = 8.0, pub_streams: int = 6,
+                         secs: float = 2.5,
+                         warmup_s: float = 0.3) -> Dict[str, float]:
+    """Heal-vs-publish contention on the shared transport substrate
+    (docs/design/transport_substrate.md). One async server core hosts a
+    ranged blob; ``pub_streams`` publication-class clients loop full
+    fetches flat-out (the saturating publication leg) while ONE
+    heal-class client measures its delivered MB/s through the same
+    egress. Unweighted FIFO would decay the heal stream toward
+    ``1/(1+pub_streams)`` of its solo rate; the DRR scheduler's 4:2
+    heal:publication weights hold a backlogged heal class at
+    weight-proportional drain instead. Reported:
+
+    * ``heal_solo_mb_s`` / ``heal_contended_mb_s`` — the heal-class
+      fetch rate on an idle server vs under the saturating leg.
+    * ``heal_contended_share`` — contended/solo; the starvation signal.
+    * ``unweighted_share_floor`` — ``1/(1+pub_streams)``, where a
+      weightless server would land the heal stream.
+    * ``qos_waits_delta`` — scheduler contention events observed during
+      the window, proof the DRR pump (not an idle rig) produced the
+      share.
+
+    Gate (ISSUE-17 acceptance): the heal class is NOT starved —
+    ``heal_contended_share`` clears the unweighted floor with margin.
+    Pure-python, native-free."""
+    from torchft_tpu import transport
+
+    rng = np.random.default_rng(23)
+    blob = rng.integers(0, 256, size=int(payload_mb * 1e6),
+                        dtype=np.uint8).tobytes()
+    view = memoryview(blob)
+
+    def route(handler: Any) -> None:
+        if handler.command != "GET":
+            handler.send_error(501, "GET only")
+            return
+        transport.serve_ranged_bytes(handler, view, send_timeout_sec=30.0)
+
+    srv = transport.serve_http("127.0.0.1", 0, route, name="qos-bench")
+    host, port = srv.server_address[:2]
+    url = f"http://{host}:{port}/blob"
+
+    def fetch_loop(qos_name: str, stop_at: list, counter: list) -> None:
+        pool = transport.ConnectionPool()
+        try:
+            while time.perf_counter() < stop_at[0]:
+                with pool.request(
+                        url, stall=60.0, auth_token=None,
+                        headers={transport.QOS_HEADER: qos_name}) as resp:
+                    while True:
+                        chunk = resp.read(1 << 16)
+                        if not chunk:
+                            break
+                        counter[0] += len(chunk)
+        finally:
+            pool.close()
+
+    out: Dict[str, float] = {"payload_mbytes": len(blob) / 1e6,
+                             "pub_streams": pub_streams,
+                             "window_s": secs}
+    try:
+        # Solo heal leg: the reference rate everything is shared against.
+        solo_c = [0]
+        t0 = time.perf_counter()
+        fetch_loop("heal", [t0 + secs], solo_c)
+        solo = solo_c[0] / 1e6 / (time.perf_counter() - t0)
+
+        # Saturating publication leg + the measured heal stream.
+        m0 = transport.metrics()
+        pub_stop = [time.perf_counter() + warmup_s + secs + 60.0]
+        pub_counts = [[0] for _ in range(pub_streams)]
+        pubs = [threading.Thread(target=fetch_loop,
+                                 args=("publication", pub_stop, pc),
+                                 daemon=True)
+                for pc in pub_counts]
+        for t in pubs:
+            t.start()
+        time.sleep(warmup_s)  # let the publication backlog form
+        heal_c = [0]
+        t0 = time.perf_counter()
+        fetch_loop("heal", [t0 + secs], heal_c)
+        wall = time.perf_counter() - t0
+        pub_stop[0] = 0.0  # release the publication workers
+        for t in pubs:
+            t.join(timeout=120)
+        contended = heal_c[0] / 1e6 / max(wall, 1e-9)
+        m1 = transport.metrics()
+        w = transport.QOS_WEIGHTS
+        out.update({
+            "heal_solo_mb_s": solo,
+            "heal_contended_mb_s": contended,
+            "heal_contended_share": contended / max(solo, 1e-9),
+            "unweighted_share_floor": 1.0 / (1 + pub_streams),
+            "qos_heal_weight_share": (
+                w[transport.QoS.HEAL]
+                / (w[transport.QoS.HEAL] + w[transport.QoS.PUBLICATION])),
+            "pub_agg_mb_s": (sum(pc[0] for pc in pub_counts) / 1e6
+                             / max(wall + warmup_s, 1e-9)),
+            "qos_waits_delta": (m1["transport_qos_waits_total"]
+                                - m0["transport_qos_waits_total"]),
+        })
+    finally:
+        srv.shutdown()
+        srv.server_close()
+    return out
+
+
 # --------------------------------------------------------------- scenario 6
 
 # ------------------------------------------------------------ scenario 9
@@ -2555,8 +2680,11 @@ def main() -> None:
 
     # Recovery-ladder A/B (docs/design/memory_tier.md): cold replacement
     # healing from a peer's RAM tier over the NIC vs the rate-capped
-    # disk-only rung. Gate: ram_speedup >= 2.0.
-    rt = bench_recovery_tiers()
+    # disk-only rung. Gate: ram_speedup >= 2.0. Both server cores run
+    # (threaded legacy vs async substrate); the headline fields carry
+    # the async leg — the shipping configuration — and the threaded
+    # leg rides along for the cut-over comparison.
+    rt_thr, rt = _ab_server_cores(bench_recovery_tiers)
     _emit({"metric": "recovery_tiers_ab",
            "payload_mbytes": round(rt["payload_mbytes"], 1),
            "disk_cap_mb_s": rt["disk_cap_mb_s"],
@@ -2566,7 +2694,10 @@ def main() -> None:
            "disk_mb_s": round(rt["disk_mb_s"], 1),
            "ram_mb_s": round(rt["ram_mb_s"], 1),
            "ram_speedup": round(rt["ram_speedup"], 2),
-           "bitwise_identical": rt["bitwise_identical"]})
+           "bitwise_identical": rt["bitwise_identical"],
+           "threaded_ram_mb_s": round(rt_thr["ram_mb_s"], 1),
+           "async_over_threaded_ram": round(
+               rt["ram_mb_s"] / max(rt_thr["ram_mb_s"], 1e-9), 3)})
 
     # Control-plane scale (docs/design/control_plane.md): quorum latency
     # vs N simulated manager groups with the membership-unchanged fast
@@ -2731,8 +2862,11 @@ def main() -> None:
     # Weight-distribution tier (docs/design/serving.md): publish-to-
     # visible latency for a long-polling fleet, small-touch delta ratio
     # (target: ~changed-leaves/total, here 1/12), and the uplink-capped
-    # fan-out capacity A/B (relay tier target: >= 4x direct).
-    pf = bench_publish_fanout()
+    # fan-out capacity A/B (relay tier target: >= 4x direct). Both
+    # server cores run; headline fields carry the async-substrate leg,
+    # with the threaded leg's aggregate throughputs alongside for the
+    # cut-over comparison (async must hold or beat threaded).
+    pf_thr, pf = _ab_server_cores(bench_publish_fanout)
     _emit({"metric": "publish_fanout",
            "payload_mbytes": round(pf["payload_mbytes"], 2),
            "subscribers": pf["subscribers"], "relays": pf["relays"],
@@ -2748,7 +2882,36 @@ def main() -> None:
                round(pf["fanout_capacity_ratio"], 2),
            "vs_capacity_target": round(
                pf["fanout_capacity_ratio"]
-               / pf["capacity_target_ratio"], 3)})
+               / pf["capacity_target_ratio"], 3),
+           "threaded_direct_agg_mb_s": round(
+               pf_thr["direct_agg_mb_s"], 2),
+           "threaded_relay_agg_mb_s": round(
+               pf_thr["relay_agg_mb_s"], 2),
+           "async_over_threaded_direct": round(
+               pf["direct_agg_mb_s"]
+               / max(pf_thr["direct_agg_mb_s"], 1e-9), 3),
+           "async_over_threaded_relay": round(
+               pf["relay_agg_mb_s"]
+               / max(pf_thr["relay_agg_mb_s"], 1e-9), 3)})
+
+    # Heal-vs-publish contention on the shared substrate (ISSUE 17): a
+    # saturating publication leg must not starve the heal class — the
+    # DRR weights (heal 4 : publication 2) hold the contended heal
+    # share far above the 1/(1+pub_streams) unweighted floor.
+    qc = bench_qos_contention()
+    _emit({"metric": "qos_contention",
+           "payload_mbytes": round(qc["payload_mbytes"], 1),
+           "pub_streams": qc["pub_streams"],
+           "window_s": qc["window_s"],
+           "heal_solo_mb_s": round(qc["heal_solo_mb_s"], 1),
+           "heal_contended_mb_s": round(qc["heal_contended_mb_s"], 1),
+           "heal_contended_share": round(qc["heal_contended_share"], 3),
+           "unweighted_share_floor": round(
+               qc["unweighted_share_floor"], 3),
+           "qos_heal_weight_share": round(
+               qc["qos_heal_weight_share"], 3),
+           "pub_agg_mb_s": round(qc["pub_agg_mb_s"], 1),
+           "qos_waits_delta": qc["qos_waits_delta"]})
 
     # Headline (stdout, exactly one line): FT efficiency vs the 0.90
     # north-star bar (BASELINE.json; the reference publishes no numbers).

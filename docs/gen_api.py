@@ -31,6 +31,8 @@ MODULES = [
     ("torchft_tpu.communicator", "Resizable cross-group communicators"),
     ("torchft_tpu.backends.host", "Elastic host TCP ring backend"),
     ("torchft_tpu.backends.mesh", "On-device full-membership backend"),
+    ("torchft_tpu.transport", "Shared byte-path substrate (pooled "
+                              "ranged fetch, async QoS server core)"),
     ("torchft_tpu.checkpointing", "Live peer-to-peer healing transfer"),
     ("torchft_tpu.checkpoint_io", "Durable checkpoint save/load"),
     ("torchft_tpu.ram_ckpt", "RAM checkpoint tier + async demotion"),

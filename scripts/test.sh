@@ -271,6 +271,26 @@ if [[ "${1:-}" == "cold-start" ]]; then
     exit 0
 fi
 
+# Substrate tier: the shared transport plane's focused gate
+# (docs/design/transport_substrate.md) — pooled ranged fetch client
+# (reuse, redial-on-stale), the one ranged/bearer server core
+# (200/206/416, 401, sendfile path), chunk_spans == shard_bounds
+# geometry, the retry classification table, QoS weighted fairness under
+# contention, and the chaos serve:/heal: channels injected at the
+# substrate seam. Tier-1 and native-free; run this tier on
+# transport/checkpointing/serving/ram_ckpt changes. Note the heal-soak
+# and serve-churn nightly rounds now also ride the substrate: both
+# tiers' byte paths (striped heal, publication fetch) are hosted by
+# torchft_tpu/transport.py, so their chaos soaks are the substrate's
+# endurance gate.
+if [[ "${1:-}" == "substrate" ]]; then
+    stage substrate env JAX_PLATFORMS=cpu \
+        python -m pytest tests/test_transport_substrate.py -q \
+        -m "substrate and not slow"
+    echo "== total: ${SECONDS}s"
+    exit 0
+fi
+
 # Heal-soak tier: seeded chaos soak of repeated heals with donor churn —
 # every round the primary donor is killed mid-stream while resets/short
 # reads pepper the heal channel; each heal must complete bitwise-

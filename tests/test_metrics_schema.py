@@ -97,6 +97,17 @@ DOCUMENTED_KEYS = frozenset([
     # is armed (see test_ram_tier_merges_keys)
     "ram_ckpt_heals_total", "ram_replicate_skipped",
     "ram_replicate_errors_total", "ram_replica_collapses_total",
+    # transport substrate (docs/design/transport_substrate.md):
+    # per-QoS-class byte volume, scheduler waits (grants that queued
+    # behind another class), async-core connection/request totals, and
+    # the sendfile fast-path volume — merged unconditionally (the
+    # substrate is process-wide, like the jit-cache stats)
+    "transport_qos_ring_bytes_total",
+    "transport_qos_heal_bytes_total",
+    "transport_qos_publication_bytes_total",
+    "transport_qos_demotion_bytes_total",
+    "transport_qos_waits_total", "transport_conns_total",
+    "transport_requests_total", "transport_sendfile_bytes_total",
 ])
 
 # Merged into metrics() only while the RAM tier is armed

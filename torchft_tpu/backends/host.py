@@ -35,7 +35,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 import jax
 
-from torchft_tpu import chaos
+from torchft_tpu import chaos, transport
 from torchft_tpu._native import StoreClient
 from torchft_tpu.communicator import (Communicator, CommunicatorError,
                                       Int8Wire, shard_bounds)
@@ -49,6 +49,10 @@ logger: logging.Logger = logging.getLogger(__name__)
 
 def _send_all(sock: socket.socket, data: bytes | memoryview) -> None:
     sock.sendall(data)
+    # Ring-class byte accounting on the shared transport substrate:
+    # RING never rides HTTP, so its QoS slice is counted here at the
+    # one send site every ring/star byte passes through.
+    transport.note_ring_bytes(len(data))
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytearray:
@@ -464,6 +468,7 @@ class HostCommunicator(Communicator):
                                          timeout=self._timeout)
             try:
                 s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                transport.mark_socket(s, transport.QoS.RING)
                 s.settimeout(self._timeout)
                 # Identify ourselves so the acceptor can reject stale
                 # dialers...
@@ -510,6 +515,7 @@ class HostCommunicator(Communicator):
                     cand.settimeout(self._timeout)
                     cand.setsockopt(socket.IPPROTO_TCP,
                                     socket.TCP_NODELAY, 1)
+                    transport.mark_socket(cand, transport.QoS.RING)
                     key, peer_pos = struct.unpack(
                         "<qq", bytes(_recv_exact(cand, 16)))
                     if key != hs_key or peer_pos != (
@@ -628,6 +634,7 @@ class HostCommunicator(Communicator):
                 try:
                     s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY,
                                  1)
+                    transport.mark_socket(s, transport.QoS.RING)
                     s.settimeout(self._timeout)
                     _send_all(s, struct.pack("<qq", hs, rank))
                     ack = struct.unpack(
@@ -677,6 +684,7 @@ class HostCommunicator(Communicator):
                     cand.settimeout(self._timeout)
                     cand.setsockopt(socket.IPPROTO_TCP,
                                     socket.TCP_NODELAY, 1)
+                    transport.mark_socket(cand, transport.QoS.RING)
                     key, peer = struct.unpack(
                         "<qq", bytes(_recv_exact(cand, 16)))
                     if key != hs or peer not in expected:

@@ -57,7 +57,7 @@ from concurrent.futures import Future
 from concurrent.futures import TimeoutError as FutureTimeout
 from typing import Any, Callable, Dict, Optional, Tuple
 
-from torchft_tpu import chaos
+from torchft_tpu import chaos, transport
 from torchft_tpu.retry import RetryPolicy, RetryStats, call_with_retry
 from torchft_tpu.serialization import (
     DEFAULT_BATCH_BYTES,
@@ -104,6 +104,13 @@ class CheckpointStallError(RuntimeError):
     timeout (``TORCHFT_CKPT_STALL_SEC``) — a wedged NFS mount or dead
     disk. The write is abandoned so ``save_async``/``shutdown`` return
     instead of hanging forever."""
+
+
+# Corruption is fatal in the shared transport classification table too:
+# a byte path that surfaces it (a 422-rejected RAM push, a torn durable
+# image fetched over HTTP) must never burn retry budget re-sending the
+# same provably-bad bytes.
+transport.register_fatal(CheckpointCorruptError)
 
 
 def _io_transient(exc: BaseException) -> bool:

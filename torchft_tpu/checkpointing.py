@@ -38,28 +38,22 @@ TPU-native differences from the reference:
 
 from __future__ import annotations
 
-import http.client
-import io
 import json
 import logging
 import re
-import socket
 import threading
 import time
 import urllib.error
 import urllib.parse
-import urllib.request
 import zlib
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable, Dict, List, Optional, Tuple, TypeVar
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from torchft_tpu import chaos
-from torchft_tpu.retry import RetryError, RetryPolicy, RetryStats, \
-    is_transient
+from torchft_tpu import chaos, transport
+from torchft_tpu.retry import RetryError, RetryPolicy, RetryStats
 from torchft_tpu.tracing import maybe_span
 from torchft_tpu.utils import advertise_host
 from torchft_tpu.serialization import (
@@ -68,7 +62,6 @@ from torchft_tpu.serialization import (
     _resolve_dtype,
     balanced_ranges,
     device_put_like,
-    iter_pytree_chunks,
     load_pytree_from,
     manifest_from,
     plan_pytree,
@@ -97,121 +90,22 @@ class LeafDigestError(ValueError):
     fix (bounded per leaf by ``MAX_LEAF_REFETCHES``)."""
 
 
-_RANGE_RE = re.compile(r"bytes=(\d+)-(\d+)?$")
 # Request-side Content-Range of a RAM-tier replication PUT:
 # ``bytes <start>-<end>/<total>`` (no wildcard forms — a pusher always
 # knows its image size).
 _CONTENT_RANGE_RE = re.compile(r"bytes (\d+)-(\d+)/(\d+)$")
 
 
-def _check_bearer_auth(handler: Any, token: Optional[str]) -> bool:
-    """Shared bearer-token gate of the checkpoint and publication
-    servers; sends the 401 itself, returns True when authorized.
-
-    Constant-time compare: plain ``!=`` short-circuits and leaks the
-    token prefix via response timing. Compare as bytes —
-    ``compare_digest`` raises TypeError on non-ASCII str, which an
-    attacker could trigger with a latin-1 header to crash the handler
-    instead of getting a 401. ``got`` came from http.server's latin-1
-    header decode, so latin-1 re-encode recovers the client's raw
-    bytes; ``want`` encodes UTF-8, the byte form a legitimate client
-    sends for a non-ASCII token."""
-    if token is None:
-        return True
-    import hmac
-    got = handler.headers.get("Authorization", "")
-    want = f"Bearer {token}"
-    if not hmac.compare_digest(got.encode("latin-1", "replace"),
-                               want.encode("utf-8")):
-        handler.send_error(401, "missing/bad bearer token")
-        return False
-    return True
-
-
-def _serve_ranged_body(handler: Any, state: Any, plan: Any,
-                       send_timeout_sec: float) -> int:
-    """Stream one serialized snapshot's bytes on ``handler`` with HTTP
-    Range semantics (200 full / 206 partial + Content-Range / 416) —
-    the ONE body-serving implementation shared by the checkpoint heal
-    endpoint and the publication tier, so Range behavior cannot drift
-    between them. Total length is known from the plan before any
-    device data is fetched (Content-Length up front), chunks are
-    zero-copy memoryviews, and socket-write backpressure paces the
-    fetches. Returns bytes written (0 for a 416)."""
-    total = int(plan[1])
-    span = _negotiate_range(handler, total)
-    if span is None:
-        return 0
-    status, start, end = span
-    handler.send_response(status)
-    handler.send_header("Content-Type", "application/octet-stream")
-    handler.send_header("Content-Length", str(end - start))
-    if status == 206:
-        handler.send_header("Content-Range",
-                            f"bytes {start}-{end - 1}/{total}")
-    handler.end_headers()
-    handler.connection.settimeout(send_timeout_sec)
-    sent = 0
-    for chunk in iter_pytree_chunks(state, plan=plan, start=start,
-                                    end=end):
-        handler.wfile.write(chunk)
-        sent += len(chunk)
-    return sent
-
-
-def _negotiate_range(handler: Any, total: int
-                     ) -> Optional[Tuple[int, int, int]]:
-    """The ONE Range-header negotiation (shared by the live-plan body
-    server above and the RAM-tier image server): parse the request's
-    Range against ``total``, send the 416 itself (returning None), else
-    return ``(status, start, end)`` — 206 for a partial span, 200 for
-    the full stream (including an unparseable Range, which HTTP permits
-    ignoring)."""
-    start, end = 0, total
-    status = 200
-    rng = handler.headers.get("Range")
-    if rng:
-        m = _RANGE_RE.match(rng.strip())
-        if m:
-            start = int(m.group(1))
-            if m.group(2) is not None:
-                end = min(int(m.group(2)) + 1, total)
-            if start >= total or start >= end:
-                handler.send_response(416)
-                handler.send_header("Content-Range", f"bytes */{total}")
-                handler.send_header("Content-Length", "0")
-                handler.end_headers()
-                return None
-            status = 206
-    return status, start, end
-
-
-def _serve_ranged_bytes(handler: Any, view: memoryview,
-                        send_timeout_sec: float) -> int:
-    """Range-serve an immutable in-memory byte region (the RAM
-    checkpoint tier's payload serving — docs/design/memory_tier.md).
-    Same negotiation as :func:`_serve_ranged_body`; chunked memoryview
-    writes, so a healer's backpressure paces us without a full-copy."""
-    total = len(view)
-    span = _negotiate_range(handler, total)
-    if span is None:
-        return 0
-    status, start, end = span
-    handler.send_response(status)
-    handler.send_header("Content-Type", "application/octet-stream")
-    handler.send_header("Content-Length", str(end - start))
-    if status == 206:
-        handler.send_header("Content-Range",
-                            f"bytes {start}-{end - 1}/{total}")
-    handler.end_headers()
-    handler.connection.settimeout(send_timeout_sec)
-    sent = 0
-    step = 1 << 20
-    for off in range(start, end, step):
-        chunk = view[off:min(off + step, end)]
-        handler.wfile.write(chunk)
-        sent += len(chunk)
-    return sent
+# The server-body, Range-negotiation, auth, pooling, and byte-counting
+# machinery now lives in the transport substrate
+# (:mod:`torchft_tpu.transport`) — ONE implementation shared with the
+# publication tier, the RAM tier, and the parameter server. The
+# underscore aliases keep this module's historical surface (tests and
+# serving.py import them from here).
+_check_bearer_auth = transport.check_bearer_auth
+_negotiate_range = transport.negotiate_range
+_serve_ranged_body = transport.serve_ranged_body
+_serve_ranged_bytes = transport.serve_ranged_bytes
 
 
 def build_manifest(plan: Any, step: int) -> dict:
@@ -231,151 +125,10 @@ def build_manifest(plan: Any, step: int) -> dict:
     }
 
 
-def _open_url(url: str, stall: float, auth_token: Optional[str],
-              headers: Optional[Dict[str, str]] = None,
-              pool: Optional["_ConnectionPool"] = None) -> Any:
-    """Dial a checkpoint URL. ``stall`` becomes the socket-op timeout:
-    it bounds how long ANY read may sit with zero bytes arriving — the
-    stall watchdog — rather than the whole transfer's wall clock.
-    ``pool``, when given, serves the request over a persistent
-    per-donor connection instead of a fresh TCP dial per request."""
-    if pool is not None:
-        return pool.request(url, stall, auth_token, headers=headers)
-    req = urllib.request.Request(url)
-    if auth_token is not None:
-        req.add_header("Authorization", f"Bearer {auth_token}")
-    for k, v in (headers or {}).items():
-        req.add_header(k, v)
-    return urllib.request.urlopen(req, timeout=stall)
-
-
-class _PooledResponse:
-    """Response off a pooled connection: returns the connection to its
-    pool on close iff the body was consumed to completion
-    (``http.client`` marks the response closed at EOF) and the server
-    did not ask to close — anything else (exception, partial read,
-    ``Connection: close``) drops the connection so a later request can
-    never read a previous response's tail bytes."""
-
-    def __init__(self, resp: Any, conn: Any, pool: "_ConnectionPool",
-                 key: str) -> None:
-        self._resp = resp
-        self._conn = conn
-        self._pool = pool
-        self._key = key
-
-    def __getattr__(self, name: str) -> Any:
-        return getattr(self._resp, name)
-
-    def getcode(self) -> int:
-        return self._resp.status
-
-    def read(self, n: int = -1) -> bytes:
-        return self._resp.read(n)
-
-    def readinto(self, b) -> int:
-        return self._resp.readinto(b)
-
-    def close(self) -> None:
-        conn, self._conn = self._conn, None
-        if conn is None:
-            return
-        resp = self._resp
-        clean = resp.isclosed() and not resp.will_close
-        try:
-            resp.close()
-        except Exception:  # noqa: BLE001 — a dirty close just drops conn
-            clean = False
-        if clean:
-            self._pool._put_idle(self._key, conn)
-        else:
-            conn.close()
-
-    def __enter__(self) -> "_PooledResponse":
-        return self
-
-    def __exit__(self, *exc: Any) -> None:
-        self.close()
-
-
-class _ConnectionPool:
-    """One persistent HTTP connection per ``host:port``, reused across
-    the Range/manifest requests of an attempt wave (and across a weight
-    subscriber's polling lifetime). Every reuse is a TCP dial avoided —
-    counted in ``redials_avoided``, surfaced as ``heal_redials_avoided``
-    in ``Manager.metrics()``. Only *idle* connections live in the pool:
-    a request pops its donor's connection (or dials fresh) and the
-    response returns it on close only when the body was read clean, so
-    the striped fetch's one-thread-per-donor concurrency never shares a
-    connection — the dict itself is lock-guarded."""
-
-    def __init__(self) -> None:
-        self._idle: Dict[str, Any] = {}
-        self._lock = threading.Lock()
-        self.redials = 0
-        self.redials_avoided = 0
-
-    def _put_idle(self, key: str, conn: Any) -> None:
-        with self._lock:
-            if key not in self._idle:
-                self._idle[key] = conn
-                return
-        conn.close()
-
-    def request(self, url: str, stall: float, auth_token: Optional[str],
-                headers: Optional[Dict[str, str]] = None) -> Any:
-        u = urllib.parse.urlsplit(url)
-        key = u.netloc
-        path = (u.path or "/") + (f"?{u.query}" if u.query else "")
-        hdrs = dict(headers or {})
-        if auth_token is not None:
-            hdrs["Authorization"] = f"Bearer {auth_token}"
-        with self._lock:
-            conn = self._idle.pop(key, None)
-        reused = conn is not None
-        resp = None
-        for attempt in (0, 1):
-            if conn is None:
-                conn = http.client.HTTPConnection(u.hostname, u.port,
-                                                  timeout=stall)
-            try:
-                conn.timeout = stall
-                if conn.sock is not None:
-                    conn.sock.settimeout(stall)
-                conn.request("GET", path, headers=hdrs)
-                resp = conn.getresponse()
-                break
-            except Exception:
-                conn.close()
-                conn = None
-                # A kept-alive connection the server idle-closed between
-                # waves looks like a send/recv failure on the FIRST use
-                # after reuse: retry once on a fresh dial. Fresh-dial
-                # failures propagate — they are the donor's problem, and
-                # the caller's retry/failover discipline owns them.
-                if not reused or attempt:
-                    raise
-                reused = False
-        with self._lock:
-            if reused:
-                self.redials_avoided += 1
-            else:
-                self.redials += 1
-        if resp.status >= 400:
-            # Error responses carry Connection: close (send_error);
-            # capture the bounded body for the HTTPError, drop the conn.
-            body = resp.read(65536)
-            conn.close()
-            raise urllib.error.HTTPError(url, resp.status, resp.reason,
-                                         resp.headers, io.BytesIO(body))
-        return _PooledResponse(resp, conn, self, key)
-
-    def close(self) -> None:
-        with self._lock:
-            conns = list(self._idle.values())
-            self._idle.clear()
-        for c in conns:
-            c.close()
+_open_url = transport.open_url
+_PooledResponse = transport.PooledResponse
+_ConnectionPool = transport.ConnectionPool
+_CountingReader = transport.CountingReader
 
 
 def _heal_endpoint(addr: str) -> str:
@@ -386,64 +139,27 @@ def _heal_endpoint(addr: str) -> str:
     return f"heal:{netloc}" if netloc else "heal"
 
 
+# Heal-domain entries in the shared classification table
+# (:func:`torchft_tpu.transport.classify`): in-transit digest
+# mismatches re-fetch (transient); a donor whose own copy is corrupt
+# does not (fatal — failover can help, retrying cannot). The 503
+# serve-window / shutting-down HTTP rule lives in the table itself.
+transport.register_fatal(HealCorruptError)
+transport.register_transient(LeafDigestError)
+
+
 def _heal_transient(exc: BaseException) -> bool:
-    """Heal-specific retryability: 503 "serve window closed (commit)" is
-    transient BY CONSTRUCTION — the donor reopens the window at its next
-    step start — while step/auth refusals (400/401) and shutdown stay
-    fatal. In-transit digest mismatches re-fetch; persistent ones
-    (:class:`HealCorruptError`) don't. Everything else defers to the
-    shared :func:`torchft_tpu.retry.is_transient` classification."""
-    if isinstance(exc, HealCorruptError):
-        return False
-    if isinstance(exc, LeafDigestError):
-        return True
-    if isinstance(exc, urllib.error.HTTPError):
-        reason = str(getattr(exc, "reason", "") or exc).lower()
-        return exc.code == 503 and "shutting down" not in reason
-    return is_transient(exc)
+    """Heal retryability — a delegating alias of THE shared
+    classification table (:func:`torchft_tpu.transport.classify`): 503
+    "serve window closed (commit)" is transient BY CONSTRUCTION — the
+    donor reopens the window at its next step start — while step/auth
+    refusals (400/401) and shutdown stay fatal; in-transit digest
+    mismatches re-fetch, persistent ones (:class:`HealCorruptError`)
+    don't."""
+    return transport.classify(exc)
 
 
-def _looks_donor_dead(exc: BaseException) -> bool:
-    """Connection-refused means the donor's server socket is GONE (a
-    dead process / freed port) — unlike the resets and timeouts a
-    live-but-flaky donor produces — so it short-circuits straight to
-    donor failover instead of burning the retry budget against a
-    corpse."""
-    e: Optional[BaseException] = exc
-    for _ in range(5):
-        if e is None:
-            break
-        if isinstance(e, ConnectionRefusedError):
-            return True
-        reason = getattr(e, "reason", None)
-        e = reason if isinstance(reason, BaseException) else e.__cause__
-    return "connection refused" in str(exc).lower()
-
-
-class _CountingReader:
-    """Read-through wrapper counting bytes actually delivered to the
-    healer — the truthful transfer-volume source (the donor's
-    Content-Length claim is 0 when absent and a lie under
-    truncation)."""
-
-    def __init__(self, raw: Any, counter: list) -> None:
-        self._raw = raw
-        self._counter = counter
-
-    def read(self, n: int = -1) -> bytes:
-        data = self._raw.read(n)
-        self._counter[0] += len(data)
-        return data
-
-    def readinto(self, b) -> int:
-        if hasattr(self._raw, "readinto"):
-            n = self._raw.readinto(b)
-        else:
-            data = self._raw.read(len(b))
-            n = len(data)
-            b[:n] = data
-        self._counter[0] += n or 0
-        return n
+_looks_donor_dead = transport.looks_peer_dead
 
 
 class _HealSession:
@@ -611,14 +327,6 @@ class _HealSession:
         return jax.tree_util.tree_unflatten(self.treedef, leaves)
 
 
-class _CheckpointHTTPServer(ThreadingHTTPServer):
-    # Large accept backlog: after a failure many healers may hit the same
-    # primary at once (reference /root/reference/torchft/http.py:5-7).
-    request_queue_size = 1024
-    daemon_threads = True
-    address_family = socket.AF_INET
-
-
 # One jitted call copying a whole list of arrays: per-leaf EAGER copies
 # would pay a dispatch (and first-time compile) round trip per leaf —
 # seconds through a tunneled device — while one compiled program runs at
@@ -711,162 +419,12 @@ class CheckpointServer:
         # and pre-verified — like /publish, never step-gated.
         self._ram_store: Optional[Any] = None
 
-        ckpt_server = self
-
-        class Handler(BaseHTTPRequestHandler):
-            # Keep-alive: healers and weight subscribers reuse one
-            # connection across Range waves (_ConnectionPool). Every
-            # response path sends Content-Length, which HTTP/1.1
-            # persistence requires.
-            protocol_version = "HTTP/1.1"
-
-            def log_message(self, fmt, *args):  # quiet
-                logger.debug("checkpoint http: " + fmt, *args)
-
-            def do_GET(self) -> None:
-                if not _check_bearer_auth(self, ckpt_server._auth_token):
-                    return
-                if self.path.split("?", 1)[0].rstrip("/") in (
-                        "/trace.json", "/metrics"):
-                    if ckpt_server._shutdown:
-                        self.close_connection = True
-                        return
-                    ckpt_server._serve_observability(self)
-                    return
-                if self.path.split("?", 1)[0].rstrip("/") == "/publish" \
-                        or self.path.startswith("/publish/"):
-                    if ckpt_server._shutdown:
-                        # Drop kept-alive connections like a dead
-                        # process would: subscribers re-dial and reach
-                        # the restarted server on this port, instead of
-                        # a zombie handler thread serving stale
-                        # generations.
-                        self.close_connection = True
-                        return
-                    pub = ckpt_server._publication
-                    if pub is None:
-                        self.send_error(404, "no publication attached")
-                        return
-                    pub.handle_request(
-                        self, send_timeout_sec=ckpt_server._send_timeout_sec)
-                    return
-                if self.path.startswith("/ramckpt/"):
-                    # RAM-tier images are immutable and pre-verified:
-                    # NOT step-gated by the heal serve window — a
-                    # commit in progress never blocks a replacement
-                    # healing from yesterday's committed image.
-                    if ckpt_server._shutdown:
-                        self.close_connection = True
-                        return
-                    ckpt_server._serve_ram(self)
-                    return
-                prefix = "/checkpoint/"
-                if not self.path.startswith(prefix):
-                    self.send_error(404, "unknown path")
-                    return
-                path = self.path
-                want_manifest = path.endswith(MANIFEST_SUFFIX)
-                if want_manifest:
-                    path = path[:-len(MANIFEST_SUFFIX)]
-                try:
-                    req_step = int(path[len(prefix):])
-                except ValueError:
-                    self.send_error(400, "bad step")
-                    return
-                srv = ckpt_server
-                deadline = time.monotonic() + srv._send_timeout_sec
-                with srv._cond:
-                    # A closed window (commit in progress) reopens at the
-                    # next step start; park briefly rather than bouncing
-                    # the healer (the reference blocks here too, on its
-                    # held lock).
-                    while not srv._allowed and not srv._shutdown:
-                        remaining = deadline - time.monotonic()
-                        if remaining <= 0:
-                            self.send_error(
-                                503, "serve window closed (commit)")
-                            return
-                        srv._cond.wait(timeout=remaining)
-                    if srv._shutdown:
-                        self.send_error(503, "shutting down")
-                        return
-                    if req_step != srv._step:
-                        self.send_error(
-                            400,
-                            f"invalid checkpoint requested: serving "
-                            f"{srv._step} but got {req_step}")
-                        return
-                    if want_manifest and srv._lock_streaming:
-                        # Live lock-streamed state has no immutable
-                        # snapshot to digest; healers fall back to the
-                        # legacy (non-resumable) full-stream fetch.
-                        self.send_error(
-                            404, "manifest unavailable (lock_streaming "
-                            "serves live state)")
-                        return
-                    try:
-                        state, plan = srv._capture_locked()
-                    except Exception as e:  # surface to healer, keep serving
-                        logger.exception("checkpoint state capture failed")
-                        self.send_error(500, str(e))
-                        return
-                    srv._inflight += 1
-                # Stream OUTSIDE the lock: the snapshot is immutable, so a
-                # slow healer never delays the donor's commit. Leaf-by-leaf:
-                # total length is known from the plan before any device data
-                # is fetched, so the response carries Content-Length yet
-                # never holds more than one leaf + one chunk in host RAM;
-                # socket-write backpressure paces the device_get fetches.
-                try:
-                    if want_manifest:
-                        # Digest pass runs outside the serve lock too
-                        # (the snapshot is immutable); computed once per
-                        # snapshot, shared by every healer and attempt.
-                        body = json.dumps(
-                            build_manifest(plan, req_step)).encode()
-                        self.send_response(200)
-                        self.send_header("Content-Type", "application/json")
-                        self.send_header("Content-Length", str(len(body)))
-                        self.end_headers()
-                        self.connection.settimeout(srv._send_timeout_sec)
-                        self.wfile.write(body)
-                        return
-                    # Once the status line is committed, a device_get
-                    # failure mid-stream can only short-close the socket
-                    # (healer sees "truncated"), so log the real cause
-                    # here.
-                    try:
-                        _serve_ranged_body(self, state, plan,
-                                           srv._send_timeout_sec)
-                    except Exception:
-                        logger.exception(
-                            "checkpoint stream failed mid-transfer "
-                            "(healer will see a truncated stream)")
-                        raise
-                finally:
-                    with srv._cond:
-                        srv._inflight -= 1
-                        srv._cond.notify_all()
-
-            def do_PUT(self) -> None:
-                # The RAM tier's push-side replication: ranged writes
-                # of a peer's v2 image against /ramckpt/{step}
-                # (docs/design/memory_tier.md). The assembled image is
-                # digest-verified BEFORE acceptance; a failed scan is a
-                # 422 and nothing is stored.
-                if not _check_bearer_auth(self, ckpt_server._auth_token):
-                    return
-                if ckpt_server._shutdown:
-                    self.close_connection = True
-                    return
-                ckpt_server._accept_ram_push(self)
-
-        self._server = _CheckpointHTTPServer((bind_host, bind_port),
-                                             Handler)
-        self._thread = threading.Thread(
-            target=self._server.serve_forever, daemon=True,
-            name="checkpoint-server")
-        self._thread.start()
+        # Host on the transport substrate's shared server core (async
+        # event loop by default, TORCHFT_ASYNC_SERVER=0 for the legacy
+        # threaded host) — the route body below is the same on either.
+        self._server = transport.serve_http(bind_host, bind_port,
+                                            self._route,
+                                            name="checkpoint-server")
         # A fresh server at this address is a REBIRTH for the chaos kill
         # latches: a churn replacement reusing a dead member's host:port
         # must not inherit the corpse's dead latch (chaos.endpoint_reborn
@@ -875,6 +433,153 @@ class CheckpointServer:
         if netloc:
             chaos.endpoint_reborn(f"heal:{netloc}", f"serve:{netloc}",
                                   f"ram:{netloc}")
+
+    def _route(self, handler: Any) -> None:
+        """One request on the substrate core (GET heal/manifest/
+        publication/RAM/observability, PUT RAM replication). Keep-alive:
+        healers and weight subscribers reuse one connection across Range
+        waves (``transport.ConnectionPool``); every response path sends
+        Content-Length, which HTTP/1.1 persistence requires."""
+        if handler.command == "PUT":
+            self._route_put(handler)
+            return
+        if handler.command != "GET":
+            handler.send_error(501, "Unsupported method "
+                               f"({handler.command!r})")
+            return
+        if not _check_bearer_auth(handler, self._auth_token):
+            return
+        if handler.path.split("?", 1)[0].rstrip("/") in (
+                "/trace.json", "/metrics"):
+            if self._shutdown:
+                handler.close_connection = True
+                return
+            self._serve_observability(handler)
+            return
+        if handler.path.split("?", 1)[0].rstrip("/") == "/publish" \
+                or handler.path.startswith("/publish/"):
+            if self._shutdown:
+                # Drop kept-alive connections like a dead process
+                # would: subscribers re-dial and reach the restarted
+                # server on this port, instead of a zombie handler
+                # serving stale generations.
+                handler.close_connection = True
+                return
+            pub = self._publication
+            if pub is None:
+                handler.send_error(404, "no publication attached")
+                return
+            pub.handle_request(
+                handler, send_timeout_sec=self._send_timeout_sec)
+            return
+        if handler.path.startswith("/ramckpt/"):
+            # RAM-tier images are immutable and pre-verified: NOT
+            # step-gated by the heal serve window — a commit in
+            # progress never blocks a replacement healing from
+            # yesterday's committed image.
+            if self._shutdown:
+                handler.close_connection = True
+                return
+            self._serve_ram(handler)
+            return
+        prefix = "/checkpoint/"
+        if not handler.path.startswith(prefix):
+            handler.send_error(404, "unknown path")
+            return
+        path = handler.path
+        want_manifest = path.endswith(MANIFEST_SUFFIX)
+        if want_manifest:
+            path = path[:-len(MANIFEST_SUFFIX)]
+        try:
+            req_step = int(path[len(prefix):])
+        except ValueError:
+            handler.send_error(400, "bad step")
+            return
+        deadline = time.monotonic() + self._send_timeout_sec
+        with self._cond:
+            # A closed window (commit in progress) reopens at the
+            # next step start; park briefly rather than bouncing
+            # the healer (the reference blocks here too, on its
+            # held lock).
+            while not self._allowed and not self._shutdown:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    handler.send_error(
+                        503, "serve window closed (commit)")
+                    return
+                self._cond.wait(timeout=remaining)
+            if self._shutdown:
+                handler.send_error(503, "shutting down")
+                return
+            if req_step != self._step:
+                handler.send_error(
+                    400,
+                    f"invalid checkpoint requested: serving "
+                    f"{self._step} but got {req_step}")
+                return
+            if want_manifest and self._lock_streaming:
+                # Live lock-streamed state has no immutable snapshot
+                # to digest; healers fall back to the legacy
+                # (non-resumable) full-stream fetch.
+                handler.send_error(
+                    404, "manifest unavailable (lock_streaming "
+                    "serves live state)")
+                return
+            try:
+                state, plan = self._capture_locked()
+            except Exception as e:  # surface to healer, keep serving
+                logger.exception("checkpoint state capture failed")
+                handler.send_error(500, str(e))
+                return
+            self._inflight += 1
+        # Stream OUTSIDE the lock: the snapshot is immutable, so a
+        # slow healer never delays the donor's commit. Leaf-by-leaf:
+        # total length is known from the plan before any device data
+        # is fetched, so the response carries Content-Length yet
+        # never holds more than one leaf + one chunk in host RAM;
+        # socket-write backpressure paces the device_get fetches.
+        try:
+            if want_manifest:
+                # Digest pass runs outside the serve lock too (the
+                # snapshot is immutable); computed once per snapshot,
+                # shared by every healer and attempt.
+                body = json.dumps(
+                    build_manifest(plan, req_step)).encode()
+                handler.send_response(200)
+                handler.send_header("Content-Type", "application/json")
+                handler.send_header("Content-Length", str(len(body)))
+                handler.end_headers()
+                handler.connection.settimeout(self._send_timeout_sec)
+                handler.wfile.write(body)
+                return
+            # Once the status line is committed, a device_get failure
+            # mid-stream can only short-close the socket (healer sees
+            # "truncated"), so log the real cause here.
+            try:
+                _serve_ranged_body(handler, state, plan,
+                                   self._send_timeout_sec)
+            except Exception:
+                logger.exception(
+                    "checkpoint stream failed mid-transfer "
+                    "(healer will see a truncated stream)")
+                raise
+        finally:
+            with self._cond:
+                self._inflight -= 1
+                self._cond.notify_all()
+
+    def _route_put(self, handler: Any) -> None:
+        # The RAM tier's push-side replication: ranged writes of a
+        # peer's v2 image against /ramckpt/{step}
+        # (docs/design/memory_tier.md). The assembled image is
+        # digest-verified BEFORE acceptance; a failed scan is a 422
+        # and nothing is stored.
+        if not _check_bearer_auth(handler, self._auth_token):
+            return
+        if self._shutdown:
+            handler.close_connection = True
+            return
+        self._accept_ram_push(handler)
 
     def _capture_locked(self) -> Tuple[Any, Any]:
         """State + plan to stream for the current step. Requires _cond held.

@@ -55,6 +55,7 @@ import jax.numpy as jnp
 
 from torchft_tpu import policy as policy_mod
 from torchft_tpu import tracing as tracing_mod
+from torchft_tpu import transport
 from torchft_tpu._native import ManagerClient, ManagerServer, Store, StoreClient
 from torchft_tpu.checkpointing import CheckpointServer
 from torchft_tpu.communicator import (INT8_SEG_ELEMS, Communicator,
@@ -3741,6 +3742,12 @@ class Manager:
             out.update(self._ram_store.metrics())
         if self._ram_replicator is not None:
             out.update(self._ram_replicator.metrics())
+        # Transport-substrate counters (process-wide, like the jit-cache
+        # stats above): per-QoS-class byte volume, scheduler waits, and
+        # the async core's connection/request/sendfile totals — the
+        # observables the shared byte plane's fairness claims are
+        # checked against (docs/design/transport_substrate.md).
+        out.update(transport.metrics())
         return out
 
     def metrics_info(self) -> Dict[str, str]:

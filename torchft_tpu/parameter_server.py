@@ -22,24 +22,17 @@ from __future__ import annotations
 
 import json
 import logging
-import socket
 import threading
 import time
 import uuid
 from abc import ABC, abstractmethod
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict
-from urllib.request import urlopen
 
+from torchft_tpu import transport
 from torchft_tpu.communicator import Communicator
 from torchft_tpu.utils import advertise_host
 
 logger: logging.Logger = logging.getLogger(__name__)
-
-
-class _PSServer(ThreadingHTTPServer):
-    daemon_threads = True
-    address_family = socket.AF_INET
 
 
 class ParameterServer(ABC):
@@ -88,47 +81,8 @@ class ParameterServer(ABC):
         self._sessions_total = 0
         self._sessions_reaped = 0
         self._shutdown_ev = threading.Event()
-        ps = self
-
-        class Handler(BaseHTTPRequestHandler):
-            def log_message(self, fmt, *args):
-                logger.debug("ps http: " + fmt, *args)
-
-            def do_GET(self) -> None:
-                if self.path == "/status.json":
-                    body = json.dumps(ps.status()).encode()
-                    self.send_response(200)
-                    self.send_header("Content-Type", "application/json")
-                    self.send_header("Content-Length", str(len(body)))
-                    self.end_headers()
-                    self.wfile.write(body)
-                    return
-                if self.path != "/new_session":
-                    self.send_error(404)
-                    return
-                session_id = str(uuid.uuid4())
-                body = json.dumps({
-                    "session_id": session_id,
-                    "store_addr": ps._store_addr,
-                }).encode()
-                self.send_response(200)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
-                # Hijack this handler thread for the session (reference
-                # parameter_server.py:96-97): the per-session world is
-                # (server=0, client=1).
-                try:
-                    ps._handle_session(session_id)
-                except Exception:  # noqa: BLE001  session dies alone
-                    logger.exception("session %s failed", session_id)
-
-        self._server = _PSServer(("0.0.0.0", port), Handler)
-        self._thread = threading.Thread(
-            target=self._server.serve_forever, daemon=True,
-            name="parameter-server")
-        self._thread.start()
+        self._server = transport.serve_http(
+            "0.0.0.0", port, self._route, name="parameter-server")
         self._reaper = threading.Thread(
             target=self._reap_loop, daemon=True,
             name="parameter-server-reaper")
@@ -142,6 +96,41 @@ class ParameterServer(ABC):
         from torchft_tpu._native import Store
 
         return Store()
+
+    def _route(self, handler: Any) -> None:
+        """One ``/status.json`` or ``/new_session`` GET on the shared
+        transport core. ``/new_session`` hijacks its worker thread for
+        the session body (reference parameter_server.py:96-97) — the
+        per-session world is (server=0, client=1); the substrate's
+        worker pool replaces the old dedicated thread-per-connection
+        spelling."""
+        if handler.command != "GET":
+            handler.send_error(501, f"Unsupported method ({handler.command!r})")
+            return
+        if handler.path == "/status.json":
+            self._send_json(handler, self.status())
+            return
+        if handler.path != "/new_session":
+            handler.send_error(404)
+            return
+        session_id = str(uuid.uuid4())
+        self._send_json(handler, {
+            "session_id": session_id,
+            "store_addr": self._store_addr,
+        })
+        try:
+            self._handle_session(session_id)
+        except Exception:  # noqa: BLE001  session dies alone
+            logger.exception("session %s failed", session_id)
+
+    @staticmethod
+    def _send_json(handler: Any, obj: Dict[str, Any]) -> None:
+        body = json.dumps(obj).encode()
+        handler.send_response(200)
+        handler.send_header("Content-Type", "application/json")
+        handler.send_header("Content-Length", str(len(body)))
+        handler.end_headers()
+        handler.wfile.write(body)
 
     def address(self) -> str:
         port = self._server.server_address[1]
@@ -241,8 +230,7 @@ class ParameterServer(ABC):
         """Open a session: returns a communicator configured as rank 1 of
         the session's 2-member world (reference
         ``parameter_server.py:149-168``)."""
-        with urlopen(address, timeout=timeout_sec) as resp:
-            meta = json.loads(resp.read())
+        meta = transport.fetch_json(address, stall=timeout_sec)
         comm = communicator
         if comm is None:
             # default transport, imported here to avoid a hard dependency

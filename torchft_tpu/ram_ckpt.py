@@ -59,7 +59,7 @@ from concurrent.futures import Future
 from concurrent.futures import TimeoutError as FutureTimeout
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
-from torchft_tpu import chaos
+from torchft_tpu import chaos, transport
 from torchft_tpu.checkpoint_io import (
     CheckpointCorruptError,
     CheckpointStallError,
@@ -405,42 +405,17 @@ def push_image(base_url: str, image: RamImage,
     netloc = u.netloc
     path = u.path.rstrip("/") + f"/ramckpt/{image.step}"
     scope = chaos_scope or f"ram:{netloc}"
-    data = image.data
-    total = len(data)
-    conn = http.client.HTTPConnection(u.hostname, u.port,
-                                      timeout=timeout_sec)
-    pushed = 0
     try:
-        for start in range(0, total, chunk_bytes):
-            chaos.ram_fault(scope, op="push")
-            end = min(start + chunk_bytes, total)
-            headers = {
-                "Content-Range": f"bytes {start}-{end - 1}/{total}",
-                "Content-Type": "application/octet-stream",
-            }
-            if auth_token is not None:
-                headers["Authorization"] = f"Bearer {auth_token}"
-            conn.request("PUT", path, body=data[start:end],
-                         headers=headers)
-            resp = conn.getresponse()
-            body = resp.read()
-            if resp.status == 422:
-                raise CheckpointCorruptError(
-                    f"peer {netloc} rejected step {image.step} image: "
-                    f"{body[:200]!r}")
-            if resp.status not in (200, 201):
-                raise OSError(
-                    f"peer {netloc} PUT {path} failed: "
-                    f"{resp.status} {body[:200]!r}")
-            pushed += end - start
-            if progress is not None:
-                progress(end - start)  # per-chunk delta (progress clock)
-    finally:
-        try:
-            conn.close()
-        except OSError:
-            pass
-    return pushed
+        return transport.push_ranged(
+            base_url, path, memoryview(image.data),
+            auth_token=auth_token, timeout_sec=timeout_sec,
+            chunk_bytes=chunk_bytes, qos=transport.QoS.DEMOTION,
+            fault=lambda: chaos.ram_fault(scope, op="push"),
+            progress=progress)
+    except transport.PushRejectedError as e:
+        raise CheckpointCorruptError(
+            f"peer {netloc} rejected step {image.step} image: "
+            f"{e.body[:200]!r}") from None
 
 
 def peer_steps(base_url: str, auth_token: Optional[str] = None,
@@ -449,15 +424,10 @@ def peer_steps(base_url: str, auth_token: Optional[str] = None,
     (``GET {base}/ramckpt/steps``), ascending. Empty on ANY failure —
     probing is best-effort rung selection, never a correctness gate
     (the disk rung covers a wrong answer)."""
-    import urllib.request
-
-    req = urllib.request.Request(
-        f"{base_url.rstrip('/')}/ramckpt/steps")
-    if auth_token:
-        req.add_header("Authorization", f"Bearer {auth_token}")
     try:
-        with urllib.request.urlopen(req, timeout=timeout_sec) as resp:
-            doc = json.loads(resp.read().decode())
+        doc = transport.fetch_json(
+            f"{base_url.rstrip('/')}/ramckpt/steps",
+            stall=timeout_sec, auth_token=auth_token)
         return sorted(int(s) for s in doc.get("steps", []))
     except Exception:  # noqa: BLE001 — probe failure = empty rung
         return []
@@ -785,9 +755,10 @@ class RamReplicator:
 
             def body(f) -> None:
                 view = memoryview(image.data)
-                for start in range(0, len(view), _PUSH_CHUNK):
-                    f.write(view[start:start + _PUSH_CHUNK])
-                    job.note(_PUSH_CHUNK)
+                for start, end in transport.chunk_spans(
+                        len(view), _PUSH_CHUNK):
+                    f.write(view[start:end])
+                    job.note(end - start)
 
             _atomic_publish(path, body)
             if fault is not None and fault.fault == "flip":

@@ -57,23 +57,23 @@ import urllib.error
 import urllib.parse
 import uuid
 from collections import OrderedDict
-from http.server import BaseHTTPRequestHandler
 from typing import Any, Dict, List, Optional
 
-from torchft_tpu import chaos
+from torchft_tpu import chaos, transport
 from torchft_tpu.checkpointing import (
     CheckpointServer,
     HealCorruptError,
     MANIFEST_FORMAT,
-    _check_bearer_auth,
-    _CheckpointHTTPServer,
-    _ConnectionPool,
     _HealSession,
     _heal_transient,
-    _looks_donor_dead,
-    _open_url,
-    _serve_ranged_body,
     _snapshot_tree,
+)
+from torchft_tpu.transport import (
+    ConnectionPool as _ConnectionPool,
+    check_bearer_auth as _check_bearer_auth,
+    looks_peer_dead as _looks_donor_dead,
+    open_url as _open_url,
+    serve_ranged_body as _serve_ranged_body,
 )
 from torchft_tpu.retry import RetryError, RetryPolicy
 from torchft_tpu.serialization import (
@@ -286,7 +286,7 @@ class WeightPublisher:
 
     # ------------------------------------------------------------- serving
 
-    def handle_request(self, handler: BaseHTTPRequestHandler,
+    def handle_request(self, handler: Any,
                        send_timeout_sec: float = 120.0) -> None:
         """Serve one ``/publish/*`` GET on ``handler`` (called from the
         hosting server's request handler, after its auth gate). Every
@@ -330,7 +330,7 @@ class WeightPublisher:
         with self._cond:
             self._m["serve_bytes_sent"] += sent
 
-    def _send_json(self, handler: BaseHTTPRequestHandler, obj: dict,
+    def _send_json(self, handler: Any, obj: dict,
                    send_timeout_sec: float) -> None:
         body = json.dumps(obj).encode()
         handler.send_response(200)
@@ -355,43 +355,36 @@ class PublicationServer:
         self._publisher = publisher
         self._bind_host = bind_host
         self._auth_token = auth_token
+        self._send_timeout_sec = send_timeout_sec
         self._down = False
-        srv_self = self
-
-        class Handler(BaseHTTPRequestHandler):
-            protocol_version = "HTTP/1.1"
-
-            def log_message(self, fmt, *args):  # quiet
-                logger.debug("publication http: " + fmt, *args)
-
-            def do_GET(self) -> None:
-                if srv_self._down:
-                    # Shut down: drop the (possibly kept-alive)
-                    # connection without a response, like a dead
-                    # process would — clients re-dial and reach
-                    # whatever now owns the port (the restart case).
-                    self.close_connection = True
-                    return
-                if not _check_bearer_auth(self, srv_self._auth_token):
-                    return
-                if not (self.path.split("?", 1)[0].rstrip("/") == "/publish"
-                        or self.path.startswith("/publish/")):
-                    self.send_error(404, "unknown path")
-                    return
-                srv_self._publisher.handle_request(
-                    self, send_timeout_sec=send_timeout_sec)
-
-        self._server = _CheckpointHTTPServer((bind_host, port), Handler)
-        self._thread = threading.Thread(
-            target=self._server.serve_forever, daemon=True,
-            name="publication-server")
-        self._thread.start()
+        self._server = transport.serve_http(
+            bind_host, port, self._route, name="publication-server")
         # Rebirth for the chaos kill latches: a replacement relay bound
         # at a dead relay's host:port must not inherit its dead latch
         # (docs/design/churn.md; no-op without an active schedule).
         netloc = urllib.parse.urlparse(self.address()).netloc
         if netloc:
             chaos.endpoint_reborn(f"serve:{netloc}")
+
+    def _route(self, handler: Any) -> None:
+        if handler.command != "GET":
+            handler.send_error(501, f"Unsupported method ({handler.command!r})")
+            return
+        if self._down:
+            # Shut down: drop the (possibly kept-alive) connection
+            # without a response, like a dead process would — clients
+            # re-dial and reach whatever now owns the port (the
+            # restart case).
+            handler.close_connection = True
+            return
+        if not _check_bearer_auth(handler, self._auth_token):
+            return
+        if not (handler.path.split("?", 1)[0].rstrip("/") == "/publish"
+                or handler.path.startswith("/publish/")):
+            handler.send_error(404, "unknown path")
+            return
+        self._publisher.handle_request(
+            handler, send_timeout_sec=self._send_timeout_sec)
 
     def address(self) -> str:
         port = self._server.server_address[1]
