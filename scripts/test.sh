@@ -291,6 +291,30 @@ if [[ "${1:-}" == "substrate" ]]; then
     exit 0
 fi
 
+# Sdc tier: the silent-divergence arc's focused gate
+# (docs/design/state_attestation.md) — the device digest kernel frozen
+# against the NumPy reference across dtypes (plus the trace-time
+# cache-miss tripwire), the pure-Python FleetAggregator attestation
+# vote (strict majority, healer/absent/foreign-quorum abstention,
+# sticky latch, the non-voter clear-on-match, farewell-clears vs
+# prune-keeps), the read-time staleness bound (a SIGKILLed group ages
+# out of baselines AND ballots), the ONE shared donor-admission
+# predicate across all three resolvers, the Manager quarantine ladder
+# (latch, refusal classes, checkpoint-server 503 gate, withdrawn
+# advertisements, deferred clears), the chaos sdc: band (spec parse,
+# stream purity, intensity/PhasedChaos, participants-only injection),
+# and the seeded 3-group flip -> verdict -> auto-heal -> bitwise-
+# converge soak. Tier-1 and native-free (not marked slow); run this
+# tier on fleet/manager/chaos/serialization/checkpointing changes. The
+# C++ lighthouse runs the same vote (the mirror contract) — its matrix
+# is in the `core` tier; the PhasedChaos storm soak rides nightly.
+if [[ "${1:-}" == "sdc" ]]; then
+    stage sdc env JAX_PLATFORMS=cpu \
+        python -m pytest tests/test_attestation.py -q -m "sdc and not slow"
+    echo "== total: ${SECONDS}s"
+    exit 0
+fi
+
 # Heal-soak tier: seeded chaos soak of repeated heals with donor churn —
 # every round the primary donor is killed mid-stream while resets/short
 # reads pepper the heal channel; each heal must complete bitwise-

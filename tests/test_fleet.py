@@ -403,6 +403,8 @@ FLEET_METRIC_NAMES = frozenset([
     "torchft_fleet_groups", "torchft_fleet_step_ms",
     "torchft_fleet_step_ms_max", "torchft_fleet_slo_breach",
     "torchft_fleet_slo_breaches_total",
+    "torchft_fleet_sdc_quarantined",
+    "torchft_fleet_sdc_verdicts_total",
     "torchft_fleet_stage_median_ms",
     "torchft_fleet_straggler_score", "torchft_fleet_group_step_ms",
 ])

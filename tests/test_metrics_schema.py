@@ -108,6 +108,13 @@ DOCUMENTED_KEYS = frozenset([
     "transport_qos_demotion_bytes_total",
     "transport_qos_waits_total", "transport_conns_total",
     "transport_requests_total", "transport_sendfile_bytes_total",
+    # state attestation (docs/design/state_attestation.md): commit-
+    # boundary digest accounting, the quarantine latch + ladder
+    # counters, and the digest kernel's trace-time tripwire
+    "sdc_digests_total", "sdc_digest_ms_total", "sdc_quarantined",
+    "sdc_quarantines_total", "sdc_quarantine_clears_total",
+    "sdc_reheals_total", "sdc_refusals_total", "sdc_chaos_flips_total",
+    "sdc_digest_cache_misses",
 ])
 
 # Merged into metrics() only while the RAM tier is armed

@@ -126,7 +126,8 @@ void tft_manager_set_digest(void* h, int64_t step, double step_wall_ms,
                             double capacity_fraction,
                             double churn_per_min, int32_t healing,
                             double heal_last_ms, double publish_last_ms,
-                            const char* trace_addr) {
+                            const char* trace_addr, int64_t quorum_id,
+                            const char* state_digest) {
   StepDigest d;
   d.set_step(step);
   d.set_step_wall_ms(step_wall_ms);
@@ -143,6 +144,10 @@ void tft_manager_set_digest(void* h, int64_t step, double step_wall_ms,
   d.set_heal_last_ms(heal_last_ms);
   d.set_publish_last_ms(publish_last_ms);
   d.set_trace_addr(trace_addr ? trace_addr : "");
+  // State attestation (docs/design/state_attestation.md): the digest
+  // rides the same piggyback; "" = attestation off (a non-voter).
+  d.set_quorum_id(quorum_id);
+  d.set_state_digest(state_digest ? state_digest : "");
   ((ManagerServer*)h)->set_digest(d);
 }
 
@@ -241,6 +246,10 @@ struct TftQuorumResult {
   char* straggler_stage;
   char* straggler_id;
   char* slo_breach;
+  // State attestation verdict (docs/design/state_attestation.md).
+  int32_t sdc_diverged;
+  char* sdc_quarantined;
+  char* sdc_quarantined_addrs;
 };
 
 void* tft_manager_client_new(const char* addr, int64_t connect_timeout_ms,
@@ -289,6 +298,9 @@ int tft_manager_client_quorum(void* h, int64_t rank, int64_t step,
   out->straggler_stage = dup_str(r.fleet().straggler_stage());
   out->straggler_id = dup_str(r.fleet().straggler_id());
   out->slo_breach = dup_str(r.fleet().slo_breach());
+  out->sdc_diverged = r.fleet().sdc_diverged() ? 1 : 0;
+  out->sdc_quarantined = dup_str(r.fleet().sdc_quarantined());
+  out->sdc_quarantined_addrs = dup_str(r.fleet().sdc_quarantined_addrs());
   return 0;
 }
 

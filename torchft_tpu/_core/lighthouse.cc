@@ -161,6 +161,35 @@ void DigestTable::prune(int64_t now, int64_t keep_ms) {
   }
 }
 
+// Read-time freshness bound (fleet._fresh_bound_ms — the mirror
+// contract, change together): ~2.5x the group's own median boundary
+// interval, floored at 2s, capped at stale_ms. Fewer than two positive
+// deltas means no cadence estimate, so fall back to stale_ms (never
+// stricter than the hard staleness cut).
+static int64_t fresh_bound_ms(const std::deque<DigestTable::Entry>& ring,
+                              int64_t stale_ms) {
+  constexpr double kFreshIntervals = 2.5;  // fleet.FRESH_INTERVALS
+  constexpr double kMinFreshMs = 2000.0;   // fleet.MIN_FRESH_MS
+  if (ring.size() >= 3) {
+    std::vector<double> deltas;
+    for (size_t i = 0; i + 1 < ring.size(); i++) {
+      double d = (double)(ring[i + 1].recorded_ms - ring[i].recorded_ms);
+      if (d > 0) deltas.push_back(d);
+    }
+    if (deltas.size() >= 2) {
+      std::sort(deltas.begin(), deltas.end());
+      size_t n = deltas.size();
+      double interval =
+          n % 2 ? deltas[n / 2] : 0.5 * (deltas[n / 2 - 1] + deltas[n / 2]);
+      if (interval > 0.0)
+        return (int64_t)std::min(
+            (double)stale_ms, std::max(kFreshIntervals * interval,
+                                       kMinFreshMs));
+    }
+  }
+  return stale_ms;
+}
+
 std::map<std::string, DigestTable::Entry> DigestTable::latest(
     int64_t now, int64_t stale_ms) const {
   std::map<std::string, Entry> out;
@@ -168,8 +197,9 @@ std::map<std::string, DigestTable::Entry> DigestTable::latest(
     std::lock_guard<std::mutex> lk(s.mu);
     for (const auto& [id, ring] : s.rings) {
       if (ring.empty()) continue;
-      const Entry& e = ring.back();
+      Entry e = ring.back();
       if (now - e.recorded_ms > stale_ms) continue;
+      e.fresh = now - e.recorded_ms <= fresh_bound_ms(ring, stale_ms);
       out[id] = e;
     }
   }
@@ -655,8 +685,16 @@ void Lighthouse::record_beat(const LighthouseHeartbeatRequest& r) {
     beats_.farewell(r.replica_id(), now_ms());
     // A clean goodbye withdraws the group from the fleet aggregates
     // immediately — no departed group may linger as a phantom
-    // straggler (docs/design/fleet_health.md).
+    // straggler (docs/design/fleet_health.md). A farewell also clears
+    // any divergence verdict (fleet.FleetAggregator.remove): the
+    // replacement rejoins behind max_step and heals from the attested
+    // majority before it can attest anything. Prune deliberately does
+    // NOT clear — dead-without-farewell stays quarantined.
     digests_.erase(r.replica_id());
+    {
+      std::lock_guard<std::mutex> flk(fleet_mu_);
+      sdc_quarantined_.erase(r.replica_id());
+    }
   } else {
     beats_.record(r.replica_id(), now_ms(), r.joining(), r.heal_count(),
                   r.committed_steps(), r.aborted_steps());
@@ -693,11 +731,98 @@ std::shared_ptr<const FleetAggregate> Lighthouse::fleet_aggregate(
       ++it;
   }
 
+  // State attestation vote (fleet.FleetAggregator._attest_vote — the
+  // mirror contract, change together): majority vote per
+  // (quorum_id, step) over fresh, non-healing digests carrying a
+  // fingerprint. A ballot needs a STRICT majority to produce a
+  // verdict (ties/50-50 fail open); minority groups latch into the
+  // sticky quarantine map; a quarantined group clears on a fresh
+  // digest matching a later winner even though it is not itself a
+  // voter (its own latch reports it healing — demanding a vote from
+  // it would deadlock the clear).
+  {
+    std::map<std::pair<int64_t, int64_t>,
+             std::map<std::string, std::vector<std::string>>>
+        ballots;
+    for (const auto& [id, e] : latest) {
+      if (!e.fresh || e.d.healing() || e.d.state_digest().empty() ||
+          e.d.quorum_id() < 0)
+        continue;
+      ballots[{e.d.quorum_id(), e.d.step()}][e.d.state_digest()]
+          .push_back(id);
+    }
+    for (const auto& [key, by_digest] : ballots) {
+      size_t voters = 0;
+      for (const auto& [dg, rids] : by_digest) voters += rids.size();
+      // max over (count, digest) — the digest tie-break is inert (a
+      // tied winner fails the strict-majority check) but keeps
+      // iteration-order independence with the Python mirror.
+      const std::string* winner = nullptr;
+      size_t winner_n = 0;
+      for (const auto& [dg, rids] : by_digest) {
+        if (rids.size() > winner_n ||
+            (winner && rids.size() == winner_n && dg > *winner)) {
+          winner = &dg;
+          winner_n = rids.size();
+        }
+      }
+      if (!winner || 2 * winner_n <= voters) continue;  // fail open
+      for (const auto& [id, e] : latest) {
+        auto it = sdc_quarantined_.find(id);
+        if (it == sdc_quarantined_.end()) continue;
+        if (e.fresh && e.d.state_digest() == *winner &&
+            e.d.quorum_id() == key.first && e.d.step() == key.second) {
+          sdc_quarantined_.erase(it);
+          sdc_clears_total_++;
+        }
+      }
+      for (const auto& [dg, rids] : by_digest) {
+        for (const auto& id : rids) {
+          if (dg == *winner) {
+            if (sdc_quarantined_.erase(id)) sdc_clears_total_++;
+          } else if (!sdc_quarantined_.count(id)) {
+            SdcVerdict v;
+            v.quorum_id = key.first;
+            v.step = key.second;
+            v.digest = dg;
+            v.majority_digest = *winner;
+            v.trace_addr = latest.at(id).d.trace_addr();
+            v.verdict_ms = now;
+            sdc_quarantined_[id] = std::move(v);
+            sdc_verdicts_total_++;
+            fprintf(stderr,
+                    "torchft_tpu lighthouse: SDC DIVERGENCE on %s "
+                    "(quorum %lld step %lld: %s vs majority %s)\n",
+                    id.c_str(), (long long)key.first,
+                    (long long)key.second, dg.c_str(),
+                    winner->c_str());
+            fflush(stderr);
+          }
+        }
+      }
+    }
+    for (const auto& [id, v] : sdc_quarantined_) {
+      agg->sdc_quarantined.push_back(id);
+      if (!v.trace_addr.empty())
+        agg->sdc_quarantined_addrs.push_back(v.trace_addr);
+    }
+    std::sort(agg->sdc_quarantined_addrs.begin(),
+              agg->sdc_quarantined_addrs.end());
+    agg->sdc_quarantined_addrs.erase(
+        std::unique(agg->sdc_quarantined_addrs.begin(),
+                    agg->sdc_quarantined_addrs.end()),
+        agg->sdc_quarantined_addrs.end());
+    agg->sdc_verdicts_total = sdc_verdicts_total_;
+    agg->sdc_clears_total = sdc_clears_total_;
+  }
+
   // Baseline median/MAD (fleet.robust_zscores) + per-stage medians.
+  // Stale rows stay visible in the group list but never shape the
+  // baseline (the dead-without-farewell fix).
   std::vector<double> walls;
   std::vector<double> stage_vals[4];
   for (const auto& [id, e] : latest) {
-    if (!baseline_eligible(e.d)) continue;
+    if (!baseline_eligible(e.d) || !e.fresh) continue;
     walls.push_back(e.d.step_wall_ms());
     for (int i = 0; i < 4; i++)
       stage_vals[i].push_back(stage_value(e.d, i));
@@ -721,7 +846,10 @@ std::shared_ptr<const FleetAggregate> Lighthouse::fleet_aggregate(
     g.replica_id = id;
     g.d = e.d;
     g.age_ms = now - e.recorded_ms;
-    g.baseline = baseline_eligible(e.d);
+    g.baseline = baseline_eligible(e.d) && e.fresh;
+    g.attested = !e.d.state_digest().empty() && e.fresh &&
+                 !e.d.healing();
+    g.sdc_diverged = sdc_quarantined_.count(id) > 0;
     if (g.baseline) {
       // Zero dispersion (uniform fleet / single group) -> all scores
       // 0.0, never NaN (fleet.robust_zscores).
@@ -732,7 +860,8 @@ std::shared_ptr<const FleetAggregate> Lighthouse::fleet_aggregate(
       g.stage = attribute_stage(e.d, agg->stage_median);
     } else {
       g.score = 0.0;
-      g.stage = e.d.healing() ? "heal" : "degraded";
+      g.stage = !e.fresh ? "stale"
+                         : (e.d.healing() ? "heal" : "degraded");
     }
     agg->groups.push_back(std::move(g));
   }
@@ -836,6 +965,23 @@ void Lighthouse::fill_fleet_hint(const std::string& id, FleetHint* out) {
     out->set_slo_breach(joined);
     break;
   }
+  // Divergence verdict echo (docs/design/state_attestation.md): the
+  // requester learns its own verdict plus the full quarantine set so
+  // every group's donor filters exclude the same peers.
+  bool diverged = false;
+  std::string q_rids, q_addrs;
+  for (const auto& r : agg->sdc_quarantined) {
+    if (r == id) diverged = true;
+    if (!q_rids.empty()) q_rids += ",";
+    q_rids += r;
+  }
+  for (const auto& a : agg->sdc_quarantined_addrs) {
+    if (!q_addrs.empty()) q_addrs += ",";
+    q_addrs += a;
+  }
+  out->set_sdc_diverged(diverged);
+  out->set_sdc_quarantined(q_rids);
+  out->set_sdc_quarantined_addrs(q_addrs);
 }
 
 std::string Lighthouse::fleet_status_json(const FleetAggregate& agg) {
@@ -867,7 +1013,21 @@ std::string Lighthouse::fleet_status_json(const FleetAggregate& agg) {
       events += ev;
     }
   }
-  out += "}},\"straggler\":{\"replica_id\":\"" +
+  out += "},\"sdc_quarantined\":[";
+  for (size_t i = 0; i < agg.sdc_quarantined.size(); i++) {
+    if (i) out += ",";
+    out += "\"" + json_escape(agg.sdc_quarantined[i]) + "\"";
+  }
+  out += "],\"sdc_quarantined_addrs\":[";
+  for (size_t i = 0; i < agg.sdc_quarantined_addrs.size(); i++) {
+    if (i) out += ",";
+    out += "\"" + json_escape(agg.sdc_quarantined_addrs[i]) + "\"";
+  }
+  out += "],\"sdc_verdicts_total\":" +
+         std::to_string(agg.sdc_verdicts_total) +
+         ",\"sdc_clears_total\":" +
+         std::to_string(agg.sdc_clears_total);
+  out += "},\"straggler\":{\"replica_id\":\"" +
          json_escape(agg.straggler_id) +
          "\",\"score\":" + fmt_double(agg.straggler_score) +
          ",\"stage\":\"" + json_escape(agg.straggler_stage) +
@@ -908,7 +1068,9 @@ std::string Lighthouse::fleet_status_json(const FleetAggregate& agg) {
       out += "\"" + json_escape(g.slo_breaches[b]) + "\"";
     }
     out += "],\"trace_addr\":\"" + json_escape(g.d.trace_addr()) +
-           "\"}";
+           "\",\"attested\":" + (g.attested ? "true" : "false") +
+           ",\"sdc_diverged\":" + (g.sdc_diverged ? "true" : "false") +
+           "}";
   }
   out += "]}";
   return out;
@@ -945,6 +1107,16 @@ std::string Lighthouse::fleet_metrics_text(const FleetAggregate& agg) {
      << "# TYPE torchft_fleet_slo_breaches_total counter\n"
      << "torchft_fleet_slo_breaches_total "
      << fmt_double((double)slo_total_snapshot) << "\n"
+     << "# HELP torchft_fleet_sdc_quarantined groups under a "
+        "divergence verdict\n"
+     << "# TYPE torchft_fleet_sdc_quarantined gauge\n"
+     << "torchft_fleet_sdc_quarantined "
+     << fmt_double((double)agg.sdc_quarantined.size()) << "\n"
+     << "# HELP torchft_fleet_sdc_verdicts_total divergence verdicts "
+        "issued\n"
+     << "# TYPE torchft_fleet_sdc_verdicts_total counter\n"
+     << "torchft_fleet_sdc_verdicts_total "
+     << fmt_double((double)agg.sdc_verdicts_total) << "\n"
      << "# HELP torchft_fleet_stage_median_ms fleet per-stage medians\n"
      << "# TYPE torchft_fleet_stage_median_ms gauge\n";
   for (int i = 0; i < 4; i++)

@@ -191,6 +191,12 @@ class DigestTable {
   struct Entry {
     StepDigest d;
     int64_t recorded_ms = 0;
+    // Read-time freshness (fleet._fresh_bound_ms — the mirror
+    // contract): false once the row is older than ~2 of the group's
+    // own boundary intervals. Stale rows stay visible in aggregates
+    // but never shape baselines or attestation votes (the
+    // dead-without-farewell fix).
+    bool fresh = true;
   };
 
   void record(const std::string& id, const StepDigest& d, int64_t now);
@@ -242,9 +248,15 @@ struct FleetAggregate {
     StepDigest d;
     int64_t age_ms = 0;
     double score = 0.0;
-    std::string stage;  // attribution; "heal"/"degraded" when excluded
+    // attribution; "heal"/"degraded"/"stale" when excluded
+    std::string stage;
     bool baseline = false;
     std::vector<std::string> slo_breaches;  // SLOs THIS group breaches
+    // State attestation (docs/design/state_attestation.md): this row
+    // carries a fresh, non-healing fingerprint (a voter) / this group
+    // is currently under a divergence verdict.
+    bool attested = false;
+    bool sdc_diverged = false;
   };
   int64_t computed_ms = 0;
   int64_t groups_n = 0;
@@ -255,6 +267,12 @@ struct FleetAggregate {
   double straggler_score = 0.0;
   std::string straggler_stage;
   std::vector<Group> groups;  // score-ranked, worst first
+  // Attestation verdicts at compute time (sorted replica ids, deduped
+  // sorted checkpoint-server bases) + lifetime counters.
+  std::vector<std::string> sdc_quarantined;
+  std::vector<std::string> sdc_quarantined_addrs;
+  int64_t sdc_verdicts_total = 0;
+  int64_t sdc_clears_total = 0;
 };
 
 class Lighthouse {
@@ -378,6 +396,26 @@ class Lighthouse {
   std::deque<std::string> slo_events_;       // JSON objects, newest last
   int64_t slo_breaches_total_ = 0;
   int64_t slo_active_ = 0;
+
+  // --- state attestation (docs/design/state_attestation.md) -------------
+  // Sticky divergence verdicts, guarded by fleet_mu_. A verdict latches
+  // when a group loses a strict-majority digest vote for its
+  // (quorum_id, step) ballot and clears only on a fresh digest matching
+  // a later winner (the non-voter clear: quarantined groups report
+  // healing=true, so their re-attest digest is not itself a ballot
+  // entry) or on a clean farewell. Prune does NOT clear — a group that
+  // died corrupt stays quarantined so donor filters keep excluding it.
+  struct SdcVerdict {
+    int64_t quorum_id = 0;
+    int64_t step = 0;
+    std::string digest;           // the minority digest that lost
+    std::string majority_digest;  // the winner it disagreed with
+    std::string trace_addr;       // checkpoint-server base, for filters
+    int64_t verdict_ms = 0;
+  };
+  std::map<std::string, SdcVerdict> sdc_quarantined_;
+  int64_t sdc_verdicts_total_ = 0;
+  int64_t sdc_clears_total_ = 0;
 
   // Standby machinery. promoted_ is true from birth on a primary; on a
   // standby it flips once the primary is provably dead and gates Quorum

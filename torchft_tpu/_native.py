@@ -118,7 +118,7 @@ def _load() -> ctypes.CDLL:
     dbl = ctypes.c_double
     lib.tft_manager_set_digest.argtypes = [
         vp, i64, dbl, dbl, dbl, dbl, dbl, dbl, dbl, i64, dbl, dbl, i32,
-        dbl, dbl, c]
+        dbl, dbl, c, i64, c]
     lib.tft_manager_set_digest.restype = None
     lib.tft_manager_farewell.argtypes = [vp]
     lib.tft_manager_farewell.restype = None
@@ -193,6 +193,10 @@ class _CQuorumResult(ctypes.Structure):
         ("straggler_stage", ctypes.c_void_p),
         ("straggler_id", ctypes.c_void_p),
         ("slo_breach", ctypes.c_void_p),
+        # State attestation verdict (docs/design/state_attestation.md).
+        ("sdc_diverged", ctypes.c_int32),
+        ("sdc_quarantined", ctypes.c_void_p),
+        ("sdc_quarantined_addrs", ctypes.c_void_p),
     ]
 
 
@@ -372,12 +376,18 @@ class ManagerServer:
                    healing: bool = False,
                    heal_last_ms: float = 0.0,
                    publish_last_ms: float = 0.0,
-                   trace_addr: str = "") -> None:
+                   trace_addr: str = "",
+                   quorum_id: int = -1,
+                   state_digest: str = "") -> None:
         """Push the per-step telemetry digest
         (docs/design/fleet_health.md): it piggybacks on this server's
         quorum RPC beat (and keepalive beats), feeding the lighthouse's
         fleet aggregates at zero extra RPCs. Never calling this keeps
-        beats bit-exact with digest-less builds."""
+        beats bit-exact with digest-less builds.
+
+        ``quorum_id``/``state_digest`` carry the state-attestation
+        fingerprint (docs/design/state_attestation.md); ``""`` keeps
+        this group a non-voter."""
         lib().tft_manager_set_digest(
             self._h, int(step), float(step_wall_ms), float(fetch_ms),
             float(ring_ms), float(put_ms), float(vote_ms),
@@ -385,7 +395,7 @@ class ManagerServer:
             int(policy_rung), float(capacity_fraction),
             float(churn_per_min), 1 if healing else 0,
             float(heal_last_ms), float(publish_last_ms),
-            trace_addr.encode())
+            trace_addr.encode(), int(quorum_id), state_digest.encode())
 
     def lighthouse_redials(self) -> int:
         """Times this manager re-dialed a DIFFERENT lighthouse endpoint
@@ -591,6 +601,13 @@ class QuorumResult:
     straggler_stage: str = ""
     straggler_id: str = ""
     slo_breach: str = ""
+    # State attestation verdict (docs/design/state_attestation.md):
+    # True while THIS group's state digest is quarantined (it lost a
+    # majority vote and has not re-attested); the comma-joined
+    # fleet-wide quarantine lists gate every donor resolver.
+    sdc_diverged: bool = False
+    sdc_quarantined: str = ""
+    sdc_quarantined_addrs: str = ""
 
 
 class ManagerClient(_RetryingNativeClient):
@@ -651,6 +668,9 @@ class ManagerClient(_RetryingNativeClient):
             straggler_stage=_take_str(res.straggler_stage),
             straggler_id=_take_str(res.straggler_id),
             slo_breach=_take_str(res.slo_breach),
+            sdc_diverged=bool(res.sdc_diverged),
+            sdc_quarantined=_take_str(res.sdc_quarantined),
+            sdc_quarantined_addrs=_take_str(res.sdc_quarantined_addrs),
         )
 
     def checkpoint_address(self, rank: int, timeout_ms: int = 10_000) -> str:

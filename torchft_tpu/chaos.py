@@ -155,6 +155,18 @@ class EndpointChaos:
     # channels' traces are unchanged while these rates are 0).
     ram_loss_rate: float = 0.0
     ram_blackhole_rate: float = 0.0
+    # Silent data corruption (the ``sdc`` channel, honored by
+    # :func:`sdc_fault` — the state-attestation soak's injection point,
+    # docs/design/state_attestation.md): per-commit-boundary
+    # probability of one bit flipping in the group's committed params
+    # on the endpoint ``sdc:<replica_id>``. Which (leaf, byte, bit) is
+    # flipped derives from the decision's own frac draw, so the
+    # corruption sequence stays a pure function of (seed, channel, n);
+    # the rate scales with the live intensity. Appended LAST in the
+    # fault-band order (same determinism contract as the device/ram
+    # bands: existing channels' traces are unchanged while this rate
+    # is 0).
+    sdc_flip_rate: float = 0.0
     max_faults: int = -1         # cap on hard faults per channel (-1 = inf)
 
 
@@ -298,7 +310,8 @@ class ChaosSchedule:
                                (cfg.chip_loss_rate, "chip_loss"),
                                (cfg.chip_return_rate, "chip_return"),
                                (cfg.ram_loss_rate, "ram_loss"),
-                               (cfg.ram_blackhole_rate, "ram_blackhole")):
+                               (cfg.ram_blackhole_rate, "ram_blackhole"),
+                               (cfg.sdc_flip_rate, "sdc_flip")):
                 acc += rate * scale
                 if u < acc:
                     fault = kind
@@ -674,6 +687,39 @@ def device_fault(endpoint: str, n_devices: int,
             sched.return_chip(endpoint,
                               lost[int(d.frac * len(lost)) % len(lost)])
     return sched.lost_chips(endpoint)
+
+
+# ------------------------------------------------- silent data corruption
+
+
+def sdc_fault(endpoint: str,
+              schedule: Optional[ChaosSchedule] = None
+              ) -> Optional[Decision]:
+    """Per-boundary silent-data-corruption hook (channel ``sdc``; the
+    Manager polls it once per commit boundary with endpoint
+    ``sdc:<replica_id>`` — docs/design/state_attestation.md).
+
+    An ``sdc_flip`` decision is RETURNED for the caller to act on — it
+    needs the committed params: flip one bit of one leaf, with the
+    (leaf, byte, bit) choice derived from the decision's own ``frac``
+    draw so the corruption sequence is a pure function of
+    ``(seed, channel, n)`` like every other channel; the rate scales
+    with the live intensity, so :class:`~torchft_tpu.policy.PhasedChaos`
+    drives SDC storms unmodified. The caller must never poll while
+    healing or benched: corrupting a transient mid-restore state would
+    both wreck the freshly verified fetch and model a fault the
+    attestation vote deliberately abstains on — the injection contract
+    is post-commit, participants only (Manager._maybe_chaos_sdc guards
+    it; frozen by tests/test_attestation.py)."""
+    sched = schedule if schedule is not None else active()
+    if sched is None:
+        return None
+    if sched.config_for(endpoint) is None:
+        return None  # no decision draw (stream purity)
+    d = sched.decide(endpoint, "sdc")
+    if d is None or d.fault != "sdc_flip":
+        return None
+    return d
 
 
 # ------------------------------------------------------------ RAM faults

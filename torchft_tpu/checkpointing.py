@@ -418,6 +418,10 @@ class CheckpointServer:
         # /ramckpt/* and accepts replication PUTs. Images are immutable
         # and pre-verified — like /publish, never step-gated.
         self._ram_store: Optional[Any] = None
+        # Divergence-verdict serve gate (set_quarantined,
+        # docs/design/state_attestation.md): sticky 503 on every
+        # state-serving GET while the owning Manager is quarantined.
+        self._quarantined = False
 
         # Host on the transport substrate's shared server core (async
         # event loop by default, TORCHFT_ASYNC_SERVER=0 for the legacy
@@ -455,6 +459,17 @@ class CheckpointServer:
                 handler.close_connection = True
                 return
             self._serve_observability(handler)
+            return
+        if self._quarantined:
+            # Divergence verdict latched on the owning Manager: every
+            # byte this server could hand out (heal stream, RAM image,
+            # published generation) came from state the fleet voted
+            # divergent. Refuse hard — a peer holding our cached
+            # address rotates to an attested donor — while
+            # observability above stays up for the operator reading
+            # the verdict. PUTs stay open: images stored FOR peers are
+            # theirs, not ours.
+            handler.send_error(503, "quarantined (divergence verdict)")
             return
         if handler.path.split("?", 1)[0].rstrip("/") == "/publish" \
                 or handler.path.startswith("/publish/"):
@@ -580,6 +595,18 @@ class CheckpointServer:
             handler.close_connection = True
             return
         self._accept_ram_push(handler)
+
+    def set_quarantined(self, flag: bool) -> None:
+        """Sticky divergence-verdict serve gate
+        (docs/design/state_attestation.md): while set, every
+        state-serving GET (``/checkpoint/*``, ``/ramckpt/*``,
+        ``/publish/*``) refuses with 503, so a peer that cached this
+        server's address cannot fetch bytes the fleet voted divergent
+        through ANY route. Cleared when the lighthouse confirms the
+        re-attested digest (Manager's verdict-clear path)."""
+        with self._cond:
+            self._quarantined = bool(flag)
+            self._cond.notify_all()
 
     def _capture_locked(self) -> Tuple[Any, Any]:
         """State + plan to stream for the current step. Requires _cond held.

@@ -56,6 +56,17 @@ DIGEST_STAGES = ("fetch", "ring", "put", "vote")
 # identically.
 MAD_SIGMA = 1.4826
 
+# Read-time freshness floor (ms) for baseline / attestation-vote
+# membership: below this a single scheduling hiccup could bounce a
+# healthy group out of the baseline between two normal boundaries.
+MIN_FRESH_MS = 2_000
+
+# How many boundary intervals a group may miss before its last digest
+# stops shaping baselines and votes at read time (~2 missed boundaries;
+# the 0.5 covers a row legitimately aged up to one interval at read
+# time). Same constant in lighthouse.cc — the mirror contract.
+FRESH_INTERVALS = 2.5
+
 # The declarative SLO knobs (docs/design/fleet_health.md). Spec string:
 # "step_p95_ms=2500;commit_rate=0.95;heal_ms=60000;publish_lag_ms=5000;
 #  staleness_ms=30000" — ';' or ',' separated, unknown keys rejected.
@@ -98,6 +109,14 @@ class StepDigest:
     # and /metrics live. Lets tracefleet resolve the fleet from
     # /fleet/status.json with no quorum-store access.
     trace_addr: str = ""
+    # State attestation (docs/design/state_attestation.md): the quorum
+    # incarnation the digest was computed under and the device-fused
+    # committed-params fingerprint ("" = attestation off). The majority
+    # vote keys on (quorum_id, step) so digests from different quorum
+    # incarnations — whose memberships may legitimately hold different
+    # state mid-transition — never cross-compare.
+    quorum_id: int = -1
+    state_digest: str = ""
 
     def stage_ms(self) -> Dict[str, float]:
         return {"fetch": self.fetch_ms, "ring": self.ring_ms,
@@ -196,6 +215,17 @@ class FleetAggregator:
         # replica_id -> (committed_steps, aborted_steps) — the beat
         # counters the commit-rate SLO reads (ride the same RPC).
         self._commit_counts: Dict[str, Tuple[int, int]] = {}
+        # State attestation (docs/design/state_attestation.md):
+        # replica_id -> verdict record for groups a majority vote found
+        # divergent. STICKY — a verdict only clears when the group
+        # later lands on the winning side of a vote (post-heal
+        # re-attestation) or says farewell (remove()); a dead-without-
+        # farewell group stays quarantined, since its last attested
+        # state is still the corrupt one.
+        self._quarantined: "OrderedDict[str, Dict[str, Any]]" = \
+            OrderedDict()
+        self._sdc_verdicts_total = 0
+        self._sdc_clears_total = 0
 
     def ingest(self, digest: StepDigest,
                now_ms: Optional[int] = None) -> None:
@@ -214,21 +244,121 @@ class FleetAggregator:
 
     def remove(self, replica_id: str) -> None:
         """Drop a departed group immediately (farewell / eviction): its
-        history must not shape the baseline or linger in aggregates."""
+        history must not shape the baseline or linger in aggregates.
+        A farewell also clears any divergence verdict — a clean
+        shutdown's replacement rejoins behind max_step and heals from
+        the attested majority before it can attest anything."""
         self._groups.pop(replica_id, None)
         self._commit_counts.pop(replica_id, None)
+        self._quarantined.pop(replica_id, None)
 
     def prune(self, now_ms: Optional[int] = None) -> None:
+        """Age out rows past stale_ms. Unlike a farewell, pruning does
+        NOT clear a divergence verdict: a dead-without-farewell corpse's
+        last attested state is still the corrupt one, and donor filters
+        must keep excluding its address if a cached copy resurfaces."""
         now = _now_ms() if now_ms is None else int(now_ms)
         for rid in [rid for rid, ring in self._groups.items()
                     if not ring or now - ring[-1][0] > self._stale_ms]:
-            self.remove(rid)
+            self._groups.pop(rid, None)
+            self._commit_counts.pop(rid, None)
 
     def group_ids(self) -> List[str]:
         return list(self._groups)
 
     def commit_counts(self) -> Dict[str, Tuple[int, int]]:
         return dict(self._commit_counts)
+
+    def quarantined(self) -> Dict[str, Dict[str, Any]]:
+        """Current divergence verdicts (copy): replica_id -> record
+        with the minority/majority digests and the (quorum_id, step)
+        the vote fired at."""
+        return {rid: dict(rec) for rid, rec in self._quarantined.items()}
+
+    def _fresh_bound_ms(self, ring: "deque") -> int:
+        """Read-time freshness bound for baseline / vote membership.
+
+        ``stale_ms`` (60 s default) exists for RETENTION — but a
+        SIGKILLed group that never said farewell would keep feeding the
+        straggler baseline (and the attestation vote) with its last
+        digest for that whole minute. Estimate the group's own boundary
+        cadence as the median inter-record interval of its ring and
+        stop trusting rows older than ~2 missed boundaries
+        (``FRESH_INTERVALS``), floored at ``MIN_FRESH_MS`` and capped
+        at ``stale_ms``. Fewer than 2 observed intervals: no cadence
+        estimate yet, fall back to ``stale_ms``."""
+        if len(ring) >= 3:
+            deltas = [ring[i + 1][0] - ring[i][0]
+                      for i in range(len(ring) - 1)]
+            deltas = [d for d in deltas if d > 0]
+            if len(deltas) >= 2:
+                interval = _median([float(d) for d in deltas])
+                if interval > 0:
+                    return int(min(float(self._stale_ms),
+                                   max(FRESH_INTERVALS * interval,
+                                       float(MIN_FRESH_MS))))
+        return self._stale_ms
+
+    def _attest_vote(self, latest: "OrderedDict[str, Tuple[int, StepDigest]]",
+                     fresh: Dict[str, bool], now: int) -> None:
+        """Majority vote per (quorum_id, step) over fresh, non-healing
+        digests carrying a fingerprint (docs/design/state_attestation.md).
+
+        Rules (identical in lighthouse.cc — the mirror contract):
+        * a ballot needs a STRICT majority (> half the voters) to
+          produce a verdict; a tie or a 50/50 split fails open — no
+          group is quarantined on ambiguous evidence;
+        * healers never vote: a mid-restore group's transient state is
+          legitimately different and must not trip a false verdict;
+        * minority groups latch into the sticky quarantined set; a
+          quarantined group clears when a fresh digest of its matches
+          the majority again (it healed and re-attested) — matching is
+          enough, VOTING is not required: the quarantine latch itself
+          reports the group healing/non-participating until cleared,
+          so demanding a vote from it would deadlock the clear."""
+        ballots: Dict[Tuple[int, int], Dict[str, List[str]]] = {}
+        for rid, (_, d) in latest.items():
+            if (not fresh.get(rid) or d.healing or not d.state_digest
+                    or d.quorum_id < 0):
+                continue
+            ballots.setdefault((d.quorum_id, d.step), {}) \
+                .setdefault(d.state_digest, []).append(rid)
+        for (qid, step), by_digest in ballots.items():
+            voters = sum(len(rids) for rids in by_digest.values())
+            # max over (count, digest) — the digest tie-break is inert
+            # (a tied winner fails the strict-majority check below) but
+            # keeps iteration-order independence with the C++ mirror.
+            winner, winner_rids = max(by_digest.items(),
+                                      key=lambda kv: (len(kv[1]), kv[0]))
+            if 2 * len(winner_rids) <= voters:
+                continue  # no strict majority: fail open
+            # Non-voter clear: a quarantined group's digests carry the
+            # healing flag (its own latch benched it), so they are
+            # never IN by_digest — but a fresh digest for this same
+            # ballot that MATCHES the winner is proof the restore
+            # landed and the bytes re-converged. Clear on match.
+            for rid, (_, d) in latest.items():
+                if (rid in self._quarantined and fresh.get(rid)
+                        and d.state_digest == winner
+                        and d.quorum_id == qid and d.step == step):
+                    self._quarantined.pop(rid, None)
+                    self._sdc_clears_total += 1
+            for dg, rids in by_digest.items():
+                for rid in rids:
+                    if dg == winner:
+                        if self._quarantined.pop(rid, None) is not None:
+                            self._sdc_clears_total += 1
+                    elif rid not in self._quarantined:
+                        self._quarantined[rid] = {
+                            "replica_id": rid,
+                            "quorum_id": qid,
+                            "step": step,
+                            "digest": dg,
+                            "majority_digest": winner,
+                            "trace_addr": latest[rid][1].trace_addr,
+                            "verdict_ms": now,
+                        }
+                        self._sdc_verdicts_total += 1
 
     # ------------------------------------------------------------ aggregate
 
@@ -243,6 +373,7 @@ class FleetAggregator:
         explained, and ranking them would bury the real straggler."""
         now = _now_ms() if now_ms is None else int(now_ms)
         latest: "OrderedDict[str, Tuple[int, StepDigest]]" = OrderedDict()
+        fresh: Dict[str, bool] = {}
         for rid in sorted(self._groups):
             ring = self._groups[rid]
             if not ring:
@@ -251,9 +382,16 @@ class FleetAggregator:
             if now - rec_ms > self._stale_ms:
                 continue
             latest[rid] = (rec_ms, d)
+            # Read-time freshness (the dead-without-farewell fix): a
+            # row older than ~2 of the group's own boundary intervals
+            # stays VISIBLE (operators should see the silent group age
+            # out) but stops shaping baselines and votes.
+            fresh[rid] = (now - rec_ms) <= self._fresh_bound_ms(ring)
+
+        self._attest_vote(latest, fresh, now)
 
         baseline = [(rid, d) for rid, (_, d) in latest.items()
-                    if d.baseline_eligible()]
+                    if d.baseline_eligible() and fresh[rid]]
         walls = [d.step_wall_ms for _, d in baseline]
         scores = robust_zscores(walls)
         score_by_id = {rid: sc for (rid, _), sc in zip(baseline, scores)}
@@ -263,10 +401,12 @@ class FleetAggregator:
 
         groups: List[Dict[str, Any]] = []
         for rid, (rec_ms, d) in latest.items():
-            in_baseline = d.baseline_eligible()
+            in_baseline = d.baseline_eligible() and fresh[rid]
             score = score_by_id.get(rid, 0.0)
             if in_baseline:
                 stage = attribute_stage(d.stage_ms(), stage_median)
+            elif not fresh[rid]:
+                stage = "stale"
             else:
                 stage = "heal" if d.healing else "degraded"
             groups.append({
@@ -288,6 +428,9 @@ class FleetAggregator:
                 "publish_last_ms": d.publish_last_ms,
                 "baseline": in_baseline,
                 "trace_addr": d.trace_addr,
+                "attested": bool(d.state_digest) and fresh[rid]
+                and not d.healing,
+                "sdc_diverged": rid in self._quarantined,
             })
         groups.sort(key=lambda g: (-g["straggler_score"],
                                    g["replica_id"]))
@@ -313,6 +456,13 @@ class FleetAggregator:
                 "max_ms": round(max(walls), 3) if walls else 0.0,
                 "stage_median_ms": {k: round(v, 3)
                                     for k, v in stage_median.items()},
+                "sdc_quarantined": sorted(self._quarantined),
+                "sdc_quarantined_addrs": sorted(
+                    {rec.get("trace_addr", "")
+                     for rec in self._quarantined.values()
+                     if rec.get("trace_addr")}),
+                "sdc_verdicts_total": self._sdc_verdicts_total,
+                "sdc_clears_total": self._sdc_clears_total,
             },
             "straggler": straggler,
             "groups": groups,
@@ -513,6 +663,16 @@ def status_prometheus(status: Dict[str, Any],
         "# TYPE torchft_fleet_slo_breaches_total counter",
         f"torchft_fleet_slo_breaches_total "
         f"{float(slo_breaches_total)!r}",
+        "# HELP torchft_fleet_sdc_quarantined groups under a "
+        "divergence verdict",
+        "# TYPE torchft_fleet_sdc_quarantined gauge",
+        f"torchft_fleet_sdc_quarantined "
+        f"{float(len(f.get('sdc_quarantined', [])))!r}",
+        "# HELP torchft_fleet_sdc_verdicts_total divergence verdicts "
+        "issued",
+        "# TYPE torchft_fleet_sdc_verdicts_total counter",
+        f"torchft_fleet_sdc_verdicts_total "
+        f"{float(f.get('sdc_verdicts_total', 0))!r}",
         "# HELP torchft_fleet_stage_median_ms fleet per-stage medians",
         "# TYPE torchft_fleet_stage_median_ms gauge",
     ]
@@ -563,6 +723,8 @@ def format_fleet_table(status: Dict[str, Any],
         st = g["stage_ms"]
         flag = " HEAL" if g["healing"] else (
             " DEG" if g["capacity_fraction"] < 0.999 else "")
+        if g.get("sdc_diverged"):
+            flag = " SDC" + flag
         out.append(
             f"{g['replica_id']:<20.20} {g['step']:>7} "
             f"{g['step_wall_ms']:>9.1f} {g['straggler_score']:>+7.2f} "

@@ -54,6 +54,7 @@ import jax
 import jax.numpy as jnp
 
 from torchft_tpu import policy as policy_mod
+from torchft_tpu import serialization
 from torchft_tpu import tracing as tracing_mod
 from torchft_tpu import transport
 from torchft_tpu._native import ManagerClient, ManagerServer, Store, StoreClient
@@ -381,6 +382,7 @@ class Manager:
         tracing: Optional[bool] = None,
         trace_steps: Optional[int] = None,
         fleet_telemetry: Optional[bool] = None,
+        attestation: Optional[bool] = None,
         ram_ckpt_peers: Optional[int] = None,
         ram_demote_dir: Optional[str] = None,
         _manager_client: Optional[ManagerClient] = None,
@@ -526,6 +528,34 @@ class Manager:
         # publication/listing), keyed by host:port so a lighthouse
         # failover re-dials.
         self._healset_store: Optional[tuple] = None
+        # --- state attestation (docs/design/state_attestation.md) --------
+        # When on (default; TORCHFT_ATTESTATION=0 opts out — the
+        # sdc_overhead_ab bench's knob), every commit boundary's digest
+        # additionally carries a device-fused fingerprint of the
+        # committed params; the lighthouse majority-votes the
+        # fingerprints per (quorum_id, step) and echoes a divergence
+        # verdict back in the fleet hint. Rides the fleet plane: with
+        # fleet telemetry off nothing is computed or pushed.
+        if attestation is None:
+            attestation = os.environ.get(
+                "TORCHFT_ATTESTATION", "1").strip().lower() \
+                not in ("0", "false")
+        self._attestation = bool(attestation)
+        # The last fingerprint this group pushed (what the flight dump
+        # names when a verdict lands), and the sticky quarantine latch:
+        # once the fleet says WE diverged, the latch holds — zero-weight
+        # fold, refused save/publish/RAM-replication, withdrawn
+        # advertisements, re-heal from the attested majority — until a
+        # later hint confirms the re-attested digest matched.
+        self._last_state_digest = ""
+        self._sdc_quarantined = False
+        # Fleet-wide quarantine facts from the hint (every group gets
+        # them, not just the diverged one): replica ids under a
+        # verdict, and their checkpoint-server BASE addresses — what
+        # the shared donor predicate (_donor_admissible) excludes from
+        # every recovery path.
+        self._sdc_quarantined_peers: set = set()
+        self._sdc_quarantined_bases: set = set()
         # Cross-step overlap engine state: the ONE in-flight deferred
         # allreduce (future + dispatch/done timestamps) whose grads apply
         # at the next step boundary. None outside overlap mode or when
@@ -736,6 +766,22 @@ class Manager:
             "ram_replicate_skipped": 0.0,
             "ram_replicate_errors_total": 0.0,
             "ram_replica_collapses_total": 0.0,
+            # State attestation (docs/design/state_attestation.md):
+            # fingerprints computed + their cumulative wall; whether
+            # THIS group is currently under a divergence verdict
+            # (gauge) and how often it entered/left quarantine; the
+            # recovery heals the verdict forced; boundary actions the
+            # quarantine refused (save/publish/RAM-replicate) on top
+            # of their per-path skip counters; and chaos sdc: band
+            # bit-flips actually applied.
+            "sdc_digests_total": 0.0,
+            "sdc_digest_ms_total": 0.0,
+            "sdc_quarantined": 0.0,
+            "sdc_quarantines_total": 0.0,
+            "sdc_quarantine_clears_total": 0.0,
+            "sdc_reheals_total": 0.0,
+            "sdc_refusals_total": 0.0,
+            "sdc_chaos_flips_total": 0.0,
         }
         self._metrics_lock = threading.Lock()
         if self._controller is not None:
@@ -1057,6 +1103,13 @@ class Manager:
         # snapshot — encode and the demotion ladder run behind it.
         self._maybe_replicate_ram()
 
+        # Chaos sdc: band (docs/design/state_attestation.md): the
+        # deterministic post-commit bit-flip rides the SAME boundary
+        # edge — the corrupted params train this step and lose the
+        # attestation vote at the NEXT boundary, which is exactly the
+        # ≤1-boundary detection-latency bound the soak asserts.
+        self._maybe_chaos_sdc()
+
         if self._should_step:
             # Under the metrics lock so (participant_rank,
             # batches_committed) snapshots (participant_slot()) can never
@@ -1084,7 +1137,11 @@ class Manager:
             if self._healing:
                 # Sync mode: state is restored *before* compute, so the
                 # healer participates immediately (reference manager.py:328-332).
-                self._apply_pending_state_dict()
+                # A donor-less quarantine re-heal stages nothing — the
+                # group then stays zero-weighted via the quarantine
+                # latch and retries next boundary.
+                if self._pending_state_dict is not None:
+                    self._apply_pending_state_dict()
                 with self._metrics_lock:
                     self._healing = False
 
@@ -1311,6 +1368,16 @@ class Manager:
             )
 
         if not q.heal:
+            with self._metrics_lock:
+                quarantined = self._sdc_quarantined
+            if quarantined:
+                # Divergence verdict latched: the lighthouse still has
+                # us at max_step (corruption does not lag a step
+                # counter), so no heal was assigned — force one anyway.
+                # Until the restore lands we must NOT advertise as a
+                # donor or capacity either: our bytes lost the vote.
+                self._sdc_reheal(q)
+                return
             # Advertise this participant's checkpoint server under the
             # quorum store's per-rank healset key so healers can
             # stripe a fetch across EVERY live donor, not just the
@@ -1450,6 +1517,7 @@ class Manager:
             self._metrics["slo_breaches_total"] += len(fresh)
             self._fleet_stage = _s("straggler_stage")
             self._fleet_straggler_id = _s("straggler_id")
+        self._consume_sdc_verdict(q)
         if not fresh:
             return
         self._log_event(event="slo_breach", step=self._step,
@@ -1462,18 +1530,215 @@ class Manager:
                               stage=self._fleet_stage,
                               fleet_p95_ms=_num("fleet_p95_ms"))
 
+    def _consume_sdc_verdict(self, q: Any) -> None:
+        """The attestation half of the fleet hint
+        (docs/design/state_attestation.md): the fleet-wide quarantine
+        lists refresh every round (they gate donor selection on EVERY
+        group via :meth:`_donor_admissible`), and the per-group
+        verdict drives this manager's own quarantine latch.
+
+        The verdict field is tri-state: ``True`` latches, ``False``
+        clears a held latch (the lighthouse saw our re-attested digest
+        match the majority), ABSENT (pre-attestation control planes,
+        duck-typed test clients) does nothing — an old lighthouse must
+        not read as an all-clear."""
+        sd = getattr(q, "sdc_diverged", None)
+        rids = getattr(q, "sdc_quarantined", None)
+        addrs = getattr(q, "sdc_quarantined_addrs", None)
+        with self._metrics_lock:
+            if isinstance(rids, str):
+                self._sdc_quarantined_peers = {
+                    r.strip() for r in rids.split(",") if r.strip()}
+            if isinstance(addrs, str):
+                self._sdc_quarantined_bases = {
+                    _addr_base(a.strip()) for a in addrs.split(",")
+                    if a.strip()}
+            latched = self._sdc_quarantined
+            healing = self._healing
+        if not isinstance(sd, bool):
+            return
+        if sd and not latched:
+            self._enter_sdc_quarantine()
+        elif not sd and latched and not healing:
+            # Cleared only once the lighthouse confirms the re-attested
+            # digest matched AND the recovery heal is no longer in
+            # flight (a mid-heal all-clear would re-admit us to the
+            # fold one boundary early, with the restore unapplied).
+            with self._metrics_lock:
+                self._sdc_quarantined = False
+                self._metrics["sdc_quarantined"] = 0.0
+            self._record(sdc_quarantine_clears_total=1)
+            unquarantine = getattr(self._ckpt_server,
+                                   "set_quarantined", None)
+            if unquarantine is not None:
+                unquarantine(False)
+            self._log_event(event="sdc_quarantine_clear",
+                            step=self._step,
+                            digest=self._last_state_digest)
+            logger.info(
+                "%s: divergence verdict cleared at step %d — "
+                "re-attested digest matched the fleet majority",
+                self._replica_id, self._step)
+
+    def _enter_sdc_quarantine(self) -> None:
+        """Latch the quarantine ladder on a fresh divergence verdict:
+        sticky out-of-the-fold latch (the zero-weight path —
+        :meth:`is_participating` goes False via the forced re-heal's
+        healing flag, so :meth:`_wire_weight` contributes 0), withdrawn
+        healset/RAM advertisements (the PR 14 ``-1:`` tombstone
+        spelling) plus a sticky serve-refusal on the checkpoint server
+        (so a peer holding our cached address cannot fetch corrupt
+        bytes either), and one ``sdc_divergence`` flight dump naming
+        the digest the fleet voted against."""
+        with self._metrics_lock:
+            self._sdc_quarantined = True
+            self._metrics["sdc_quarantined"] = 1.0
+        self._record(sdc_quarantines_total=1)
+        # Advertisement withdrawal reuses the graceful-drain spelling:
+        # healset tombstone + publication/RAM-serve detach + shut heal
+        # window. Best-effort by the same contract.
+        self._withdraw_advertisements()
+        quarantine = getattr(self._ckpt_server, "set_quarantined", None)
+        if quarantine is not None:
+            quarantine(True)
+        self._log_event(event="sdc_divergence", step=self._step,
+                        digest=self._last_state_digest)
+        self._flight_dump("sdc_divergence",
+                          digest=self._last_state_digest)
+        logger.error(
+            "%s: DIVERGENCE VERDICT at step %d — this group's state "
+            "digest %s lost the fleet majority vote; quarantining "
+            "(zero-weight fold, refused save/publish/RAM-replication, "
+            "withdrawn advertisements) and re-healing from the "
+            "attested majority", self._replica_id, self._step,
+            self._last_state_digest or "<none>")
+
+    def _sdc_reheal(self, q: Any) -> None:
+        """Quarantine recovery: re-enter the fold as a healer even
+        though the quorum assigned none (a corrupt group is still at
+        max_step — only its BYTES are wrong). Runs the existing
+        max-step heal against donors drawn from the healset
+        advertisements, filtered through :meth:`_donor_admissible` so
+        every donor is an attestation winner — a quarantined group must
+        never heal from another quarantined group. No admissible donor
+        means we stay latched and zero-weighted this boundary and try
+        again next round; healing from nothing beats healing from
+        divergent bytes."""
+        with self._metrics_lock:
+            self._healing = True
+        self._record(sdc_reheals_total=1)
+        donors: list = []
+        try:
+            store = self._healset_client(q)
+            if store is not None:
+                for r in range(q.max_world_size):
+                    if r == q.replica_rank:
+                        continue  # our own (tombstoned) advertisement
+                    try:
+                        v = store.get(f"torchft/healset/{r}",
+                                      timeout_ms=200).decode()
+                    except Exception:  # noqa: BLE001 — absent rank key
+                        continue
+                    step_s, _, a = v.partition(":")
+                    if not self._donor_admissible(a, step_s=step_s,
+                                                  max_step=q.max_step):
+                        continue  # stale/tombstoned/quarantined
+                    if a not in donors:
+                        donors.append(a)
+        except Exception:  # noqa: BLE001 — scrape is best-effort
+            logger.debug("sdc reheal donor scrape failed", exc_info=True)
+        if not donors and getattr(q, "recover_manager_address", ""):
+            try:
+                donors = [self._resolve_checkpoint_addr(
+                    q.recover_manager_address)]
+            except Exception:  # noqa: BLE001 — quarantined/unreachable
+                logger.debug("sdc reheal primary resolve failed",
+                             exc_info=True)
+        if not donors:
+            logger.warning(
+                "%s: no attested donor for quarantine recovery at step "
+                "%d — staying zero-weighted, retrying next boundary",
+                self._replica_id, self._step)
+            return
+        self._record(heal_count=1)
+        heal_t0 = time.perf_counter()
+        heal_stats: Dict[str, float] = {}
+        logger.info("%s: quarantine recovery healing from %d attested "
+                    "donor(s) at step %d", self._replica_id,
+                    len(donors), self._step)
+        with self._tracer.span("sdc_reheal", donors=len(donors),
+                               max_step=q.max_step):
+            target = self._manager_state_dict()
+            state = cast(
+                Dict[str, Any],
+                CheckpointServer.load_from_address(
+                    donors[0], target, stats=heal_stats,
+                    auth_token=self._auth_token,
+                    retry_policy=self._retry_policy,
+                    retry_stats=self._retry_stats,
+                    stall_timeout_sec=self._heal_stall_timeout_sec,
+                    donors=lambda i: None,
+                    max_donor_failovers=0,
+                    donor_addrs=donors if len(donors) > 1 else None,
+                    stripe_seed=_stripe_seed(self._replica_id),
+                    progress_cb=self._heal_progress,
+                    tracer=self._tracer),
+            )
+        heal_ms = (time.perf_counter() - heal_t0) * 1e3
+        self._record(heal_ms_total=heal_ms,
+                     heal_bytes_total=heal_stats.get("bytes", 0.0))
+        self._log_event(event="sdc_reheal", step=self._step,
+                        donors=len(donors), ms=round(heal_ms, 1),
+                        bytes=heal_stats.get("bytes", 0.0))
+        # Same staging convention as the in-quorum heal: manager
+        # metadata restores on this thread, the user pytree applies on
+        # the main thread at the commit boundary.
+        self.load_state_dict(state["torchft"])
+        self._pending_state_dict = state
+
     def _resolve_checkpoint_addr(self, manager_addr: str) -> str:
         """Resolve a peer manager's checkpoint-server URL for this
         rank — the ONE spelling of the ManagerClient round-trip shared
         by the in-quorum heal, the mid-heal donor failover, and the
         pre-join heal (client wiring — timeouts, retry policy, shared
-        counters — must never diverge between them)."""
-        return ManagerClient(
+        counters — must never diverge between them). Raises when the
+        resolved donor is SDC-quarantined: every consumer must treat a
+        divergence-verdicted group as no donor at all, same as a
+        tombstone (:meth:`_donor_admissible`)."""
+        addr = ManagerClient(
             manager_addr,
             connect_timeout_ms=self._timeout_ms,
             retry_policy=self._retry_policy,
             retry_stats=self._retry_stats,
         ).checkpoint_address(self._rank, timeout_ms=self._timeout_ms)
+        if not self._donor_admissible(addr):
+            raise RuntimeError(
+                f"{self._replica_id}: resolved donor {addr} is "
+                "SDC-quarantined (divergence verdict) — refusing to "
+                "heal from unattested state")
+        return addr
+
+    def _donor_admissible(self, addr: str,
+                          step_s: Optional[str] = None,
+                          max_step: Optional[int] = None) -> bool:
+        """The ONE admission predicate every donor resolver shares
+        (in-quorum heal, mid-heal failover, pre-join heal, RAM
+        replication targets): a donor is admissible iff its address is
+        non-empty, its advertisement (when given) is neither the PR 14
+        ``-1:`` withdrawal tombstone nor a stale step, and its server
+        base is not on the lighthouse's SDC quarantine list. One
+        spelling, so no resolver can re-admit a divergent group the
+        others exclude (docs/design/state_attestation.md)."""
+        if not addr:
+            return False
+        if step_s is not None:
+            if not step_s or step_s == "-1":
+                return False  # withdrawn (tombstoned) advertisement
+            if max_step is not None and step_s != str(max_step):
+                return False  # stale advertisement from an older step
+        with self._metrics_lock:
+            quarantined = _addr_base(addr) in self._sdc_quarantined_bases
+        return not quarantined
 
     def _apply_pending_state_dict(self) -> None:
         assert self._pending_state_dict is not None, "no staged state"
@@ -1608,9 +1873,10 @@ class Manager:
                 except Exception:  # noqa: BLE001 — absent rank key
                     continue
                 step_s, _, a = v.partition(":")
-                if step_s != str(q.max_step):
-                    continue  # stale advertisement from an older step
-                if a and a not in addrs:
+                if not self._donor_admissible(a, step_s=step_s,
+                                              max_step=q.max_step):
+                    continue  # stale/tombstoned/quarantined
+                if a not in addrs:
                     addrs.append(a)
         except Exception:  # noqa: BLE001 — resolution is best-effort
             logger.debug("healset donor listing failed", exc_info=True)
@@ -2938,7 +3204,12 @@ class Manager:
             for m in donors:
                 try:
                     a = resolve(m["address"])
-                    if a and a not in addrs:
+                    # Custom resolvers bypass _resolve_checkpoint_addr's
+                    # raise, so the admission predicate runs here too —
+                    # a quarantined max-step member must not seed a
+                    # cold start with divergent bytes.
+                    if a and a not in addrs \
+                            and self._donor_admissible(a):
                         addrs.append(a)
                 except Exception:  # noqa: BLE001 — skip unreachable donor
                     logger.debug("prejoin donor resolve failed",
@@ -3633,19 +3904,70 @@ class Manager:
                                   "publish_count"),
             trace_addr=self._ckpt_server.address(),
         )
+        # State attestation rides the SAME piggyback: the params this
+        # boundary committed, fingerprinted on device, keyed by the
+        # quorum epoch so the lighthouse only ballots digests from the
+        # same configuration (docs/design/state_attestation.md).
+        attest_kw = dict(
+            quorum_id=self._quorum_id,
+            state_digest=self._compute_state_digest(),
+        )
         try:
             try:
                 # RAM-tier fan-in rides the same digest (-1 = tier off)
                 # so the fleet plane sees a replication-set collapse;
-                # the TypeError retry keeps older control planes that
-                # predate the field working unchanged.
+                # the TypeError retry ladder keeps older control planes
+                # that predate each field generation working unchanged:
+                # first the full spelling, then attestation without the
+                # (still unplumbed) ram_peers field, then the bare
+                # pre-attestation digest.
                 set_digest(ram_peers=int(mx["ram_ckpt_peers"])
                            if "ram_ckpt_peers" in mx else -1,
-                           **kwargs)
+                           **attest_kw, **kwargs)
             except TypeError:
-                set_digest(**kwargs)
+                try:
+                    set_digest(**attest_kw, **kwargs)
+                except TypeError:
+                    set_digest(**kwargs)
         except Exception:  # noqa: BLE001 — observability never fails
             logger.debug("digest push failed", exc_info=True)
+
+    def _compute_state_digest(self) -> str:
+        """Fingerprint the committed params into the 32-hex attestation
+        digest (4 u32 words — docs/design/state_attestation.md), or
+        ``""`` when attestation is off / the state has no array leaves /
+        anything at all goes wrong: an absent digest makes this group a
+        non-voter at the lighthouse, never a step failure. Device trees
+        take the fused jitted path (:func:`_attest_device_words`, D2H =
+        16 bytes); host/mixed trees fall back to the numpy reference
+        the kernel is parity-frozen against."""
+        if not self._attestation:
+            return ""
+        try:
+            t0 = time.monotonic()
+            leaves = [
+                leaf for leaf in jax.tree_util.tree_leaves(
+                    self._user_state_dict())
+                if serialization._is_array_leaf(leaf)
+                and getattr(leaf, "nbytes", 0)
+            ]
+            if not leaves:
+                return ""
+            if all(isinstance(x, jax.Array) for x in leaves):
+                words = np.asarray(_attest_device_words(leaves),
+                                   dtype=np.uint32)
+                digest = serialization.attest_combine(
+                    [int(w) for w in words])
+            else:
+                digest = serialization.attest_fingerprint(leaves)
+            self._record(
+                sdc_digests_total=1,
+                sdc_digest_ms_total=(time.monotonic() - t0) * 1e3)
+            self._last_state_digest = digest
+            return digest
+        except Exception:  # noqa: BLE001 — attestation never fails a step
+            logger.debug("state digest failed", exc_info=True)
+            return ""
 
     def metrics(self) -> Dict[str, float]:
         """Snapshot of counters + cumulative timings (ms): quorum rounds,
@@ -3720,6 +4042,8 @@ class Manager:
             _PACK_STATS["pack_cache_misses"])
         out["allreduce_d2h_async_fallbacks"] = float(
             _PACK_STATS["d2h_async_fallbacks"])
+        out["sdc_digest_cache_misses"] = float(
+            _PACK_STATS["sdc_digest_cache_misses"])
         # Durable-writer counters (saves, fatal ENOSPC/EROFS class,
         # stalls, bytes) + its sticky last error, so /metrics.json shows
         # a dying checkpoint disk long before the next cold start needs
@@ -3908,10 +4232,9 @@ class Manager:
                 except Exception:  # noqa: BLE001 — absent rank key
                     continue
                 step_s, _, a = v.partition(":")
-                if step_s == "-1" or not a:
-                    continue  # withdrawn (tombstoned) or malformed
-                base = (a.rsplit("/checkpoint/", 1)[0]
-                        if "/checkpoint/" in a else a.rstrip("/"))
+                if not self._donor_admissible(a, step_s=step_s):
+                    continue  # withdrawn/quarantined or malformed
+                base = _addr_base(a)
                 if base and base not in bases:
                     bases.append(base)
         except Exception:  # noqa: BLE001 — discovery is best-effort
@@ -3932,20 +4255,26 @@ class Manager:
             return None
         with self._metrics_lock:
             healing = self._healing
+            quarantined = self._sdc_quarantined
         committed = self._should_step
         deferred = self.deferred_pending()
         if healing or self._errored is not None or not committed \
-                or deferred:
+                or deferred or quarantined:
             logger.warning(
                 "%s: skipping RAM replication at step %d (healing=%s "
-                "errored=%s committed=%s deferred=%s) — state is not a "
-                "settled committed step's", self._replica_id, self._step,
-                healing, self._errored is not None, committed, deferred)
+                "errored=%s committed=%s deferred=%s quarantined=%s) — "
+                "state is not a settled committed step's",
+                self._replica_id, self._step, healing,
+                self._errored is not None, committed, deferred,
+                quarantined)
             self._record(ram_replicate_skipped=1)
+            if quarantined:
+                self._record(sdc_refusals_total=1)
             self._log_event(
                 event="ram_replicate_skip", step=self._step,
                 healing=healing, errored=self._errored is not None,
-                committed=committed, deferred=deferred)
+                committed=committed, deferred=deferred,
+                quarantined=quarantined)
             return None
         meta = {
             "committed": True,
@@ -4003,6 +4332,68 @@ class Manager:
                 "%s: RAM replication dispatch failed at step %d",
                 self._replica_id, self._step, exc_info=True)
 
+    # --------------------------------------------- sdc chaos injection
+
+    def _maybe_chaos_sdc(self) -> None:
+        """:meth:`step`'s chaos hook for the attestation plane: poll
+        the ``sdc`` chaos channel once per commit boundary and, on an
+        ``sdc_flip`` decision, flip ONE bit of one committed param
+        leaf. Participants only — a healer/spare is mid-restore and
+        the injection contract (chaos.sdc_fault) is post-commit state,
+        so corruption there would model a fault the vote deliberately
+        abstains on. No schedule / no config for this endpoint = no
+        decision draw, keeping every other channel's fault sequence
+        byte-identical with the band off (stream purity)."""
+        with self._metrics_lock:
+            healing = self._healing
+            quarantined = self._sdc_quarantined
+        if healing or quarantined:
+            return
+        try:
+            from torchft_tpu import chaos as chaos_mod
+
+            d = chaos_mod.sdc_fault(f"sdc:{self._replica_id}")
+            if d is None:
+                return
+            self._apply_sdc_flip(d.frac)
+        except Exception:  # noqa: BLE001 — chaos never fails a step
+            logger.debug("sdc chaos injection failed", exc_info=True)
+
+    def _apply_sdc_flip(self, frac: float) -> None:
+        """Deterministically corrupt one bit of the committed params:
+        the (leaf, byte, bit) choice is a pure function of the
+        decision's ``frac`` draw, so a seeded schedule reproduces the
+        exact same corruption run over run (the soak's determinism
+        contract). The flipped leaf is re-placed like the original
+        (device arrays stay device, host stays host) and loaded back
+        through the registered ``load_state_dict`` — the corruption is
+        indistinguishable from a real in-memory flip by the time the
+        digest sees it."""
+        leaves, treedef = jax.tree_util.tree_flatten(
+            self._user_state_dict())
+        idxs = [i for i, leaf in enumerate(leaves)
+                if serialization._is_array_leaf(leaf)
+                and getattr(leaf, "nbytes", 0)]
+        if not idxs:
+            return
+        li = idxs[int(frac * len(idxs)) % len(idxs)]
+        leaf = leaves[li]
+        a = np.array(leaf)  # contiguous host copy, any dtype
+        b = a.view(np.uint8).reshape(-1)
+        byte = int(frac * b.size) % b.size
+        bit = int(frac * 8) % 8
+        b[byte] ^= np.uint8(1 << bit)
+        leaves[li] = (serialization.device_put_like(a, leaf)
+                      if isinstance(leaf, jax.Array) else a)
+        self._user_load_state_dict(
+            jax.tree_util.tree_unflatten(treedef, leaves))
+        self._record(sdc_chaos_flips_total=1)
+        self._log_event(event="sdc_chaos_flip", step=self._step,
+                        leaf=li, byte=byte, bit=bit)
+        logger.warning(
+            "%s: chaos sdc_flip at step %d — leaf %d byte %d bit %d",
+            self._replica_id, self._step, li, byte, bit)
+
     # ------------------------------------------------- durable checkpoints
 
     def save_durable(self, writer: Any, directory: str,
@@ -4030,27 +4421,34 @@ class Manager:
         :func:`torchft_tpu.checkpoint_io.recover` directly)."""
         with self._metrics_lock:
             healing = self._healing
+            quarantined = self._sdc_quarantined
         committed = self._should_step
         deferred = self.deferred_pending()
-        if healing or self._errored is not None or not committed or deferred:
+        if healing or self._errored is not None or not committed \
+                or deferred or quarantined:
             # A deferred allreduce in flight means the manager metadata
             # (step already advanced) and the params (update not yet
             # applied) describe DIFFERENT steps: a snapshot now would
             # cold-start at step N+1 with step-N weights. Callers flush
             # the deferred step first (DelayedOptimizer.flush /
-            # FTTrainer.flush), then save.
+            # FTTrainer.flush), then save. A divergence verdict
+            # (quarantined) means the bytes themselves lost the fleet
+            # vote — persisting them would make the corruption durable.
             logger.warning(
                 "%s: skipping durable snapshot at step %d "
-                "(healing=%s errored=%s committed=%s deferred=%s) — state "
-                "is not a settled committed step's%s", self._replica_id,
+                "(healing=%s errored=%s committed=%s deferred=%s "
+                "quarantined=%s) — state is not a settled committed "
+                "step's%s", self._replica_id,
                 self._step, healing, self._errored is not None, committed,
-                deferred,
+                deferred, quarantined,
                 " (flush() the deferred step first)" if deferred else "")
             self._record(ckpt_save_skipped=1)
+            if quarantined:
+                self._record(sdc_refusals_total=1)
             self._log_event(
                 event="ckpt_skip", step=self._step, healing=healing,
                 errored=self._errored is not None, committed=committed,
-                deferred=deferred)
+                deferred=deferred, quarantined=quarantined)
             return None
         self._ckpt_writer = writer
         # Remember the target: the graceful preemption drain's FINAL
@@ -4112,19 +4510,24 @@ class Manager:
         refused."""
         with self._metrics_lock:
             healing = self._healing
+            quarantined = self._sdc_quarantined
         committed = self._should_step
         deferred = self.deferred_pending()
-        if healing or self._errored is not None or not committed or deferred:
+        if healing or self._errored is not None or not committed \
+                or deferred or quarantined:
             logger.warning(
                 "%s: skipping publish at step %d (healing=%s errored=%s "
-                "committed=%s deferred=%s) — state is not a settled "
-                "committed step's", self._replica_id, self._step, healing,
-                self._errored is not None, committed, deferred)
+                "committed=%s deferred=%s quarantined=%s) — state is not "
+                "a settled committed step's", self._replica_id, self._step,
+                healing, self._errored is not None, committed, deferred,
+                quarantined)
             self._record(publish_skipped=1)
+            if quarantined:
+                self._record(sdc_refusals_total=1)
             self._log_event(
                 event="publish_skip", step=self._step, healing=healing,
                 errored=self._errored is not None, committed=committed,
-                deferred=deferred)
+                deferred=deferred, quarantined=quarantined)
             return None
         self._publisher = publisher
         attach = getattr(self._ckpt_server, "attach_publication", None)
@@ -4353,9 +4756,14 @@ class Manager:
             return rank, self._batches_committed, self._capacity_fraction
 
     def is_participating(self) -> bool:
-        """False while healing (async) or benched as a spare (reference
-        ``manager.py:520-532``)."""
+        """False while healing (async), benched as a spare (reference
+        ``manager.py:520-532``), or latched out of the fold by a
+        divergence verdict (the quarantine rides the same zero-weight
+        path: ``_wire_weight() == 0`` until the re-heal lands and the
+        lighthouse clears the verdict)."""
         if self._participating_rank is None:
+            return False
+        if self._sdc_quarantined:
             return False
         if self._healing:
             assert self._use_async_quorum
@@ -4450,8 +4858,14 @@ _PACK_FNS: Dict[str, Any] = {}
 #   d2h_async_fallbacks — buckets whose copy_to_host_async did NOT run
 #     (API absent or transient failure): their D2H serializes into the
 #     fetch-wait stage instead of overlapping the ring.
+#   sdc_digest_cache_misses — TRACES of the cached jitted attestation
+#     digest fn (_attest_device_words). Same tripwire contract as
+#     pack_cache_misses: steady state is one trace per param-tree
+#     signature; a climbing count means the digest is recompiling every
+#     boundary and its <2% overhead budget is gone.
 _PACK_STATS: Dict[str, int] = {"pack_cache_misses": 0,
-                               "d2h_async_fallbacks": 0}
+                               "d2h_async_fallbacks": 0,
+                               "sdc_digest_cache_misses": 0}
 # Incremented from concurrent Manager worker threads (and jit tracing);
 # a bare `+= 1` is a non-atomic read-modify-write that can undercount —
 # and these exist as regression tripwires, where an undercount masks
@@ -4462,6 +4876,19 @@ _PACK_STATS_LOCK = threading.Lock()
 def _pack_stat_bump(key: str) -> None:
     with _PACK_STATS_LOCK:
         _PACK_STATS[key] += 1
+
+
+def _addr_base(addr: str) -> str:
+    """Canonical server base of any checkpoint-plane URL — the ONE
+    spelling shared by the quarantine ledger and every donor resolver,
+    so a group quarantined by its trace address is recognized no matter
+    which route (``…/checkpoint/{step}``, ``…/ramckpt/{step}``, bare
+    base) a consumer holds."""
+    if "/checkpoint/" in addr:
+        return addr.rsplit("/checkpoint/", 1)[0]
+    if "/ramckpt/" in addr:
+        return addr.rsplit("/ramckpt/", 1)[0]
+    return addr.rstrip("/")
 
 
 def _transfer_dtype(wire: Any) -> Optional[np.dtype]:
@@ -4508,6 +4935,96 @@ def _pack_leaves(leaves: list, wire_dtype_str: str) -> Any:
             return buf
 
         fn = _PACK_FNS[wire_dtype_str] = jax.jit(pack)
+    return fn(leaves)
+
+
+_ATTEST_FNS: Dict[str, Any] = {}
+
+
+def _attest_device_words(leaves: list) -> Any:
+    """Device-fused state-attestation fingerprint: ONE cached jitted
+    dispatch bitcasts every committed param leaf to raw bytes, reduces
+    each to three u32 words (byte sum, position-weighted byte sum,
+    byte count) and folds them across leaves in pytree order into four
+    u32 accumulator words — so the only D2H the attestation plane ever
+    pays is 16 bytes, never a second copy of the state. The arithmetic
+    mirrors :func:`serialization.attest_fingerprint` word for word
+    (u32 wraparound is associative, so XLA's per-add wrap agrees with
+    numpy's u64-sum-then-mask; frozen by tests/test_attestation.py) —
+    groups hash the SAME committed bytes to the SAME 32-hex digest or
+    the lighthouse vote is meaningless. Jit re-specializes per
+    param-tree signature, counted by the trace-time
+    ``sdc_digest_cache_misses`` bump like ``_pack_leaves``."""
+    fn = _ATTEST_FNS.get("attest")
+    if fn is None:
+        prime = np.uint32(serialization.ATTEST_FNV_PRIME)
+
+        def leaf_words(x):
+            # Word-based spelling of the byte fingerprint: every sum is
+            # mod 2^32 anyway, so the per-BYTE reference
+            #   w0 = sum(b_i),  w1 = sum((i+1) * b_i)
+            # regroups exactly into per-UNIT terms (unit = the widest
+            # lane the dtype bitcasts to, <= 4 bytes): for unit j of
+            # size s covering bytes s*j..s*j+s-1,
+            #   w1 contribution = s*j * bytesum_j + intra_j
+            # with intra_j the (k+1)-weighted sum INSIDE the unit. That
+            # turns N byte-lane ops (u8 upcasts + an N-long iota
+            # multiply — the slow path XLA:CPU vectorizes poorly) into
+            # ~N/s u32-lane shifts/masks — measured ~5x faster per MB
+            # — while staying bitwise-identical to
+            # serialization.attest_leaf_words.
+            if x.dtype == jnp.bool_:
+                x = x.astype(jnp.uint8)
+            s = jnp.dtype(x.dtype).itemsize
+            if s == 1:
+                u = jax.lax.bitcast_convert_type(
+                    x, jnp.uint8).ravel().astype(jnp.uint32)
+                bs = intra = u
+                s = 1
+            elif s == 2:
+                u = jax.lax.bitcast_convert_type(
+                    x, jnp.uint16).ravel().astype(jnp.uint32)
+                b0 = u & 0xFF
+                b1 = (u >> 8) & 0xFF
+                bs = b0 + b1
+                intra = b0 + 2 * b1
+            else:
+                # 4-byte dtypes bitcast 1:1; 8-byte dtypes gain a
+                # trailing lane dim ordered least-significant-first,
+                # which ravel() lays out in little-endian byte order —
+                # the same order the u8 reference reads.
+                u = jax.lax.bitcast_convert_type(x, jnp.uint32).ravel()
+                b0 = u & 0xFF
+                b1 = (u >> 8) & 0xFF
+                b2 = (u >> 16) & 0xFF
+                b3 = (u >> 24) & 0xFF
+                bs = b0 + b1 + b2 + b3
+                intra = b0 + 2 * b1 + 3 * b2 + 4 * b3
+                s = 4
+            m = int(u.shape[0])
+            j = jnp.arange(m, dtype=jnp.uint32)
+            w0 = jnp.sum(bs, dtype=jnp.uint32)
+            w1 = (jnp.uint32(s) * jnp.sum(j * bs, dtype=jnp.uint32)
+                  + jnp.sum(intra, dtype=jnp.uint32))
+            return w0, w1, jnp.uint32((m * s) & 0xFFFFFFFF)
+
+        def attest(ls):
+            # Trace-time side effect: counts digest-executable cache
+            # misses exactly like _pack_leaves (compiles once per
+            # param-tree signature, never on steady-state dispatch).
+            _pack_stat_bump("sdc_digest_cache_misses")
+            acc = [jnp.uint32(serialization.ATTEST_FNV_BASIS)
+                   for _ in range(4)]
+            for x in ls:
+                w0, w1, n32 = leaf_words(x)
+                rot1 = (w1 << np.uint32(1)) | (w1 >> np.uint32(31))
+                acc = [acc[0] * prime + w0,
+                       acc[1] * prime + w1,
+                       acc[2] * prime + n32,
+                       (acc[3] ^ w0 ^ rot1) * prime]
+            return jnp.stack(acc)
+
+        fn = _ATTEST_FNS["attest"] = jax.jit(attest)
     return fn(leaves)
 
 

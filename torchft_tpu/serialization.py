@@ -164,6 +164,74 @@ def manifest_from(plan: PytreePlan,
     }
 
 
+# ------------------------------------------------- state attestation
+# docs/design/state_attestation.md: the cross-group committed-params
+# fingerprint. Per leaf, over the RAW little-endian bytes:
+#   w0 = sum(byte_i)            mod 2^32   (catches every single-byte
+#                                           corruption outright)
+#   w1 = sum((i+1) * byte_i)    mod 2^32   (position-weighted: catches
+#                                           transposed / relocated bytes)
+# folded across leaves in pytree order with FNV-style u32 multiply-add
+# into FOUR accumulator words (the two sums, the byte-length chain, and
+# a rotate-xor mix). ALL arithmetic is u32 wraparound — exact on every
+# backend, so the jitted device fold in manager.py and this NumPy
+# reference are bit-identical (frozen by tests/test_attestation.py).
+# crc32 (the heal/publish manifests above) is NOT reused here: it is
+# inherently sequential per leaf, while these sums are one fused
+# data-parallel reduction a jitted kernel can run on device without an
+# extra D2H of the params.
+
+ATTEST_FNV_PRIME = 0x01000193
+ATTEST_FNV_BASIS = 0x811C9DC5
+_M32 = 0xFFFFFFFF
+
+
+def attest_leaf_words(arr: Any) -> Tuple[int, int, int]:
+    """``(w0, w1, nbytes mod 2^32)`` of one leaf's raw bytes — the
+    NumPy reference spelling of the device kernel's per-leaf stage."""
+    a = np.asarray(arr)
+    b = np.frombuffer(a.tobytes(), dtype=np.uint8).astype(np.uint64)
+    n = b.size
+    w0 = int(b.sum()) & _M32
+    pos = (np.arange(n, dtype=np.uint64) + 1) & _M32
+    # u64 products are exact (< 2^40); a u64 sum that wraps still
+    # agrees mod 2^32 with the device's per-add u32 wraparound.
+    w1 = int((pos * b).sum()) & _M32
+    return w0, w1, n & _M32
+
+
+def attest_fold(acc: List[int], w0: int, w1: int, n32: int) -> List[int]:
+    """Fold one leaf's words into the 4-word accumulator (u32
+    wraparound multiply-add; the device kernel runs the same ops in
+    ``uint32``)."""
+    p = ATTEST_FNV_PRIME
+    rot = ((w1 << 1) | (w1 >> 31)) & _M32
+    return [
+        (acc[0] * p + w0) & _M32,
+        (acc[1] * p + w1) & _M32,
+        (acc[2] * p + n32) & _M32,
+        ((acc[3] ^ w0 ^ rot) * p) & _M32,
+    ]
+
+
+def attest_combine(words: Any) -> str:
+    """Render the 4 accumulator words as the 32-hex-char state digest
+    string every StepDigest carries — one spelling for the device path
+    (manager.py hands the fetched u32 words here) and the reference."""
+    return "".join(f"{int(w) & _M32:08x}" for w in words)
+
+
+def attest_fingerprint(leaves: List[Any]) -> str:
+    """NumPy reference of the full committed-state fingerprint: fold
+    every array leaf (pytree order) and combine. The oracle the jitted
+    device digest is frozen against, and the host fallback when a
+    state tree holds no device arrays at all."""
+    acc = [ATTEST_FNV_BASIS] * 4
+    for leaf in leaves:
+        acc = attest_fold(acc, *attest_leaf_words(leaf))
+    return attest_combine(acc)
+
+
 def manifest_delta(old: Optional[dict], new: dict) -> dict:
     """Changed-leaf summary between two digest manifests of the same
     pytree structure — the delta-publication primitive
