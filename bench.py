@@ -61,13 +61,17 @@ def _provenance() -> Dict[str, Any]:
     stamp are schema v1), the PROCESS-WIDE tracing default (rows whose
     scenario overrides it per-run — e.g. the trace A/B's legs — carry
     the truth in their own fields, which win over this stamp in
-    _emit), and the flight-recorder dump directory in force ("" =
-    flight recording off) so an incident row points at its postmortem
-    artifacts."""
+    _emit), the host CPU count (a "cpu" platform row from a 16-core
+    box and one from a 1-core box are different rigs for every
+    throughput metric — benchdiff treats a host-shape change like a
+    platform change, skipped-not-gated), and the flight-recorder dump
+    directory in force ("" = flight recording off) so an incident row
+    points at its postmortem artifacts."""
     if not _PROVENANCE:
         _PROVENANCE.update({
             "platform": jax.devices()[0].platform,
             "device_kind": jax.devices()[0].device_kind,
+            "host_cpus": os.cpu_count() or 1,
             "jax": jax.__version__,
             "schema": _BENCH_SCHEMA,
             "tracing_enabled": _tracing_default(),
@@ -683,6 +687,164 @@ def bench_degraded_goodput(n_groups: int = 2, steps: int = 12,
         # What whole-group eviction of the wounded group would leave.
         "eviction_ratio": (n_groups - 1) / n_groups,
         "capacity_fractions": dict(caps),
+    }
+
+
+# --------------------------------------------------------------- scenario 1c
+
+def bench_rebalance_goodput(n_groups: int = 4, rounds: int = 60,
+                            batch_size: int = 32,
+                            slow_factor: float = 2.0,
+                            tail: int = 20) -> Dict[str, float]:
+    """Straggler-rebalancing goodput A/B
+    (docs/design/fleet_rebalance.md), native-free: N lockstep groups
+    with ONE persistently slow member, driven on a simulated clock
+    through the real control loop — the ``fleet.Rebalancer`` ladder
+    (adoption lagging one boundary, the decider-publish protocol's
+    documented skew), real ``ElasticSampler`` draws sized by the
+    assigned fraction, per-group walls proportional to samples drawn
+    x per-sample cost. The uniform leg is plain lockstep data
+    parallelism: every boundary waits for the slow group's full
+    batch. The rebalance leg trims the straggler's slice toward the
+    floor and reallocates it to the headroom groups, so the fleet
+    boundary wall tracks the (boosted) fast groups instead.
+
+    Headline: steady-tail committed-samples/sec vs the uniform leg
+    (gate ``rebalance_ratio >= 0.8``; with walls this imbalanced it
+    lands well ABOVE 1.0 — nonuniform parallelism strictly beats
+    lockstep), the fraction floor (never below 0.5), ZERO table
+    changes across the settled tail, and the weighted fold at the
+    final composed weights bitwise against the single-process oracle
+    over real socketpair rings."""
+    import socket as _socket
+
+    from torchft_tpu import fleet
+    from torchft_tpu.backends.host import HostCommunicator, _Ring
+    from torchft_tpu.data import ElasticSampler
+
+    rids = [f"rb{i}" for i in range(n_groups)]
+    slow_rid = rids[-1]
+    cost_ms = {rid: (slow_factor if rid == slow_rid else 1.0)
+               for rid in rids}
+    overhead_ms = 5.0  # quorum + vote floor, fraction-independent
+
+    class _Slot:
+        """Duck-typed manager: the atomic slot snapshot the sampler
+        draws by, recording the reported fold weight."""
+
+        def __init__(self, rank: int) -> None:
+            self.rank, self.committed, self.frac = rank, 0, 1.0
+            self.samples: Optional[int] = None
+
+        def participant_slot(self):
+            return (self.rank, self.committed, self.frac)
+
+        def set_step_samples(self, n: int) -> None:
+            self.samples = int(n)
+
+    # Uniform leg: every group draws the full batch, the boundary wall
+    # is the straggler's.
+    uniform_wall_ms = overhead_ms + batch_size * max(cost_ms.values())
+    uniform_per_s = (n_groups * batch_size) / (uniform_wall_ms / 1e3)
+
+    # Rebalance leg.
+    rb = fleet.Rebalancer()
+    slots = {rid: _Slot(i) for i, rid in enumerate(rids)}
+    samplers = {rid: ElasticSampler(batch_size * 64, slots[rid],
+                                    batch_size=batch_size, seed=0)
+                for rid in rids}
+    assigned = {rid: 1.0 for rid in rids}
+    committed = 0
+    min_fraction = 1.0
+    tail_samples = 0
+    tail_wall_ms = 0.0
+    seq_at_tail = None
+    for k in range(1, rounds + 1):
+        draws: Dict[str, int] = {}
+        walls: Dict[str, float] = {}
+        for rid in rids:
+            s = slots[rid]
+            # The fraction adopted at the PREVIOUS boundary is the one
+            # this draw runs under (one-boundary adoption lag).
+            s.frac = assigned[rid]
+            s.committed = committed
+            idx = samplers[rid].next_indices()
+            draws[rid] = len(idx)
+            walls[rid] = overhead_ms + len(idx) * cost_ms[rid]
+            if abs(s.frac - 1.0) > 1e-9 and s.samples != len(idx):
+                raise RuntimeError(
+                    "sampler did not report its draw as the fold "
+                    f"weight ({s.samples} != {len(idx)})")
+        if k > rounds - tail and seq_at_tail is None:
+            seq_at_tail = rb.seq
+        assigned = rb.observe(
+            [(rid, k, walls[rid], slots[rid].frac, True)
+             for rid in rids])
+        min_fraction = min(min_fraction, min(assigned.values()))
+        committed += n_groups
+        if k > rounds - tail:
+            tail_samples += sum(draws.values())
+            tail_wall_ms += max(walls.values())
+    tail_flaps = rb.seq - (seq_at_tail if seq_at_tail is not None
+                           else rb.seq)
+    rebalance_per_s = tail_samples / (tail_wall_ms / 1e3)
+
+    # The weighted fold at the settled composed weights, bitwise on
+    # every rank over real socketpair rings vs the documented oracle
+    # (sum of w_r * x_r in rank order, true-divided by the total).
+    weights = [int(round(batch_size * assigned[rid])) for rid in rids]
+    rng = np.random.default_rng(7)
+    xs = [rng.normal(size=4_099).astype(np.float32)
+          for _ in range(n_groups)]
+    pairs = [_socket.socketpair() for _ in range(n_groups)]
+    rings = [_Ring(pairs[r][0], pairs[(r - 1) % n_groups][1],
+                   _socket.socket())
+             for r in range(n_groups)]
+    comms = []
+    for r in range(n_groups):
+        c = HostCommunicator(timeout_sec=15)
+        c._rank, c._world = r, n_groups
+        comms.append(c)
+    out: list = [None] * n_groups
+
+    def fold(r: int) -> None:
+        out[r] = comms[r]._do_allreduce_wire(
+            rings[r], [xs[r].copy()], [np.dtype(np.float32)], "sum",
+            "step", weights[r])
+
+    ts = [threading.Thread(target=fold, args=(r,))
+          for r in range(n_groups)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=30)
+    for ring in rings:
+        ring.close()
+    for c in comms:
+        c.shutdown()
+    acc = np.zeros(4_099, np.float32)
+    for w, x in zip(weights, xs):
+        if w:
+            acc += x * np.float32(w)
+    acc /= np.float32(sum(weights))
+    bitwise = all(o is not None and np.array_equal(o[0], acc)
+                  for o in out)
+
+    return {
+        "n_groups": n_groups,
+        "slow_factor": slow_factor,
+        "rounds": rounds,
+        "tail_rounds": tail,
+        "uniform_samples_per_s": uniform_per_s,
+        "rebalance_samples_per_s": rebalance_per_s,
+        "rebalance_ratio": rebalance_per_s / max(uniform_per_s, 1e-9),
+        "min_fraction": min_fraction,
+        "floor": fleet.REBALANCE_FLOOR,
+        "tail_flaps": tail_flaps,
+        "shrinks_total": rb.shrinks_total,
+        "restores_total": rb.restores_total,
+        "adoption_lag_boundaries": 1,
+        "bitwise_identical": bitwise,
     }
 
 
@@ -2820,6 +2982,29 @@ def main() -> None:
            "digest_ms_med": round(so["digest_ms_med"], 2),
            "overhead_frac": round(so["overhead_frac"], 4),
            "target_max_overhead_frac": 0.02})
+
+    # Straggler-rebalancing goodput A/B (docs/design/fleet_rebalance.md):
+    # one 2x-slow group, the real Rebalancer ladder + ElasticSampler
+    # draws on a simulated clock vs lockstep uniform parallelism.
+    # Gate: rebalance_ratio >= 0.8 (it lands well above 1.0), fraction
+    # never below the floor, zero tail flaps, fold bitwise. Native-free.
+    rg = bench_rebalance_goodput()
+    _emit({"metric": "rebalance_goodput_ab",
+           "n_groups": rg["n_groups"],
+           "slow_factor": rg["slow_factor"],
+           "uniform_samples_per_s": round(
+               rg["uniform_samples_per_s"], 1),
+           "rebalance_samples_per_s": round(
+               rg["rebalance_samples_per_s"], 1),
+           "rebalance_ratio": round(rg["rebalance_ratio"], 3),
+           "target_min_ratio": 0.8,
+           "min_fraction": rg["min_fraction"],
+           "floor": rg["floor"],
+           "tail_flaps": rg["tail_flaps"],
+           "shrinks_total": rg["shrinks_total"],
+           "restores_total": rg["restores_total"],
+           "adoption_lag_boundaries": rg["adoption_lag_boundaries"],
+           "bitwise_identical": rg["bitwise_identical"]})
 
     # Control-plane scale (docs/design/control_plane.md): quorum latency
     # vs N simulated manager groups with the membership-unchanged fast

@@ -33,9 +33,13 @@ grow with the repo).
 Rows are compared only when their provenance stamps agree: a metric
 pair whose ``schema`` tags differ (rows predating the stamp are
 schema v1), whose ``platform``/``device_kind`` changed (a TPU round
-followed by a CPU-only rig is a rig change, not a regression), or
-where either side is an error stub (a bench that could not run) is
-reported as ``skipped`` and never gated.
+followed by a CPU-only rig is a rig change, not a regression), whose
+``host_cpus`` stamp changed (same ``platform`` string, different
+machine shape — a 1-core container cannot reproduce a 16-core
+round's throughput rows; like ``schema``, rows predating the stamp
+are unstamped and cannot be host-matched, so stamped-vs-unstamped
+also skips), or where either side is an error stub (a bench that
+could not run) is reported as ``skipped`` and never gated.
 
 Directory mode diffs every adjacent pair of the sorted trajectory but
 gates (exit code) only the NEWEST pair by default — an old, already
@@ -154,6 +158,14 @@ def _incomparable(o_row: Dict[str, Any],
         ov, nv = o_row.get(k), n_row.get(k)
         if ov is not None and nv is not None and ov != nv:
             return f"rig changed: {k} {ov} -> {nv}"
+    # Host shape is strict like schema, not lenient like platform: an
+    # unstamped row's host is UNKNOWN, and gating a 1-core round
+    # against an unknown-(likely larger)-host round manufactures
+    # permanent "regressions" no commit can fix.
+    o_cpus, n_cpus = o_row.get("host_cpus"), n_row.get("host_cpus")
+    if o_cpus != n_cpus:
+        return (f"host shape changed: {o_cpus or 'unstamped'} -> "
+                f"{n_cpus or 'unstamped'} cpus")
     return None
 
 

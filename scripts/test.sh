@@ -315,6 +315,28 @@ if [[ "${1:-}" == "sdc" ]]; then
     exit 0
 fi
 
+# Rebalance tier: the straggler-aware fleet-rebalancing arc's focused
+# gate (docs/design/fleet_rebalance.md) — the pure-Python Rebalancer
+# ladder frozen against the C++ lighthouse mirror (the same snapshot
+# literals core_test.cc pins), the fraction-table wire format, the
+# Manager's decider-publishes/all-adopt commit-boundary protocol with
+# save_durable's refusal classes, the composed capacity x rebalance
+# effective fraction through participant_slot, ElasticSampler
+# fractional/boost draws reporting exact fold weights, the chaos
+# `slow:` band (spec parse, stream purity, natural-wall stretch), and
+# the composed-fraction bitwise weighted-fold oracle over socketpair
+# rings. Tier-1 and native-free (not marked slow); run this tier on
+# fleet/manager/data/chaos changes. The C++ Rebalancer parity matrix
+# is in the `core` tier; the PhasedChaos shrink -> restore zero-flap
+# soak rides nightly.
+if [[ "${1:-}" == "rebalance" ]]; then
+    stage rebalance env JAX_PLATFORMS=cpu \
+        python -m pytest tests/test_rebalance.py -q \
+        -m "rebalance and not slow"
+    echo "== total: ${SECONDS}s"
+    exit 0
+fi
+
 # Heal-soak tier: seeded chaos soak of repeated heals with donor churn —
 # every round the primary donor is killed mid-stream while resets/short
 # reads pepper the heal channel; each heal must complete bitwise-

@@ -216,6 +216,9 @@ class TestPublishFanoutSmoke:
         # the flight recorder would dump ("" = off).
         assert isinstance(row["tracing_enabled"], bool)
         assert "flight_dir" in row
+        # host shape: benchdiff skips throughput comparisons across
+        # machine-shape changes, so every row must carry its cpu count
+        assert row["host_cpus"] >= 1
 
 
 class TestBenchdiffSmoke:

@@ -405,6 +405,9 @@ FLEET_METRIC_NAMES = frozenset([
     "torchft_fleet_slo_breaches_total",
     "torchft_fleet_sdc_quarantined",
     "torchft_fleet_sdc_verdicts_total",
+    "torchft_fleet_rebalance_groups",
+    "torchft_fleet_rebalance_seq",
+    "torchft_fleet_rebalance_fraction",
     "torchft_fleet_stage_median_ms",
     "torchft_fleet_straggler_score", "torchft_fleet_group_step_ms",
 ])
@@ -891,6 +894,36 @@ class TestBenchdiff:
         d = bd.diff_rows(cpu, slow, threshold=0.10)
         assert not d["skipped"]
         assert len(d["improvements"]) == 1
+
+    def test_host_shape_change_skips_not_gates(self):
+        """Same "cpu" platform string on a different machine shape is
+        still a rig change: a 1-core container cannot reproduce a
+        16-core round's throughput rows. Strict like schema — an
+        unstamped row's host is unknown, so stamped-vs-unstamped also
+        skips rather than manufacturing a permanent regression."""
+        bd = self._bd()
+
+        def row(v, cpus=None):
+            r = {"metric": "m", "steps_per_s": v,
+                 "schema": "tft-bench-2", "platform": "cpu"}
+            if cpus is not None:
+                r["host_cpus"] = cpus
+            return {"m": r}
+
+        # both stamped, shapes differ -> skipped
+        d = bd.diff_rows(row(100.0, cpus=16), row(10.0, cpus=1), 0.10)
+        assert not d["regressions"]
+        assert "host shape changed: 16 -> 1 cpus" == \
+            d["skipped"][0]["reason"]
+        # unstamped old vs stamped new (rows predate the stamp) ->
+        # skipped, never a regression
+        d = bd.diff_rows(row(100.0), row(10.0, cpus=1), 0.10)
+        assert not d["regressions"]
+        assert "unstamped -> 1 cpus" in d["skipped"][0]["reason"]
+        # both stamped, same shape -> gates normally
+        d = bd.diff_rows(row(100.0, cpus=1), row(10.0, cpus=1), 0.10)
+        assert not d["skipped"]
+        assert len(d["regressions"]) == 1
 
     def test_trajectory_gates_newest_pair_only(self, tmp_path):
         bd = self._bd()

@@ -92,6 +92,11 @@ DOCUMENTED_KEYS = frozenset([
     # lighthouse's per-requester hint, refreshed every quorum round
     "fleet_p95_ms", "straggler_score", "fleet_groups",
     "slo_breach", "slo_breaches_total",
+    # straggler-aware rebalance (docs/design/fleet_rebalance.md): the
+    # fraction in force plus commit-boundary adoption accounting —
+    # unconditional, like the degraded-mode trio above
+    "rebalance_fraction", "rebalance_adoptions_total",
+    "rebalance_deferred_total",
     # RAM checkpoint tier (docs/design/memory_tier.md) — the Manager
     # half only; the store/replicator counters merge in when the tier
     # is armed (see test_ram_tier_merges_keys)
@@ -354,6 +359,29 @@ class TestPrometheusExposition:
         text = tracing.prometheus_text(
             {"a": 1}, {"weird": 'x"y\\z\n'}, labels={"replica_id": "r"})
         assert 'weird="x\\"y\\\\z\\n"' in text
+
+
+class TestFleetExpositionSchema:
+    """Freeze the fleet-side /fleet/metrics names the rebalance plane
+    added (docs/design/fleet_rebalance.md): the aggregate gauges and the
+    per-group fraction gauge — mirrored family-for-family by the C++
+    lighthouse's fleet_metrics_text, so a rename here silently forks the
+    two expositions."""
+
+    def test_rebalance_families_render(self):
+        from torchft_tpu import fleet
+
+        agg = fleet.FleetAggregator()
+        agg.ingest(fleet.StepDigest(replica_id="g0", step=1,
+                                    step_wall_ms=100.0))
+        text = fleet.status_prometheus(agg.aggregate())
+        for family, typ in (
+                ("torchft_fleet_rebalance_groups", "gauge"),
+                ("torchft_fleet_rebalance_seq", "counter"),
+                ("torchft_fleet_rebalance_fraction", "gauge")):
+            assert f"# TYPE {family} {typ}" in text, family
+        assert 'torchft_fleet_rebalance_fraction{replica_id="g0"} 1.0' \
+            in text
 
 
 class TestTraceEventSchema:

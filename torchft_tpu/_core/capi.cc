@@ -127,7 +127,8 @@ void tft_manager_set_digest(void* h, int64_t step, double step_wall_ms,
                             double churn_per_min, int32_t healing,
                             double heal_last_ms, double publish_last_ms,
                             const char* trace_addr, int64_t quorum_id,
-                            const char* state_digest) {
+                            const char* state_digest,
+                            double rebalance_fraction) {
   StepDigest d;
   d.set_step(step);
   d.set_step_wall_ms(step_wall_ms);
@@ -148,6 +149,9 @@ void tft_manager_set_digest(void* h, int64_t step, double step_wall_ms,
   // rides the same piggyback; "" = attestation off (a non-voter).
   d.set_quorum_id(quorum_id);
   d.set_state_digest(state_digest ? state_digest : "");
+  // Fleet rebalance (docs/design/fleet_rebalance.md): the fraction in
+  // force for the measured step; 0/unset reads as 1.0 lighthouse-side.
+  d.set_rebalance_fraction(rebalance_fraction);
   ((ManagerServer*)h)->set_digest(d);
 }
 
@@ -250,6 +254,12 @@ struct TftQuorumResult {
   int32_t sdc_diverged;
   char* sdc_quarantined;
   char* sdc_quarantined_addrs;
+  // Fleet rebalance hint (docs/design/fleet_rebalance.md); 0/empty when
+  // the rebalancer has nothing for this group. Layout mirrored by
+  // _native._CQuorumResult.
+  double rebalance_fraction;
+  char* rebalance_table;
+  int64_t rebalance_seq;
 };
 
 void* tft_manager_client_new(const char* addr, int64_t connect_timeout_ms,
@@ -301,6 +311,9 @@ int tft_manager_client_quorum(void* h, int64_t rank, int64_t step,
   out->sdc_diverged = r.fleet().sdc_diverged() ? 1 : 0;
   out->sdc_quarantined = dup_str(r.fleet().sdc_quarantined());
   out->sdc_quarantined_addrs = dup_str(r.fleet().sdc_quarantined_addrs());
+  out->rebalance_fraction = r.fleet().rebalance_fraction();
+  out->rebalance_table = dup_str(r.fleet().rebalance_table());
+  out->rebalance_seq = r.fleet().rebalance_seq();
   return 0;
 }
 

@@ -1302,6 +1302,139 @@ static void test_fleet_farewell_and_digestless() {
   printf("test_fleet_farewell_and_digestless ok\n");
 }
 
+// ---------------------------------------------------------------------------
+// Fleet rebalance ladder (docs/design/fleet_rebalance.md)
+// ---------------------------------------------------------------------------
+
+// Pure-unit parity matrix for the Rebalancer: the frozen snapshots below
+// were produced by driving the SAME row sequence through the pure-Python
+// mirror (torchft_tpu.fleet.Rebalancer — tests/test_rebalance.py freezes
+// the identical literals). One 2x-slow group among four: ladder descends
+// an eighth per persist+cooldown window to the floor with derived boosts
+// conserving the fleet total, then restores symmetrically (slower, by
+// design) once the group recovers. seq counts table CHANGES — the flap
+// counter both suites pin.
+static void test_rebalancer_ladder_parity() {
+  Rebalancer rb;
+  std::map<std::string, double> base{
+      {"a", 100.0}, {"b", 100.0}, {"c", 200.0}, {"d", 100.0}};
+  // reported_fraction trails the assigned table by one boundary (the
+  // adoption lag real managers have) and the wall scales with it (a
+  // shrunken batch finishes proportionally faster).
+  std::map<std::string, double> prev{
+      {"a", 1.0}, {"b", 1.0}, {"c", 1.0}, {"d", 1.0}};
+  struct Snap {
+    int64_t k;
+    const char* table;
+    int64_t seq, shrinks, restores;
+  };
+  const Snap kSnaps[] = {
+      {1, "", 0, 0, 0},
+      {3, "a=1.0417,b=1.0417,c=0.8750,d=1.0417", 1, 1, 0},
+      {7, "a=1.0833,b=1.0833,c=0.7500,d=1.0833", 2, 2, 0},
+      {11, "a=1.1250,b=1.1250,c=0.6250,d=1.1250", 3, 3, 0},
+      {15, "a=1.1667,b=1.1667,c=0.5000,d=1.1667", 4, 4, 0},
+      {21, "a=1.1250,b=1.1250,c=0.6250,d=1.1250", 5, 4, 1},
+      {27, "a=1.0833,b=1.0833,c=0.7500,d=1.0833", 6, 4, 2},
+      {33, "a=1.0417,b=1.0417,c=0.8750,d=1.0417", 7, 4, 3},
+      {39, "", 8, 4, 4},
+  };
+  size_t si = 0;
+  for (int64_t k = 1; k <= 39; ++k) {
+    if (k == 16) base["c"] = 100.0;  // the straggler recovers
+    std::vector<Rebalancer::Row> rows;
+    for (const auto& [rid, wall] : base) {
+      Rebalancer::Row r;
+      r.replica_id = rid;
+      r.step = k;
+      r.step_wall_ms = wall * prev[rid];
+      r.reported_fraction = prev[rid];
+      r.eligible = true;
+      rows.push_back(r);
+    }
+    prev = rb.observe(std::move(rows));
+    if (si < sizeof(kSnaps) / sizeof(kSnaps[0]) && kSnaps[si].k == k) {
+      assert(rb.table() == kSnaps[si].table);
+      assert(rb.seq() == kSnaps[si].seq);
+      assert(rb.shrinks_total == kSnaps[si].shrinks);
+      assert(rb.restores_total == kSnaps[si].restores);
+      ++si;
+    }
+  }
+  assert(si == sizeof(kSnaps) / sizeof(kSnaps[0]));
+  // Fully restored: every fraction back to 1.0, table empty.
+  for (const auto& [rid, f] : rb.fractions()) {
+    (void)rid;
+    assert(f == 1.0);
+  }
+  printf("test_rebalancer_ladder_parity ok (seq %lld)\n",
+         (long long)rb.seq());
+}
+
+// Ladder edge cases frozen on both sides: duplicate-step digests take no
+// observation, ineligible rows are sticky (keep their fraction, restart
+// streaks, receive no boost), forget() drops a group immediately, and a
+// 2-group fleet never shrinks — the median absorbs the outlier.
+static void test_rebalancer_edges() {
+  auto mkrow = [](const std::string& rid, int64_t step, double wall,
+                  double rep, bool elig) {
+    Rebalancer::Row r;
+    r.replica_id = rid;
+    r.step = step;
+    r.step_wall_ms = wall;
+    r.reported_fraction = rep;
+    r.eligible = elig;
+    return r;
+  };
+
+  {  // duplicate step: replaying the same boundary never advances loud.
+    Rebalancer rb;
+    for (int i = 0; i < 10; ++i) {
+      rb.observe({mkrow("a", 1, 100, 1.0, true),
+                  mkrow("b", 1, 100, 1.0, true),
+                  mkrow("c", 1, 400, 1.0, true),
+                  mkrow("d", 1, 100, 1.0, true)});
+    }
+    assert(rb.shrinks_total == 0 && rb.table().empty());
+  }
+  {  // ineligible straggler: sticky fraction, no shrink, no boost.
+    Rebalancer rb;
+    for (int64_t k = 1; k <= 8; ++k) {
+      rb.observe({mkrow("a", k, 100, 1.0, true),
+                  mkrow("b", k, 100, 1.0, true),
+                  mkrow("c", k, 400, 1.0, /*elig=*/false),
+                  mkrow("d", k, 100, 1.0, true)});
+    }
+    assert(rb.shrinks_total == 0 && rb.table().empty());
+    auto f = rb.fractions();
+    assert(f.at("c") == 1.0);
+  }
+  {  // forget(): the departed group's deficit vanishes from the table.
+    Rebalancer rb;
+    for (int64_t k = 1; k <= 3; ++k) {
+      rb.observe({mkrow("a", k, 100, 1.0, true),
+                  mkrow("b", k, 100, 1.0, true),
+                  mkrow("c", k, 400, 1.0, true),
+                  mkrow("d", k, 100, 1.0, true)});
+    }
+    assert(rb.shrinks_total == 1);
+    rb.forget("c");
+    assert(Rebalancer::format_table(rb.fractions()).empty());
+  }
+  {  // 2-group fleet, 2x-slow outlier: the outlier drags the median up
+    // (med = 150, ratio = 1.33 < HI) so it never goes loud; only past
+    // 3x does a 2-group outlier shrink. Pinned so nobody "fixes" the
+    // median into a mean and changes small-fleet behavior silently.
+    Rebalancer rb;
+    for (int64_t k = 1; k <= 12; ++k) {
+      rb.observe({mkrow("a", k, 100, 1.0, true),
+                  mkrow("b", k, 200, 1.0, true)});
+    }
+    assert(rb.shrinks_total == 0 && rb.table().empty());
+  }
+  printf("test_rebalancer_edges ok\n");
+}
+
 int main() {
   test_quorum_changed();
   test_store();
@@ -1323,6 +1456,8 @@ int main() {
   test_farewell_invalidates_fast_path_cache();
   test_fleet_digest_hint_and_slo();
   test_fleet_farewell_and_digestless();
+  test_rebalancer_ladder_parity();
+  test_rebalancer_edges();
   test_standby_replication_and_promotion();
   test_manager_lighthouse_failover();
   printf("ALL CORE TESTS PASSED\n");

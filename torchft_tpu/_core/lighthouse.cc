@@ -272,6 +272,21 @@ std::string attribute_stage(const StepDigest& d,
 }
 
 double round3(double v) { return std::floor(v * 1e3 + 0.5) / 1e3; }
+double round4(double v) { return std::floor(v * 1e4 + 0.5) / 1e4; }
+
+// Straggler-aware rebalance constants (docs/design/fleet_rebalance.md)
+// — every value spelled identically in torchft_tpu.fleet (the mirror
+// contract: both sides must compute bit-identical fraction tables from
+// the same digest stream). The ladder moves in exact-binary eighths so
+// the mirrors cannot drift through accumulated rounding.
+constexpr double kRebalanceFloor = 0.5;
+constexpr double kRebalanceCeil = 1.5;
+constexpr double kRebalanceStep = 0.125;
+constexpr double kRebalanceHi = 1.5;
+constexpr double kRebalanceLo = 1.15;
+constexpr int kRebalancePersist = 3;
+constexpr int kRebalanceRelax = 6;
+constexpr int kRebalanceCooldown = 4;
 
 std::string fmt_double(double v) {
   char buf[64];
@@ -280,6 +295,138 @@ std::string fmt_double(double v) {
 }
 
 }  // namespace
+
+// ------------------------------------------------------ fleet rebalance
+// Mirror of torchft_tpu.fleet.Rebalancer — change together. The two
+// implementations iterate in the same order (rows sorted by
+// replica_id, state in map order) and use the same arithmetic, so the
+// fraction table is bit-identical given the same digest stream.
+
+std::string Rebalancer::format_table(
+    const std::map<std::string, double>& f) {
+  // fleet.format_rebalance_table: "rid=%.4f" comma-joined, sorted by
+  // rid (std::map order), entries at exactly 1.0 omitted.
+  std::string out;
+  for (const auto& [rid, frac] : f) {
+    if (std::fabs(frac - 1.0) <= 1e-9) continue;
+    char buf[32];
+    snprintf(buf, sizeof buf, "%.4f", frac);
+    if (!out.empty()) out += ",";
+    out += rid + "=" + buf;
+  }
+  return out;
+}
+
+std::map<std::string, double> Rebalancer::observe(std::vector<Row> rows) {
+  std::set<std::string> present;
+  for (const auto& r : rows) present.insert(r.replica_id);
+  for (auto it = state_.begin(); it != state_.end();) {
+    if (!present.count(it->first))
+      it = state_.erase(it);  // departed: fraction cleared immediately
+    else
+      ++it;
+  }
+
+  std::sort(rows.begin(), rows.end(), [](const Row& a, const Row& b) {
+    return a.replica_id < b.replica_id;
+  });
+  std::map<std::string, double> norm;
+  std::vector<double> norm_vals;
+  for (const auto& r : rows) {
+    if (!r.eligible) continue;
+    // Judge each group at its would-be full-batch pace: divide the
+    // wall by the fraction its measured step actually ran under
+    // (clamped to the ladder bounds — a corrupt report must not
+    // explode the normalization).
+    double rep = std::min(kRebalanceCeil,
+                          std::max(kRebalanceFloor, r.reported_fraction));
+    double v = r.step_wall_ms / rep;
+    norm[r.replica_id] = v;
+    norm_vals.push_back(v);
+  }
+  double med = median_of(norm_vals);
+
+  for (const auto& r : rows) {
+    St& st = state_[r.replica_id];
+    st.eligible = r.eligible;
+    if (!r.eligible) {
+      // A healer/degraded/stale row is not comparable: freeze the
+      // ladder (sticky fraction) and restart persistence.
+      st.loud = st.quiet = 0;
+      continue;
+    }
+    if (st.has_step && r.step == st.last_step)
+      continue;  // no new boundary: not a new observation
+    st.has_step = true;
+    st.last_step = r.step;
+    if (st.cooldown > 0) st.cooldown--;
+    if (med <= 1e-9) {
+      st.loud = st.quiet = 0;
+      continue;
+    }
+    double ratio = norm[r.replica_id] / med;
+    if (ratio >= kRebalanceHi) {
+      st.loud++;
+      st.quiet = 0;
+      if (st.loud >= kRebalancePersist && st.cooldown == 0 &&
+          st.fraction > kRebalanceFloor + 1e-9) {
+        st.fraction =
+            std::max(kRebalanceFloor, st.fraction - kRebalanceStep);
+        st.cooldown = kRebalanceCooldown;
+        st.loud = 0;
+        shrinks_total++;
+      }
+    } else if (ratio <= kRebalanceLo) {
+      st.quiet++;
+      st.loud = 0;
+      if (st.quiet >= kRebalanceRelax && st.cooldown == 0 &&
+          st.fraction < 1.0 - 1e-9) {
+        st.fraction = std::min(1.0, st.fraction + kRebalanceStep);
+        st.cooldown = kRebalanceCooldown;
+        st.quiet = 0;
+        restores_total++;
+      }
+    } else {
+      st.loud = st.quiet = 0;  // dead zone resets both streaks
+    }
+  }
+
+  auto f = fractions();
+  std::string t = format_table(f);
+  if (t != table_) {
+    table_ = t;
+    seq_++;
+  }
+  return f;
+}
+
+std::map<std::string, double> Rebalancer::fractions() const {
+  // fleet.Rebalancer.fractions: the trimmed mass sum(1 - ladder) is
+  // reallocated evenly over headroom groups (ladder 1.0 AND eligible
+  // — a shrunken group that went healing still counts as deficit, but
+  // a healer never receives boost), capped at the ceiling; remainder
+  // past the cap goes unallocated.
+  double deficit = 0.0;
+  size_t headroom = 0;
+  for (const auto& [rid, st] : state_) {
+    if (st.fraction < 1.0 - 1e-9)
+      deficit += 1.0 - st.fraction;
+    else if (st.eligible)
+      headroom++;
+  }
+  double bonus =
+      (headroom && deficit > 1e-9) ? deficit / (double)headroom : 0.0;
+  std::map<std::string, double> out;
+  for (const auto& [rid, st] : state_) {
+    if (st.fraction < 1.0 - 1e-9)
+      out[rid] = st.fraction;
+    else if (st.eligible && bonus > 0.0)
+      out[rid] = std::min(kRebalanceCeil, 1.0 + bonus);
+    else
+      out[rid] = 1.0;
+  }
+  return out;
+}
 
 SLOConfig SLOConfig::parse(const std::string& spec) {
   // Same grammar as fleet.SLOConfig.from_spec; unknown keys are
@@ -694,6 +841,10 @@ void Lighthouse::record_beat(const LighthouseHeartbeatRequest& r) {
     {
       std::lock_guard<std::mutex> flk(fleet_mu_);
       sdc_quarantined_.erase(r.replica_id());
+      // Farewell clears the rebalance fraction immediately: the
+      // group's slice is gone, and the next aggregate re-derives the
+      // survivors' boosts without it (fleet.FleetAggregator.remove).
+      rebalancer_.forget(r.replica_id());
     }
   } else {
     beats_.record(r.replica_id(), now_ms(), r.joining(), r.heal_count(),
@@ -816,6 +967,32 @@ std::shared_ptr<const FleetAggregate> Lighthouse::fleet_aggregate(
     agg->sdc_clears_total = sdc_clears_total_;
   }
 
+  // Rebalance ladder (fleet.FleetAggregator.aggregate — the mirror
+  // contract): one observation per group per NEW step, from the same
+  // latest view. Eligibility == the straggler-baseline flag; a
+  // zero-valued reported fraction is a pre-rebalance manager and
+  // reads as 1.0.
+  std::map<std::string, double> rebalance_fractions;
+  {
+    std::vector<Rebalancer::Row> rows;
+    rows.reserve(latest.size());
+    for (const auto& [id, e] : latest) {
+      Rebalancer::Row row;
+      row.replica_id = id;
+      row.step = e.d.step();
+      row.step_wall_ms = e.d.step_wall_ms();
+      row.reported_fraction =
+          e.d.rebalance_fraction() > 0.0 ? e.d.rebalance_fraction() : 1.0;
+      row.eligible = baseline_eligible(e.d) && e.fresh;
+      rows.push_back(std::move(row));
+    }
+    rebalance_fractions = rebalancer_.observe(std::move(rows));
+    agg->rebalance_table = rebalancer_.table();
+    agg->rebalance_seq = rebalancer_.seq();
+    agg->rebalance_shrinks_total = rebalancer_.shrinks_total;
+    agg->rebalance_restores_total = rebalancer_.restores_total;
+  }
+
   // Baseline median/MAD (fleet.robust_zscores) + per-stage medians.
   // Stale rows stay visible in the group list but never shape the
   // baseline (the dead-without-farewell fix).
@@ -850,6 +1027,11 @@ std::shared_ptr<const FleetAggregate> Lighthouse::fleet_aggregate(
     g.attested = !e.d.state_digest().empty() && e.fresh &&
                  !e.d.healing();
     g.sdc_diverged = sdc_quarantined_.count(id) > 0;
+    {
+      auto rit = rebalance_fractions.find(id);
+      g.rebalance_fraction =
+          rit == rebalance_fractions.end() ? 1.0 : round4(rit->second);
+    }
     if (g.baseline) {
       // Zero dispersion (uniform fleet / single group) -> all scores
       // 0.0, never NaN (fleet.robust_zscores).
@@ -982,6 +1164,20 @@ void Lighthouse::fill_fleet_hint(const std::string& id, FleetHint* out) {
   out->set_sdc_diverged(diverged);
   out->set_sdc_quarantined(q_rids);
   out->set_sdc_quarantined_addrs(q_addrs);
+  // Rebalance echo (docs/design/fleet_rebalance.md): the requester's
+  // own assigned fraction plus the full fleet table the decider
+  // publishes verbatim; seq bumps on every table change (the flap
+  // counter).
+  double reb = 1.0;
+  for (const auto& g : agg->groups) {
+    if (g.replica_id == id) {
+      reb = g.rebalance_fraction;
+      break;
+    }
+  }
+  out->set_rebalance_fraction(reb);
+  out->set_rebalance_table(agg->rebalance_table);
+  out->set_rebalance_seq(agg->rebalance_seq);
 }
 
 std::string Lighthouse::fleet_status_json(const FleetAggregate& agg) {
@@ -1027,6 +1223,25 @@ std::string Lighthouse::fleet_status_json(const FleetAggregate& agg) {
          std::to_string(agg.sdc_verdicts_total) +
          ",\"sdc_clears_total\":" +
          std::to_string(agg.sdc_clears_total);
+  // Rebalance section (fleet.FleetAggregator.aggregate's fleet keys):
+  // only entries != 1.0 appear in the fractions map, like the table.
+  out += ",\"rebalance_fractions\":{";
+  {
+    bool first = true;
+    for (const auto& g : agg.groups) {
+      if (std::fabs(g.rebalance_fraction - 1.0) <= 1e-9) continue;
+      if (!first) out += ",";
+      first = false;
+      out += "\"" + json_escape(g.replica_id) +
+             "\":" + fmt_double(g.rebalance_fraction);
+    }
+  }
+  out += "},\"rebalance_table\":\"" + json_escape(agg.rebalance_table) +
+         "\",\"rebalance_seq\":" + std::to_string(agg.rebalance_seq) +
+         ",\"rebalance_shrinks_total\":" +
+         std::to_string(agg.rebalance_shrinks_total) +
+         ",\"rebalance_restores_total\":" +
+         std::to_string(agg.rebalance_restores_total);
   out += "},\"straggler\":{\"replica_id\":\"" +
          json_escape(agg.straggler_id) +
          "\",\"score\":" + fmt_double(agg.straggler_score) +
@@ -1070,7 +1285,8 @@ std::string Lighthouse::fleet_status_json(const FleetAggregate& agg) {
     out += "],\"trace_addr\":\"" + json_escape(g.d.trace_addr()) +
            "\",\"attested\":" + (g.attested ? "true" : "false") +
            ",\"sdc_diverged\":" + (g.sdc_diverged ? "true" : "false") +
-           "}";
+           ",\"rebalance_fraction\":" +
+           fmt_double(g.rebalance_fraction) + "}";
   }
   out += "]}";
   return out;
@@ -1085,6 +1301,9 @@ std::string Lighthouse::fleet_metrics_text(const FleetAggregate& agg) {
     slo_active_snapshot = slo_active_;
     slo_total_snapshot = slo_breaches_total_;
   }
+  int64_t reb_groups = 0;
+  for (const auto& g : agg.groups)
+    if (std::fabs(g.rebalance_fraction - 1.0) > 1e-9) reb_groups++;
   std::ostringstream os;
   os << "# HELP torchft_fleet_groups groups contributing digests\n"
      << "# TYPE torchft_fleet_groups gauge\n"
@@ -1117,6 +1336,16 @@ std::string Lighthouse::fleet_metrics_text(const FleetAggregate& agg) {
      << "# TYPE torchft_fleet_sdc_verdicts_total counter\n"
      << "torchft_fleet_sdc_verdicts_total "
      << fmt_double((double)agg.sdc_verdicts_total) << "\n"
+     << "# HELP torchft_fleet_rebalance_groups groups with a "
+        "rebalance fraction != 1\n"
+     << "# TYPE torchft_fleet_rebalance_groups gauge\n"
+     << "torchft_fleet_rebalance_groups "
+     << fmt_double((double)reb_groups) << "\n"
+     << "# HELP torchft_fleet_rebalance_seq fraction-table change "
+        "counter\n"
+     << "# TYPE torchft_fleet_rebalance_seq counter\n"
+     << "torchft_fleet_rebalance_seq "
+     << fmt_double((double)agg.rebalance_seq) << "\n"
      << "# HELP torchft_fleet_stage_median_ms fleet per-stage medians\n"
      << "# TYPE torchft_fleet_stage_median_ms gauge\n";
   for (int i = 0; i < 4; i++)
@@ -1126,13 +1355,18 @@ std::string Lighthouse::fleet_metrics_text(const FleetAggregate& agg) {
         "the fleet\n"
      << "# TYPE torchft_fleet_straggler_score gauge\n"
      << "# HELP torchft_fleet_group_step_ms group step wall (ms)\n"
-     << "# TYPE torchft_fleet_group_step_ms gauge\n";
+     << "# TYPE torchft_fleet_group_step_ms gauge\n"
+     << "# HELP torchft_fleet_rebalance_fraction assigned rebalance "
+        "batch fraction\n"
+     << "# TYPE torchft_fleet_rebalance_fraction gauge\n";
   for (const auto& g : agg.groups) {
     std::string rid = json_escape(g.replica_id);
     os << "torchft_fleet_straggler_score{replica_id=\"" << rid
        << "\"} " << fmt_double(g.score) << "\n"
        << "torchft_fleet_group_step_ms{replica_id=\"" << rid << "\"} "
-       << fmt_double(round3(g.d.step_wall_ms())) << "\n";
+       << fmt_double(round3(g.d.step_wall_ms())) << "\n"
+       << "torchft_fleet_rebalance_fraction{replica_id=\"" << rid
+       << "\"} " << fmt_double(g.rebalance_fraction) << "\n";
   }
   return os.str();
 }

@@ -222,6 +222,68 @@ class DigestTable {
   std::array<Shard, kShards> shards_;
 };
 
+// Straggler-aware batch-fraction ladder
+// (docs/design/fleet_rebalance.md) — the authoritative copy of
+// torchft_tpu.fleet.Rebalancer (the mirror contract, change together:
+// constants and math are spelled identically on both sides, and the
+// fraction TABLE string they emit must match byte-for-byte — frozen by
+// core_test.cc and tests/test_rebalance.py). Watches each group's
+// NORMALIZED step wall (wall / the digest-reported fraction in force)
+// against the fleet median and walks a per-group fraction ladder with
+// PolicyController-style persistence/hysteresis/cooldown; the trimmed
+// slice is reallocated to headroom groups (boosts DERIVED, never
+// ladder state). Not thread-safe: the owner (Lighthouse, under
+// fleet_mu_) serializes.
+class Rebalancer {
+ public:
+  struct Row {
+    std::string replica_id;
+    int64_t step = 0;
+    double step_wall_ms = 0.0;
+    // The digest's own rebalance_fraction — what the measured step
+    // actually ran under (may trail the assigned one by an adoption
+    // boundary). 0 must be mapped to 1.0 by the CALLER (proto default
+    // = pre-rebalance manager).
+    double reported_fraction = 1.0;
+    // The straggler-baseline flag (fresh, not healing, full
+    // capacity): ineligible rows keep their ladder fraction sticky
+    // but take no observation and receive no boost.
+    bool eligible = false;
+  };
+
+  // Farewell/eviction clears the group's fraction immediately.
+  void forget(const std::string& rid) { state_.erase(rid); }
+  // Advance the ladder one aggregate (groups absent from rows are
+  // dropped as departed); returns the target fraction table, every
+  // tracked group including 1.0 entries.
+  std::map<std::string, double> observe(std::vector<Row> rows);
+  // Ladder fractions plus derived boosts (deficit reallocated evenly
+  // over eligible headroom groups, capped at the ceiling).
+  std::map<std::string, double> fractions() const;
+  // Canonical wire spelling: "rid=%.4f" comma-joined, sorted, entries
+  // at exactly 1.0 omitted (fleet.format_rebalance_table).
+  static std::string format_table(const std::map<std::string, double>& f);
+  const std::string& table() const { return table_; }
+  int64_t seq() const { return seq_; }
+
+  int64_t shrinks_total = 0;
+  int64_t restores_total = 0;
+
+ private:
+  struct St {
+    double fraction = 1.0;
+    int loud = 0;
+    int quiet = 0;
+    int cooldown = 0;
+    int64_t last_step = 0;
+    bool has_step = false;
+    bool eligible = false;
+  };
+  std::map<std::string, St> state_;
+  std::string table_;
+  int64_t seq_ = 0;
+};
+
 // Parsed SLO thresholds (< 0 = disabled), mirroring
 // torchft_tpu.fleet.SLOConfig.
 struct SLOConfig {
@@ -257,6 +319,9 @@ struct FleetAggregate {
     // is currently under a divergence verdict.
     bool attested = false;
     bool sdc_diverged = false;
+    // Assigned rebalance batch fraction (docs/design/fleet_rebalance
+    // .md): 1.0 = uniform share.
+    double rebalance_fraction = 1.0;
   };
   int64_t computed_ms = 0;
   int64_t groups_n = 0;
@@ -273,6 +338,13 @@ struct FleetAggregate {
   std::vector<std::string> sdc_quarantined_addrs;
   int64_t sdc_verdicts_total = 0;
   int64_t sdc_clears_total = 0;
+  // Straggler-aware rebalance (docs/design/fleet_rebalance.md): the
+  // canonical fraction table, its change sequence (the flap counter),
+  // and lifetime ladder moves.
+  std::string rebalance_table;
+  int64_t rebalance_seq = 0;
+  int64_t rebalance_shrinks_total = 0;
+  int64_t rebalance_restores_total = 0;
 };
 
 class Lighthouse {
@@ -416,6 +488,13 @@ class Lighthouse {
   std::map<std::string, SdcVerdict> sdc_quarantined_;
   int64_t sdc_verdicts_total_ = 0;
   int64_t sdc_clears_total_ = 0;
+
+  // --- fleet rebalance (docs/design/fleet_rebalance.md) -----------------
+  // Guarded by fleet_mu_ (advanced inside fleet_aggregate, which holds
+  // it; forget() on the farewell path takes it explicitly).
+  // Observations are step-driven, so the 200 ms aggregate cache never
+  // inflates the ladder clock.
+  Rebalancer rebalancer_;
 
   // Standby machinery. promoted_ is true from birth on a primary; on a
   // standby it flips once the primary is provably dead and gates Quorum

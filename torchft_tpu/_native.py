@@ -118,7 +118,7 @@ def _load() -> ctypes.CDLL:
     dbl = ctypes.c_double
     lib.tft_manager_set_digest.argtypes = [
         vp, i64, dbl, dbl, dbl, dbl, dbl, dbl, dbl, i64, dbl, dbl, i32,
-        dbl, dbl, c, i64, c]
+        dbl, dbl, c, i64, c, dbl]
     lib.tft_manager_set_digest.restype = None
     lib.tft_manager_farewell.argtypes = [vp]
     lib.tft_manager_farewell.restype = None
@@ -197,6 +197,10 @@ class _CQuorumResult(ctypes.Structure):
         ("sdc_diverged", ctypes.c_int32),
         ("sdc_quarantined", ctypes.c_void_p),
         ("sdc_quarantined_addrs", ctypes.c_void_p),
+        # Fleet rebalance hint (docs/design/fleet_rebalance.md).
+        ("rebalance_fraction", ctypes.c_double),
+        ("rebalance_table", ctypes.c_void_p),
+        ("rebalance_seq", ctypes.c_int64),
     ]
 
 
@@ -378,7 +382,8 @@ class ManagerServer:
                    publish_last_ms: float = 0.0,
                    trace_addr: str = "",
                    quorum_id: int = -1,
-                   state_digest: str = "") -> None:
+                   state_digest: str = "",
+                   rebalance_fraction: float = 1.0) -> None:
         """Push the per-step telemetry digest
         (docs/design/fleet_health.md): it piggybacks on this server's
         quorum RPC beat (and keepalive beats), feeding the lighthouse's
@@ -387,7 +392,10 @@ class ManagerServer:
 
         ``quorum_id``/``state_digest`` carry the state-attestation
         fingerprint (docs/design/state_attestation.md); ``""`` keeps
-        this group a non-voter."""
+        this group a non-voter. ``rebalance_fraction`` is the batch
+        fraction in force for the measured step
+        (docs/design/fleet_rebalance.md) so the rebalancer can
+        normalize wall time."""
         lib().tft_manager_set_digest(
             self._h, int(step), float(step_wall_ms), float(fetch_ms),
             float(ring_ms), float(put_ms), float(vote_ms),
@@ -395,7 +403,8 @@ class ManagerServer:
             int(policy_rung), float(capacity_fraction),
             float(churn_per_min), 1 if healing else 0,
             float(heal_last_ms), float(publish_last_ms),
-            trace_addr.encode(), int(quorum_id), state_digest.encode())
+            trace_addr.encode(), int(quorum_id), state_digest.encode(),
+            float(rebalance_fraction))
 
     def lighthouse_redials(self) -> int:
         """Times this manager re-dialed a DIFFERENT lighthouse endpoint
@@ -608,6 +617,13 @@ class QuorumResult:
     sdc_diverged: bool = False
     sdc_quarantined: str = ""
     sdc_quarantined_addrs: str = ""
+    # Fleet rebalance hint (docs/design/fleet_rebalance.md): THIS
+    # group's advisory batch fraction, the fleet-wide fraction table
+    # ("rid=frac,..." — only entries != 1.0), and the table's change
+    # sequence number. 0/empty from a pre-rebalance control plane.
+    rebalance_fraction: float = 0.0
+    rebalance_table: str = ""
+    rebalance_seq: int = 0
 
 
 class ManagerClient(_RetryingNativeClient):
@@ -671,6 +687,9 @@ class ManagerClient(_RetryingNativeClient):
             sdc_diverged=bool(res.sdc_diverged),
             sdc_quarantined=_take_str(res.sdc_quarantined),
             sdc_quarantined_addrs=_take_str(res.sdc_quarantined_addrs),
+            rebalance_fraction=res.rebalance_fraction,
+            rebalance_table=_take_str(res.rebalance_table),
+            rebalance_seq=res.rebalance_seq,
         )
 
     def checkpoint_address(self, rank: int, timeout_ms: int = 10_000) -> str:

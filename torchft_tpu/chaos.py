@@ -87,6 +87,7 @@ __all__ = [
     "disk_fault",
     "device_fault",
     "ram_fault",
+    "slow_fault",
 ]
 
 
@@ -167,6 +168,21 @@ class EndpointChaos:
     # bands: existing channels' traces are unchanged while this rate
     # is 0).
     sdc_flip_rate: float = 0.0
+    # Straggler step-stretch (the ``slow`` channel, honored by
+    # :func:`slow_fault` — the rebalance soak's injection point,
+    # docs/design/fleet_rebalance.md): per-commit-boundary probability
+    # that THIS boundary's step is stretched by ``slow_factor`` on the
+    # endpoint ``slow:<replica_id>``. A persistent straggler is minted
+    # with ``slow_rate=1`` (every boundary stretches, no wall-clock
+    # hacks); the rate scales with the live intensity, so a
+    # PhasedChaos stable->storm->stable walk mints and clears the
+    # straggler with zero latch bookkeeping. ``slow_factor`` is a
+    # multiplier, not a rate — intensity never scales it. Appended
+    # LAST in the fault-band order (same determinism contract as the
+    # device/ram/sdc bands: existing channels' traces are unchanged
+    # while this rate is 0).
+    slow_rate: float = 0.0
+    slow_factor: float = 2.0
     max_faults: int = -1         # cap on hard faults per channel (-1 = inf)
 
 
@@ -311,7 +327,8 @@ class ChaosSchedule:
                                (cfg.chip_return_rate, "chip_return"),
                                (cfg.ram_loss_rate, "ram_loss"),
                                (cfg.ram_blackhole_rate, "ram_blackhole"),
-                               (cfg.sdc_flip_rate, "sdc_flip")):
+                               (cfg.sdc_flip_rate, "sdc_flip"),
+                               (cfg.slow_rate, "slow")):
                 acc += rate * scale
                 if u < acc:
                     fault = kind
@@ -720,6 +737,37 @@ def sdc_fault(endpoint: str,
     if d is None or d.fault != "sdc_flip":
         return None
     return d
+
+
+def slow_fault(endpoint: str,
+               schedule: Optional[ChaosSchedule] = None) -> float:
+    """Per-boundary step-stretch hook (channel ``slow``; the Manager
+    polls it once per commit boundary with endpoint
+    ``slow:<replica_id>`` — docs/design/fleet_rebalance.md).
+
+    Returns the stretch multiplier for THIS boundary: ``slow_factor``
+    when a ``slow`` decision fires, else ``1.0`` (no stretch — also
+    when no schedule/config is active, with NO decision drawn: stream
+    purity, like the sdc band). The caller stretches the step by
+    sleeping ``(factor - 1) x`` its natural boundary wall — an honest
+    straggler whose slowness the health plane measures end-to-end,
+    not a clock hack. A persistent straggler is ``slow_rate=1`` on
+    the endpoint; the rate scales with the live intensity, so a
+    PhasedChaos walk mints the straggler in its storm phase and
+    clears it in the next stable phase with no latch to forget. The
+    injection contract mirrors the sdc band: participants only, once
+    per boundary (Manager._maybe_chaos_slow guards it; frozen by
+    tests/test_rebalance.py)."""
+    sched = schedule if schedule is not None else active()
+    if sched is None:
+        return 1.0
+    cfg = sched.config_for(endpoint)
+    if cfg is None:
+        return 1.0  # no decision draw (stream purity)
+    d = sched.decide(endpoint, "slow")
+    if d is None or d.fault != "slow":
+        return 1.0
+    return max(1.0, float(cfg.slow_factor))
 
 
 # ------------------------------------------------------------ RAM faults

@@ -329,14 +329,24 @@ class StatefulLoader:
         self._q = None
 
 
-def _reports_samples(manager: Any) -> bool:
+def _reports_samples(manager: Any, fraction: float = 1.0) -> bool:
     """True when a draw should report its sample count as the
-    degraded-mode fold weight: the manager accepts reports AND is in
-    degraded mode (the only mode the weight is read). Duck-typed
+    weighted-fold weight: the manager accepts reports AND either is in
+    degraded mode or drew at a nonuniform ``fraction`` (!= 1).
+
+    The fraction clause is load-bearing: rebalance fractions
+    (docs/design/fleet_rebalance.md) resize the draw with degraded
+    mode off — shrunken straggler AND boosted headroom group alike —
+    and gating the report on the degraded-mode probe alone would
+    leave the fold weight silently at the last full-batch value while
+    the actual contribution changed: the exact draw size must always
+    ride the fold whenever any fraction != 1 is in force. Duck-typed
     managers exposing ``set_step_samples`` without the mode probe
     (test doubles) report unconditionally."""
     if getattr(manager, "set_step_samples", None) is None:
         return False
+    if abs(fraction - 1.0) > 1e-9:
+        return True
     dm = getattr(manager, "degraded_mode", None)
     return dm is None or bool(dm())
 
@@ -444,31 +454,41 @@ class ElasticSampler:
         """Deterministic index batch for any slot of the global stream.
 
         ``capacity_fraction`` < 1 (a degraded group,
-        docs/design/degraded_mode.md) draws only the first
+        docs/design/degraded_mode.md, or a rebalance-shrunken one,
+        docs/design/fleet_rebalance.md) draws only the first
         ``round(batch_size * fraction)`` indices of the slot — the
         group contributes fewer samples and its gradient is weighted
         accordingly; the slot's tail goes unvisited this epoch (the
         same lossy contract as a static sampler's dead shard, but
-        bounded to the degraded remainder instead of a whole shard)."""
+        bounded to the degraded remainder instead of a whole shard).
+        A fraction > 1 (a rebalance BOOST group absorbing a straggler's
+        trimmed slice) draws past its slot boundary into the adjacent
+        slot's indices: the fleet sample total is conserved, at the
+        cost of the overlap re-visiting a few of the neighbor's
+        samples — a mild with-replacement perturbation bounded by the
+        skew ceiling, weighted exactly by the fold since the draw size
+        is reported verbatim. The draw truncates at the epoch edge
+        (the permutation never wraps)."""
         epoch, pos = divmod(int(slot), self.batches_per_epoch)
         perm = self._perm(int(epoch))
         lo = pos * self.batch_size
         k = self.batch_size
-        if capacity_fraction < 1.0:
+        if abs(capacity_fraction - 1.0) > 1e-9:
             k = max(1, int(round(self.batch_size * capacity_fraction)))
         return perm[lo:lo + k]
 
     def next_indices(self) -> np.ndarray:
         """Index batch for this group's slot of the current step, sized
-        by the capacity fraction riding the same atomic snapshot. In
-        degraded mode the draw size is reported back to the manager
+        by the effective capacity fraction (degraded x rebalance)
+        riding the same atomic snapshot. Whenever the weight can be
+        read — degraded mode, or ANY fraction != 1 in force — the draw
+        size is reported back to the manager
         (``Manager.set_step_samples``) so the fold weight is exactly
-        the samples this batch contributes; outside it (and for
-        duck-typed managers without the mode probe) the report is
-        skipped — the weight is never read."""
+        the samples this batch contributes; only a full-fraction draw
+        outside degraded mode skips the report."""
         rank, committed, frac = self._snapshot()
         idx = self.indices_for_slot(int(committed) + (rank or 0), frac)
-        if _reports_samples(self.manager):
+        if _reports_samples(self.manager, frac):
             self.manager.set_step_samples(len(idx))
         return idx
 
@@ -619,14 +639,15 @@ class ElasticLoader:
                 self._store(key, batch)  # kept: an abort redraws it
         else:
             self.prefetch_hits += 1
-        # The served draw IS the contribution: in degraded mode, report
-        # its size as the fold weight (same contract as
-        # ElasticSampler.next_indices; guarded so the non-degraded hot
-        # path pays no tree flatten for a weight never read). The
-        # sample count is the leading dim of the batch's first LEAF —
-        # a tuple/list batch's len() would be its field count, not its
-        # rows.
-        if _reports_samples(self.sampler.manager):
+        # The served draw IS the contribution: whenever the weight can
+        # be read (degraded mode, or any fraction < 1 in force),
+        # report its size as the fold weight (same contract as
+        # ElasticSampler.next_indices; guarded so the full-fraction
+        # non-degraded hot path pays no tree flatten for a weight
+        # never read). The sample count is the leading dim of the
+        # batch's first LEAF — a tuple/list batch's len() would be its
+        # field count, not its rows.
+        if _reports_samples(self.sampler.manager, frac):
             import jax
 
             leaves = jax.tree_util.tree_leaves(batch)

@@ -117,6 +117,13 @@ class StepDigest:
     # state mid-transition — never cross-compare.
     quorum_id: int = -1
     state_digest: str = ""
+    # Fleet rebalancing (docs/design/fleet_rebalance.md): the rebalance
+    # batch fraction that was IN FORCE for the step this digest
+    # measures (1.0 = full slice). Kept separate from
+    # capacity_fraction — a rebalanced group is NOT degraded and stays
+    # in the straggler baseline; the Rebalancer divides the wall by
+    # this to judge the group at its would-be full-batch pace.
+    rebalance_fraction: float = 1.0
 
     def stage_ms(self) -> Dict[str, float]:
         return {"fetch": self.fetch_ms, "ring": self.ring_ms,
@@ -182,6 +189,250 @@ def attribute_stage(stage_ms: Dict[str, float],
     return best
 
 
+# ------------------------------------------------------------- rebalancing
+# Straggler-aware fleet rebalancing (docs/design/fleet_rebalance.md).
+# Every constant below is spelled identically in lighthouse.cc — the
+# mirror contract: both sides must compute bit-identical fraction
+# tables from the same digest stream.
+
+# Bounded skew: no group's data slice ever shrinks below half a batch
+# (beyond that, evict — see docs/pod_runbook.md) or grows past 1.5x
+# (a boosted group must not become the new straggler).
+REBALANCE_FLOOR = 0.5
+REBALANCE_CEIL = 1.5
+# Ladder granularity: fractions move in exact-binary eighths so the
+# C++/Python mirrors cannot drift through accumulated rounding.
+REBALANCE_STEP = 0.125
+# Multiplicative hysteresis band on the NORMALIZED wall (wall divided
+# by the fraction in force) vs the fleet median: "loud" at >= HI x
+# median, "quiet" at <= LO x median, dead zone between. A ratio, not
+# the MAD-scaled z the straggler *ranking* uses: MAD collapses to zero
+# in small uniform-but-for-one fleets (all-zero scores), and the
+# restore half needs a threshold that stays meaningful at the shrunken
+# equilibrium where the slow group's raw wall matches the fleet's.
+REBALANCE_HI = 1.5
+REBALANCE_LO = 1.15
+# PolicyController-style persistence/cooldown (policy.py): shrink one
+# rung after PERSIST consecutive loud boundaries, restore one rung
+# after RELAX consecutive quiet ones, never move twice within COOLDOWN
+# boundaries of the same group — a transient stall never flaps the
+# fleet.
+REBALANCE_PERSIST = 3
+REBALANCE_RELAX = 6
+REBALANCE_COOLDOWN = 4
+
+
+def format_rebalance_table(fractions: Dict[str, float]) -> str:
+    """Canonical wire spelling of a fraction table: ``rid=frac`` pairs,
+    comma-joined, sorted by replica_id, fractions at fixed %.4f (the
+    exact format lighthouse.cc emits — the decider publishes this
+    string verbatim, and mirror parity is asserted on it). Groups at
+    exactly 1.0 are omitted: an empty table means a uniform fleet."""
+    return ",".join(f"{rid}={fractions[rid]:.4f}"
+                    for rid in sorted(fractions)
+                    if abs(fractions[rid] - 1.0) > 1e-9)
+
+
+def parse_rebalance_table(table: str) -> Dict[str, float]:
+    """Inverse of :func:`format_rebalance_table`; malformed entries are
+    dropped (an old/corrupt table must never poison adoption — a group
+    absent from the table is simply at 1.0)."""
+    out: Dict[str, float] = {}
+    for part in table.split(","):
+        rid, sep, val = part.rpartition("=")
+        if not sep or not rid:
+            continue
+        try:
+            frac = float(val)
+        except ValueError:
+            continue
+        if REBALANCE_FLOOR - 1e-9 <= frac <= REBALANCE_CEIL + 1e-9:
+            out[rid] = frac
+    return out
+
+
+class Rebalancer:
+    """Straggler-aware batch-fraction ladder — the pure-Python mirror
+    of the lighthouse-side rebalancer (docs/design/fleet_rebalance.md).
+
+    Watches each group's NORMALIZED step wall (wall / the rebalance
+    fraction in force when it was measured — so a shrunken group is
+    judged at its would-be full-batch pace, which is what prevents the
+    shrink -> wall normalizes -> restore -> shrink flap) against the
+    fleet median, and walks a per-group fraction ladder with
+    PolicyController-style persistence, hysteresis and cooldown:
+
+    * ``>= REBALANCE_HI x median`` for ``REBALANCE_PERSIST``
+      consecutive boundaries: shrink one ``REBALANCE_STEP`` rung,
+      never below ``REBALANCE_FLOOR``;
+    * ``<= REBALANCE_LO x median`` for ``REBALANCE_RELAX`` consecutive
+      boundaries: restore one rung toward 1.0 (recovery is symmetric,
+      deliberately slower than descent);
+    * the dead zone between resets both streaks, and no group moves
+      twice within ``REBALANCE_COOLDOWN`` of its own boundaries.
+
+    The fleet sample total is conserved: the trimmed slice is
+    reallocated evenly across the headroom groups (ladder fraction
+    1.0, eligible), capped at ``REBALANCE_CEIL``. Boosts are DERIVED
+    per observation, not ladder state — they follow the shrink ladder
+    deterministically and cannot flap on their own.
+
+    Observations are step-driven, not poll-driven: a digest whose step
+    has not advanced since the group's last observation is ignored, so
+    aggregate-recompute cadence (the lighthouse's 200 ms cache, a
+    dashboard poller) never inflates the ladder clock.
+
+    Not thread-safe; the owner (FleetAggregator here, fleet_mu_ in the
+    lighthouse) serializes."""
+
+    def __init__(self, floor: float = REBALANCE_FLOOR,
+                 ceil: float = REBALANCE_CEIL,
+                 step: float = REBALANCE_STEP,
+                 hi: float = REBALANCE_HI, lo: float = REBALANCE_LO,
+                 persist: int = REBALANCE_PERSIST,
+                 relax: int = REBALANCE_RELAX,
+                 cooldown: int = REBALANCE_COOLDOWN) -> None:
+        self.floor = float(floor)
+        self.ceil = float(ceil)
+        self.step = float(step)
+        self.hi = float(hi)
+        self.lo = float(lo)
+        self.persist = int(persist)
+        self.relax = int(relax)
+        self.cooldown = int(cooldown)
+        # replica_id -> ladder state. The ladder fraction is the only
+        # durable state; boosts are derived each observation.
+        self._state: Dict[str, Dict[str, Any]] = {}
+        self._table = ""
+        self._seq = 0
+        self.shrinks_total = 0
+        self.restores_total = 0
+
+    def _st(self, rid: str) -> Dict[str, Any]:
+        st = self._state.get(rid)
+        if st is None:
+            st = self._state[rid] = {"fraction": 1.0, "loud": 0,
+                                     "quiet": 0, "cooldown": 0,
+                                     "last_step": None,
+                                     "eligible": False}
+        return st
+
+    def forget(self, rid: str) -> None:
+        """Farewell/eviction clears the group's fraction immediately:
+        its slice is gone, and the next observation re-derives the
+        survivors' boosts without it."""
+        self._state.pop(rid, None)
+
+    def observe(self, rows: List[Tuple[str, int, float, float, bool]]) \
+            -> Dict[str, float]:
+        """Advance the ladder one aggregate and return the target
+        fraction table (every tracked group, including 1.0 entries).
+
+        ``rows``: one ``(replica_id, step, step_wall_ms,
+        reported_fraction, eligible)`` per group currently in the
+        aggregate. ``reported_fraction`` is the digest's own
+        ``rebalance_fraction`` — the fraction actually in force for
+        the measured step, which may trail the assigned one by an
+        adoption boundary. ``eligible`` is the straggler-baseline flag
+        (fresh, not healing, full capacity): ineligible rows keep
+        their ladder fraction sticky but take no observation. Groups
+        absent from ``rows`` are dropped (departed)."""
+        present = {r[0] for r in rows}
+        for rid in [r for r in self._state if r not in present]:
+            self._state.pop(rid, None)
+
+        rows = sorted(rows, key=lambda r: r[0])
+        norm: Dict[str, float] = {}
+        for rid, _step, wall, reported, eligible in rows:
+            if eligible:
+                rep = min(self.ceil, max(self.floor, float(reported)))
+                norm[rid] = float(wall) / rep
+        med = _median(list(norm.values()))
+
+        for rid, step, _wall, _reported, eligible in rows:
+            st = self._st(rid)
+            st["eligible"] = bool(eligible)
+            if not eligible:
+                # A healer/degraded/stale row is not comparable: freeze
+                # the ladder (sticky fraction) and restart persistence.
+                st["loud"] = st["quiet"] = 0
+                continue
+            if st["last_step"] is not None and step == st["last_step"]:
+                continue  # no new boundary: not a new observation
+            st["last_step"] = step
+            if st["cooldown"] > 0:
+                st["cooldown"] -= 1
+            if med <= 1e-9:
+                st["loud"] = st["quiet"] = 0
+                continue
+            ratio = norm[rid] / med
+            if ratio >= self.hi:
+                st["loud"] += 1
+                st["quiet"] = 0
+                if (st["loud"] >= self.persist and st["cooldown"] == 0
+                        and st["fraction"] > self.floor + 1e-9):
+                    st["fraction"] = max(self.floor,
+                                         st["fraction"] - self.step)
+                    st["cooldown"] = self.cooldown
+                    st["loud"] = 0
+                    self.shrinks_total += 1
+            elif ratio <= self.lo:
+                st["quiet"] += 1
+                st["loud"] = 0
+                if (st["quiet"] >= self.relax and st["cooldown"] == 0
+                        and st["fraction"] < 1.0 - 1e-9):
+                    st["fraction"] = min(1.0,
+                                         st["fraction"] + self.step)
+                    st["cooldown"] = self.cooldown
+                    st["quiet"] = 0
+                    self.restores_total += 1
+            else:
+                st["loud"] = st["quiet"] = 0
+
+        fractions = self.fractions()
+        table = format_rebalance_table(fractions)
+        if table != self._table:
+            self._table = table
+            self._seq += 1
+        return fractions
+
+    def fractions(self) -> Dict[str, float]:
+        """Current target table: ladder fractions plus derived boosts.
+        The trimmed mass ``sum(1 - ladder)`` over shrunk groups is
+        reallocated evenly across headroom groups (ladder 1.0 AND
+        eligible at the last observation — a shrunken group that went
+        healing still counts as deficit, but a healer never receives
+        boost), capped at ``REBALANCE_CEIL``; any remainder past the
+        cap goes unallocated (the fleet total shrinks, logged by the
+        caller rather than overloading the fast groups)."""
+        deficit = sum(1.0 - st["fraction"]
+                      for st in self._state.values()
+                      if st["fraction"] < 1.0 - 1e-9)
+        headroom = [rid for rid in sorted(self._state)
+                    if self._state[rid]["fraction"] >= 1.0 - 1e-9
+                    and self._state[rid]["eligible"]]
+        out: Dict[str, float] = {}
+        bonus = deficit / len(headroom) if headroom and deficit > 1e-9 \
+            else 0.0
+        for rid in sorted(self._state):
+            st = self._state[rid]
+            if st["fraction"] < 1.0 - 1e-9:
+                out[rid] = st["fraction"]
+            elif rid in headroom and bonus > 0.0:
+                out[rid] = min(self.ceil, 1.0 + bonus)
+            else:
+                out[rid] = 1.0
+        return out
+
+    @property
+    def table(self) -> str:
+        return self._table
+
+    @property
+    def seq(self) -> int:
+        return self._seq
+
+
 class FleetAggregator:
     """Bounded per-group digest rings + the fleet aggregate.
 
@@ -226,6 +477,12 @@ class FleetAggregator:
             OrderedDict()
         self._sdc_verdicts_total = 0
         self._sdc_clears_total = 0
+        # Straggler-aware rebalancing (docs/design/fleet_rebalance.md):
+        # advanced once per aggregate from the same latest-digest view
+        # the straggler ranking reads. Always on — a uniform fleet
+        # yields an empty table, and only rebalance-armed managers
+        # adopt it.
+        self.rebalancer = Rebalancer()
 
     def ingest(self, digest: StepDigest,
                now_ms: Optional[int] = None) -> None:
@@ -251,6 +508,9 @@ class FleetAggregator:
         self._groups.pop(replica_id, None)
         self._commit_counts.pop(replica_id, None)
         self._quarantined.pop(replica_id, None)
+        # Farewell clears the rebalance fraction immediately: the
+        # departed slice must not keep inflating survivors' boosts.
+        self.rebalancer.forget(replica_id)
 
     def prune(self, now_ms: Optional[int] = None) -> None:
         """Age out rows past stale_ms. Unlike a farewell, pruning does
@@ -393,6 +653,16 @@ class FleetAggregator:
         baseline = [(rid, d) for rid, (_, d) in latest.items()
                     if d.baseline_eligible() and fresh[rid]]
         walls = [d.step_wall_ms for _, d in baseline]
+
+        # Rebalance ladder (docs/design/fleet_rebalance.md): one
+        # observation per group per NEW step, from the same latest
+        # view. Eligibility == the straggler-baseline flag; the digest
+        # reports the fraction its measured step actually ran under.
+        rebalance_fractions = self.rebalancer.observe(
+            [(rid, d.step, d.step_wall_ms,
+              getattr(d, "rebalance_fraction", 1.0),
+              d.baseline_eligible() and fresh[rid])
+             for rid, (_, d) in latest.items()])
         scores = robust_zscores(walls)
         score_by_id = {rid: sc for (rid, _), sc in zip(baseline, scores)}
         stage_median = {
@@ -427,6 +697,8 @@ class FleetAggregator:
                 "heal_last_ms": d.heal_last_ms,
                 "publish_last_ms": d.publish_last_ms,
                 "baseline": in_baseline,
+                "rebalance_fraction": round(
+                    rebalance_fractions.get(rid, 1.0), 4),
                 "trace_addr": d.trace_addr,
                 "attested": bool(d.state_digest) and fresh[rid]
                 and not d.healing,
@@ -463,6 +735,18 @@ class FleetAggregator:
                      if rec.get("trace_addr")}),
                 "sdc_verdicts_total": self._sdc_verdicts_total,
                 "sdc_clears_total": self._sdc_clears_total,
+                # Rebalance fraction table (only entries != 1.0; the
+                # canonical wire string is what the decider publishes).
+                "rebalance_fractions": {
+                    rid: round(f, 4)
+                    for rid, f in rebalance_fractions.items()
+                    if abs(f - 1.0) > 1e-9},
+                "rebalance_table": self.rebalancer.table,
+                "rebalance_seq": self.rebalancer.seq,
+                "rebalance_shrinks_total":
+                    self.rebalancer.shrinks_total,
+                "rebalance_restores_total":
+                    self.rebalancer.restores_total,
             },
             "straggler": straggler,
             "groups": groups,
@@ -673,6 +957,16 @@ def status_prometheus(status: Dict[str, Any],
         "# TYPE torchft_fleet_sdc_verdicts_total counter",
         f"torchft_fleet_sdc_verdicts_total "
         f"{float(f.get('sdc_verdicts_total', 0))!r}",
+        "# HELP torchft_fleet_rebalance_groups groups with a "
+        "rebalance fraction != 1",
+        "# TYPE torchft_fleet_rebalance_groups gauge",
+        f"torchft_fleet_rebalance_groups "
+        f"{float(len(f.get('rebalance_fractions', {})))!r}",
+        "# HELP torchft_fleet_rebalance_seq fraction-table change "
+        "counter",
+        "# TYPE torchft_fleet_rebalance_seq counter",
+        f"torchft_fleet_rebalance_seq "
+        f"{float(f.get('rebalance_seq', 0))!r}",
         "# HELP torchft_fleet_stage_median_ms fleet per-stage medians",
         "# TYPE torchft_fleet_stage_median_ms gauge",
     ]
@@ -686,6 +980,9 @@ def status_prometheus(status: Dict[str, Any],
         "# TYPE torchft_fleet_straggler_score gauge",
         "# HELP torchft_fleet_group_step_ms group step wall (ms)",
         "# TYPE torchft_fleet_group_step_ms gauge",
+        "# HELP torchft_fleet_rebalance_fraction assigned rebalance "
+        "batch fraction",
+        "# TYPE torchft_fleet_rebalance_fraction gauge",
     ]
     for g in status.get("groups", []):
         rid = _escape_label(str(g["replica_id"]))
@@ -695,6 +992,9 @@ def status_prometheus(status: Dict[str, Any],
         lines.append(
             f'torchft_fleet_group_step_ms{{replica_id="{rid}"}} '
             f'{float(g["step_wall_ms"])!r}')
+        lines.append(
+            f'torchft_fleet_rebalance_fraction{{replica_id="{rid}"}} '
+            f'{float(g.get("rebalance_fraction", 1.0))!r}')
     return "\n".join(lines) + "\n"
 
 
@@ -725,6 +1025,9 @@ def format_fleet_table(status: Dict[str, Any],
             " DEG" if g["capacity_fraction"] < 0.999 else "")
         if g.get("sdc_diverged"):
             flag = " SDC" + flag
+        reb = g.get("rebalance_fraction", 1.0)
+        if abs(reb - 1.0) > 1e-9:
+            flag += f" REB:{reb:.2f}"
         out.append(
             f"{g['replica_id']:<20.20} {g['step']:>7} "
             f"{g['step_wall_ms']:>9.1f} {g['straggler_score']:>+7.2f} "

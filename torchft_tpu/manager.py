@@ -53,6 +53,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from torchft_tpu import fleet as fleet_mod
 from torchft_tpu import policy as policy_mod
 from torchft_tpu import serialization
 from torchft_tpu import tracing as tracing_mod
@@ -72,6 +73,10 @@ MANAGER_ADDR_KEY: str = "manager/addr"
 # like the healset keys: the store has no delete/TTL, so a per-step key
 # would leak one entry per boundary for the life of the job).
 _POLICY_KEY: str = "torchft/policy"
+# Fixed quorum-store key the fleet-rebalance decision rides on (same
+# fixed-key rationale as _POLICY_KEY: no delete/TTL in the store, so a
+# per-step key would leak one entry per boundary).
+_REBALANCE_KEY: str = "torchft/rebalance"
 # Fold-weight encoding of a capacity fraction when the caller never
 # reports exact per-step sample counts (degraded-mode groups,
 # docs/design/degraded_mode.md): weight = round(fraction * SCALE).
@@ -370,6 +375,7 @@ class Manager:
         shard_update: bool = False,
         device_quantize: Optional[bool] = None,
         degraded_mode: Optional[bool] = None,
+        rebalance: Optional[bool] = None,
         heal_striped: Optional[bool] = None,
         auth_token: Optional[str] = None,
         checkpoint_bind_host: Optional[str] = None,
@@ -489,6 +495,38 @@ class Manager:
                 "on-device backends never issue")
         self._capacity_fraction = 1.0
         self._step_samples: Optional[int] = None
+        # --- straggler-aware rebalance (docs/design/fleet_rebalance.md) --
+        # Like degraded_mode, arming rebalance switches the fold into
+        # weighted mode — a cluster-wide WIRE-FORMAT property (every
+        # group weighted or none; mixing is a per-op preamble abort) —
+        # so it is a launch flag, not a live knob. The per-group batch
+        # fraction itself IS live: the lighthouse Rebalancer computes
+        # it from persistent straggler scores, the decider publishes it
+        # on the quorum store, and every group adopts only at commit
+        # boundaries (save_durable's refusal classes defer a boundary).
+        # _rebalance_frac_prev is the fraction that was IN FORCE for
+        # the step the next digest measures: the digest is pushed after
+        # adoption lands, so stamping the live value would mis-
+        # normalize the just-measured wall by one boundary.
+        if rebalance is None:
+            rebalance = os.environ.get(
+                "TORCHFT_REBALANCE", "0").strip().lower() in ("1", "true")
+        self._rebalance = bool(rebalance)
+        if self._rebalance and getattr(comm, "wants_device_arrays", False):
+            raise ValueError(
+                "rebalance requires a host-path communicator: the "
+                "weighted fold lives in the host ring's wire ops, which "
+                "on-device backends never issue")
+        self._rebalance_fraction = 1.0
+        self._rebalance_frac_prev = 1.0
+        self._rebalance_table = ""
+        self._rebalance_published: Optional[tuple] = None
+        # Chaos slow: band bookkeeping — last boundary timestamp and
+        # the sleep injected there, so the stretch applies to the
+        # NATURAL wall only (sleeping (f-1)x a wall that already
+        # includes the prior injection diverges for f >= 2).
+        self._chaos_slow_prev: Optional[float] = None
+        self._chaos_slow_injected = 0.0
         if heal_striped is None:
             heal_striped = os.environ.get(
                 "TORCHFT_HEAL_STRIPED", "1").strip() not in ("0", "false")
@@ -717,6 +755,13 @@ class Manager:
             "degraded_capacity_fraction": 1.0,
             "degrade_events_total": 0.0,
             "restore_events_total": 0.0,
+            # Straggler-aware rebalance (docs/design/fleet_rebalance.md):
+            # the lighthouse-assigned batch fraction in force (gauge,
+            # 1.0 = uniform share), adoptions that landed, and adoptions
+            # deferred a boundary by save_durable's refusal classes.
+            "rebalance_fraction": 1.0,
+            "rebalance_adoptions_total": 0.0,
+            "rebalance_deferred_total": 0.0,
             "policy_current": -1.0,
             "policy_switches_total": 0.0,
             "policy_switch_refusals": 0.0,
@@ -1109,6 +1154,12 @@ class Manager:
         # attestation vote at the NEXT boundary, which is exactly the
         # ≤1-boundary detection-latency bound the soak asserts.
         self._maybe_chaos_sdc()
+
+        # Chaos slow: band (docs/design/fleet_rebalance.md): stretch
+        # this group's step wall at the same edge, so soaks can mint a
+        # persistent straggler the lighthouse Rebalancer must shrink —
+        # without wall-clock hacks.
+        self._maybe_chaos_slow()
 
         if self._should_step:
             # Under the metrics lock so (participant_rank,
@@ -1517,6 +1568,16 @@ class Manager:
             self._metrics["slo_breaches_total"] += len(fresh)
             self._fleet_stage = _s("straggler_stage")
             self._fleet_straggler_id = _s("straggler_id")
+            # Rebalance fraction table (docs/design/fleet_rebalance.md):
+            # tri-state like the sdc verdict — a STRING (possibly empty:
+            # uniform fleet) refreshes the stored table; ABSENT
+            # (pre-rebalance lighthouses, duck-typed test clients) is
+            # inert, so an old control plane never reads as a
+            # restore-everyone-to-1.0 order. Adoption happens only at
+            # the commit boundary (_rebalance_post_vote).
+            rt = getattr(q, "rebalance_table", None)
+            if isinstance(rt, str):
+                self._rebalance_table = rt
         self._consume_sdc_verdict(q)
         if not fresh:
             return
@@ -2222,30 +2283,36 @@ class Manager:
             setter("diloco" if self._policy.diloco else "step")
         wsetter = getattr(self._comm, "set_wire_weight", None)
         if wsetter is not None:
-            wsetter(self._wire_weight() if self._degraded else -1)
+            weighted = self._degraded or self._rebalance
+            wsetter(self._wire_weight() if weighted else -1)
 
     def _wire_weight(self) -> int:
-        """This step's fold weight (degraded mode): 0 while healing or
-        benched (the zero contribution must carry zero weight), else
-        the samples the caller reported via :meth:`set_step_samples`
-        (an :class:`~torchft_tpu.data.ElasticSampler` draw reports
-        automatically), else a fixed-scale encoding of the capacity
-        fraction — so groups that share a batch config stay
-        PROPORTIONAL whether or not they report exact counts, as long
-        as every group uses the same convention."""
+        """This step's fold weight (degraded mode / rebalance): 0 while
+        healing or benched (the zero contribution must carry zero
+        weight), else the samples the caller reported via
+        :meth:`set_step_samples` (an
+        :class:`~torchft_tpu.data.ElasticSampler` draw reports
+        automatically), else a fixed-scale encoding of the EFFECTIVE
+        fraction (capacity x rebalance — the same product
+        :meth:`participant_slot` snapshots, so the sampler's draw and
+        the fallback weight always agree) — so groups that share a
+        batch config stay PROPORTIONAL whether or not they report
+        exact counts, as long as every group uses the same
+        convention."""
         if not self.is_participating():
             return 0
         with self._metrics_lock:
             samples = self._step_samples
-            frac = self._capacity_fraction
+            frac = self._capacity_fraction * self._rebalance_fraction
         if samples is not None:
             return max(int(samples), 0)
         return max(1, int(round(frac * _CAPACITY_WEIGHT_SCALE)))
 
     def set_step_samples(self, samples: Optional[int]) -> None:
         """Report the samples this group actually contributes this step
-        (the degraded-mode fold weight). ``None`` reverts to the
-        capacity-fraction-derived weight. No-op outside degraded mode."""
+        (the weighted fold's weight). ``None`` reverts to the
+        fraction-derived weight. No-op unless degraded mode or
+        rebalance armed the weighted fold."""
         with self._metrics_lock:
             self._step_samples = (None if samples is None
                                   else int(samples))
@@ -3419,6 +3486,133 @@ class Manager:
         except Exception:  # noqa: BLE001 — advertisement is best-effort
             logger.debug("capacity publication failed", exc_info=True)
 
+    # --------------------------------------------- fleet rebalance
+    # Straggler-aware nonuniform data parallelism
+    # (docs/design/fleet_rebalance.md): the lighthouse Rebalancer (the
+    # fleet.py mirror of _core/lighthouse.cc) turns persistent
+    # straggler scores into per-group batch fractions (floor 0.5,
+    # trimmed slice reallocated to headroom groups, hysteresis +
+    # cooldown so transient stalls never flap the fleet) and echoes
+    # the table in every FleetHint. The fractions land through the
+    # SAME decider-publishes/all-adopt protocol as policy switches:
+    # participating rank 0 publishes {step}:{table} on the quorum
+    # store every boundary, every group adopts its own entry on read —
+    # only at commit boundaries, with save_durable's refusal classes
+    # deferring the adoption one boundary. The adopted fraction
+    # composes multiplicatively with degraded-mode capacity inside
+    # participant_slot(); the ElasticSampler draw reports the exact
+    # sample count as the fold weight, so the wire-v4 weighted
+    # canonical fold keeps the update bitwise with zero new wire
+    # format.
+
+    def rebalance_enabled(self) -> bool:
+        """True when this Manager was built with ``rebalance=True``
+        (weighted folding armed cluster-wide, lighthouse fractions
+        adopted at commit boundaries)."""
+        return self._rebalance
+
+    def rebalance_fraction(self) -> float:
+        """The rebalance batch fraction in force (1.0 = uniform
+        share)."""
+        with self._metrics_lock:
+            return self._rebalance_fraction
+
+    def _land_rebalance(self, fraction: float, reason: str) -> bool:
+        """Adopt a lighthouse-assigned batch fraction at this commit
+        boundary, or defer: the :meth:`_land_capacity` discipline with
+        the rebalance counters (a refused adoption counts
+        ``rebalance_deferred_total`` and retries at the next boundary —
+        the table re-reads every round, so nothing is lost)."""
+        blocked = self._capacity_blocked()
+        if blocked:
+            with self._metrics_lock:
+                self._metrics["rebalance_deferred_total"] += 1
+            self._log_event(event="rebalance_deferred", step=self._step,
+                            fraction=fraction, why=",".join(blocked))
+            logger.warning(
+                "%s: rebalance to fraction %.4f deferred (%s); retry "
+                "at the next boundary", self._replica_id, fraction,
+                ",".join(blocked))
+            return False
+        with self._metrics_lock:
+            prev = self._rebalance_fraction
+            self._rebalance_fraction = float(fraction)
+            self._metrics["rebalance_fraction"] = float(fraction)
+            self._metrics["rebalance_adoptions_total"] += 1
+        self._log_event(event="rebalance_adopt", step=self._step,
+                        reason=reason,
+                        **{"from": prev, "to": fraction})
+        self._flight_dump("rebalance_adopt",
+                          **{"from": prev, "to": fraction, "why": reason})
+        logger.info("%s rebalance fraction %.4f -> %.4f at step %d (%s)",
+                    self._replica_id, prev, fraction, self._step, reason)
+        return True
+
+    def _rebalance_pre_vote(self) -> None:
+        """Decider half of the rebalance boundary hook: participating
+        rank 0 publishes ``{step}:{table}`` (the latest FleetHint
+        fraction table) under the fixed key every boundary —
+        unconditionally, like the policy decider, so a follower's read
+        never blocks on a boundary with no change."""
+        if not self._rebalance:
+            return
+        addr, _rw, _mw, coordinated = self._policy_coordination()
+        if not coordinated:
+            return
+        if self._participating_rank != 0 or not self.is_participating():
+            return
+        with self._metrics_lock:
+            table = self._rebalance_table
+        value = f"{self._step}:{table}"
+        try:
+            store = self._store_client(addr)
+            if store is not None:
+                store.set(_REBALANCE_KEY, value.encode())
+                self._rebalance_published = (self._step, table)
+        except Exception:  # noqa: BLE001 — retried next boundary
+            logger.debug("rebalance publication failed", exc_info=True)
+
+    def _rebalance_post_vote(self) -> None:
+        """All-groups half: read the published table (coordinated) or
+        fall back to this group's own hint copy (single-group /
+        storeless runs), pick out our entry — absent means 1.0, the
+        restore-to-uniform spelling and the farewell path's implicit
+        clear (a departed group's entry is dropped from the table the
+        same round the lighthouse forgets its digests) — clamp to the
+        ladder bounds, and land it via :meth:`_land_rebalance`. A
+        failed read adopts nothing: stale-but-consistent beats a
+        torn default."""
+        if not self._rebalance:
+            return
+        addr, _rw, _mw, coordinated = self._policy_coordination()
+        table: Optional[str] = None
+        if coordinated:
+            try:
+                store = self._store_client(addr)
+                if store is not None:
+                    raw = store.get(
+                        _REBALANCE_KEY,
+                        timeout_ms=min(self._timeout_ms, 2000)).decode()
+                    _seq, _, table = raw.partition(":")
+            except Exception:  # noqa: BLE001 — next boundary re-reads
+                logger.debug("rebalance decision read failed",
+                             exc_info=True)
+                return
+        else:
+            with self._metrics_lock:
+                table = self._rebalance_table
+        if table is None:
+            return
+        fractions = fleet_mod.parse_rebalance_table(table)
+        target = float(fractions.get(self._replica_id, 1.0))
+        target = min(fleet_mod.REBALANCE_CEIL,
+                     max(fleet_mod.REBALANCE_FLOOR, target))
+        with self._metrics_lock:
+            cur = self._rebalance_fraction
+        if abs(target - cur) < 1e-9:
+            return
+        self._land_rebalance(target, reason="lighthouse table")
+
     # ------------------------------------------------- adaptive policy
     # Hot-swappable FT knobs (docs/design/adaptive_policy.md): the
     # policy in force bundles overlap_steps / wire rung / DiLoCo /
@@ -3705,6 +3899,7 @@ class Manager:
 
         if self._controller is not None:
             self._policy_pre_vote()
+        self._rebalance_pre_vote()
 
         enough = self._participating_world_size >= self._min_replica_size
         local_ok = self._errored is None and enough
@@ -3740,6 +3935,7 @@ class Manager:
                 error=repr(self._errored) if self._errored else None)
         if self._controller is not None:
             self._policy_post_vote(decision)
+        self._rebalance_post_vote()
         self._publish_status()
 
         # Shut the heal window before the caller mutates state (reference
@@ -3870,6 +4066,16 @@ class Manager:
             "publish_count": mx.get("publish_count", 0.0),
         }
         self._digest_prev = snap
+        # The rebalance fraction stamped below is the one that was IN
+        # FORCE for the step this digest MEASURES — the digest is
+        # pushed after this boundary's adoption landed, so the live
+        # value would mis-normalize the just-measured wall by one
+        # boundary. Rolled on EVERY boundary (including the skipped
+        # first one, whose adoption would otherwise stamp one boundary
+        # late) so prev always holds the previous boundary's adoption.
+        with self._metrics_lock:
+            reb_prev = self._rebalance_frac_prev
+            self._rebalance_frac_prev = self._rebalance_fraction
         if prev is None:
             return  # the first boundary has no wall to report yet
 
@@ -3912,23 +4118,30 @@ class Manager:
             quorum_id=self._quorum_id,
             state_digest=self._compute_state_digest(),
         )
+        # The lighthouse divides step_wall by the stamped fraction to
+        # compare groups on equal-work terms
+        # (docs/design/fleet_rebalance.md); rolled above.
+        reb_kw = dict(rebalance_fraction=reb_prev)
+        ram_kw = dict(ram_peers=int(mx["ram_ckpt_peers"])
+                      if "ram_ckpt_peers" in mx else -1)
         try:
             try:
-                # RAM-tier fan-in rides the same digest (-1 = tier off)
-                # so the fleet plane sees a replication-set collapse;
-                # the TypeError retry ladder keeps older control planes
-                # that predate each field generation working unchanged:
-                # first the full spelling, then attestation without the
-                # (still unplumbed) ram_peers field, then the bare
-                # pre-attestation digest.
-                set_digest(ram_peers=int(mx["ram_ckpt_peers"])
-                           if "ram_ckpt_peers" in mx else -1,
-                           **attest_kw, **kwargs)
+                # RAM-tier fan-in and the rebalance fraction ride the
+                # same digest; the TypeError retry ladder keeps older
+                # control planes that predate each field generation
+                # working unchanged: the full spelling first, then
+                # without the (still unplumbed) ram_peers field, then
+                # the pre-rebalance attestation digest, then the bare
+                # pre-attestation one.
+                set_digest(**reb_kw, **ram_kw, **attest_kw, **kwargs)
             except TypeError:
                 try:
-                    set_digest(**attest_kw, **kwargs)
+                    set_digest(**reb_kw, **attest_kw, **kwargs)
                 except TypeError:
-                    set_digest(**kwargs)
+                    try:
+                        set_digest(**attest_kw, **kwargs)
+                    except TypeError:
+                        set_digest(**kwargs)
         except Exception:  # noqa: BLE001 — observability never fails
             logger.debug("digest push failed", exc_info=True)
 
@@ -4394,6 +4607,41 @@ class Manager:
             "%s: chaos sdc_flip at step %d — leaf %d byte %d bit %d",
             self._replica_id, self._step, li, byte, bit)
 
+    def _maybe_chaos_slow(self) -> None:
+        """:meth:`step`'s chaos hook for the ``slow`` band
+        (docs/design/fleet_rebalance.md): poll the channel once per
+        commit boundary and, on a ``slow`` decision, sleep
+        ``(factor - 1) x`` the NATURAL wall of the boundary just
+        finished — natural meaning the measured wall minus the sleep
+        THIS hook injected there, so the stretch converges to a steady
+        ``factor x`` wall instead of compounding its own injections
+        (at factor >= 2 the naive spelling diverges). Participants
+        only, like the sdc band: a healer/spare contributes no wall
+        the Rebalancer reads. No schedule / no config for this
+        endpoint = no decision draw (stream purity)."""
+        now = time.monotonic()
+        prev = self._chaos_slow_prev
+        injected = self._chaos_slow_injected
+        self._chaos_slow_prev = now
+        self._chaos_slow_injected = 0.0
+        if not self.is_participating():
+            return
+        try:
+            from torchft_tpu import chaos as chaos_mod
+
+            factor = chaos_mod.slow_fault(f"slow:{self._replica_id}")
+        except Exception:  # noqa: BLE001 — chaos never fails a step
+            logger.debug("slow chaos injection failed", exc_info=True)
+            return
+        if factor <= 1.0 or prev is None:
+            return
+        natural = max(0.0, (now - prev) - injected)
+        sleep_s = (factor - 1.0) * natural
+        if sleep_s <= 0.0:
+            return
+        self._chaos_slow_injected = sleep_s
+        time.sleep(sleep_s)
+
     # ------------------------------------------------- durable checkpoints
 
     def save_durable(self, writer: Any, directory: str,
@@ -4720,12 +4968,16 @@ class Manager:
 
     def participant_slot(self) -> tuple:
         """Atomic ``(participant_rank, batches_committed,
-        capacity_fraction)`` snapshot.
+        effective_fraction)`` snapshot, where the fraction is the
+        degraded-mode capacity times the rebalance share
+        (docs/design/fleet_rebalance.md) — the one number
+        :class:`~torchft_tpu.data.ElasticSampler` sizes its draw by.
 
         All three are written under the metrics lock (``step()`` bumps
         the commit counter, the quorum thread installs the new rank,
         :meth:`request_degrade`/:meth:`request_restore` move the
-        capacity), so unlike separate accessor calls this can never
+        capacity, :meth:`_land_rebalance` moves the rebalance share),
+        so unlike separate accessor calls this can never
         observe a torn combination — e.g. the new rank with the
         previous step's counter, or a fresh capacity with a stale rank
         — which would make :class:`~torchft_tpu.data.ElasticSampler`
@@ -4753,7 +5005,13 @@ class Manager:
                 rank: Optional[int] = None
             else:
                 rank = self._participating_rank
-            return rank, self._batches_committed, self._capacity_fraction
+            # Effective fraction = degraded capacity x rebalance share:
+            # the two compose multiplicatively, and the sampler's draw
+            # (round(batch x this)) reported as the exact fold weight
+            # keeps the weighted canonical fold bitwise for the product
+            # just as for either factor alone.
+            frac = self._capacity_fraction * self._rebalance_fraction
+            return rank, self._batches_committed, frac
 
     def is_participating(self) -> bool:
         """False while healing (async), benched as a spare (reference
