@@ -1532,6 +1532,12 @@ class _UplinkCapProxy:
             except OSError:
                 cli.close()
                 continue
+            for s in (cli, up):
+                try:
+                    s.setsockopt(_socket.IPPROTO_TCP,
+                                 _socket.TCP_NODELAY, 1)
+                except OSError:
+                    pass
             for src, dst, capped in ((cli, up, False), (up, cli, True)):
                 threading.Thread(target=self._pump,
                                  args=(src, dst, capped),
@@ -1562,6 +1568,14 @@ class _UplinkCapProxy:
                     s.shutdown(2)
                 except OSError:
                     pass
+
+    def set_rate(self, mb_s: float) -> None:
+        """Retune the cap live — legs warm their fleet uncapped (the
+        initial full sync is not what's being measured), then clamp to
+        the modeled uplink before the clock starts."""
+        with self._tlock:
+            self._rate = mb_s * 1e6
+            self._tokens = min(self._tokens, self._rate * 0.05)
 
     def shutdown(self) -> None:
         self._alive = False
@@ -1742,6 +1756,333 @@ def bench_publish_fanout(payload_mb: float = 4.0, subscribers: int = 12,
         pub_proxy.shutdown()
         srv2.shutdown()
     return out
+
+
+def bench_publish_delta_ab(payload_mb: float = 4.0,
+                           publishes: int = 3) -> Dict[str, float]:
+    """Quantized delta publication A/B (docs/design/serving.md): one
+    ``delta=True`` publisher, two synced subscribers — the delta leg
+    negotiates int8+pow2-scale wires per leaf, the full leg fetches
+    exact f32 — across ``publishes`` small-touch updates (1 of 12
+    leaves nudged). Reported: delta wire bytes vs the changed leaves'
+    f32 bytes (design target <= ~1/4 — int8 payload plus pow2 scale
+    tables), total fetched bytes both legs, and the bitwise verdict
+    (both legs must hold identical bits every generation — the delta
+    route reconstructs the SAME published array the full route
+    serves)."""
+    from torchft_tpu.retry import RetryPolicy
+    from torchft_tpu.serving import (PublicationServer, WeightPublisher,
+                                     WeightSubscriber)
+
+    rng = np.random.default_rng(23)
+    n_leaves = 12
+    per = max(int(payload_mb * 1e6 / 4 / n_leaves), 1)
+    state = {f"l{i}": rng.normal(size=per).astype(np.float32)
+             for i in range(n_leaves)}
+    template = {f"l{i}": np.zeros(per, np.float32)
+                for i in range(n_leaves)}
+    pol = RetryPolicy(max_attempts=4, base_delay_ms=10.0, jitter=0.0)
+    pub = WeightPublisher(keep_generations=2, delta=True)
+    srv = PublicationServer(pub, bind_host="127.0.0.1")
+    out: Dict[str, float] = {
+        "payload_mbytes": per * 4 * n_leaves / 1e6,
+        "publishes": float(publishes),
+    }
+    on = off = None
+    try:
+        pub.publish(state, step=0)
+        on = WeightSubscriber(srv.address(), template, retry_policy=pol,
+                              delta=True, name="delta-on")
+        off = WeightSubscriber(srv.address(), template, retry_policy=pol,
+                               delta=False, name="delta-off")
+        on.sync()
+        off.sync()
+        delta_fetched = full_fetched = 0.0
+        bitwise = True
+        st = state
+        for k in range(publishes):
+            st = dict(st)
+            lk = f"l{k % n_leaves}"
+            st[lk] = (np.asarray(st[lk])
+                      + np.float32(1e-3)
+                      * rng.normal(size=per).astype(np.float32))
+            pub.publish(st, step=k + 1)
+            a0 = on.metrics()["serve_bytes_fetched_total"]
+            b0 = off.metrics()["serve_bytes_fetched_total"]
+            on.sync()
+            off.sync()
+            delta_fetched += on.metrics()[
+                "serve_bytes_fetched_total"] - a0
+            full_fetched += off.metrics()[
+                "serve_bytes_fetched_total"] - b0
+            wa, wb = on.weights(), off.weights()
+            bitwise = bitwise and all(
+                np.array_equal(np.asarray(wa[key]).view(np.uint32),
+                               np.asarray(wb[key]).view(np.uint32))
+                for key in wa)
+        m = on.metrics()
+        out["delta_wire_bytes"] = m["serve_delta_wire_bytes_total"]
+        # Denominator: the full leg's MEASURED bytes for the same
+        # generations — both legs fetch the same changed-leaf set (the
+        # nudged leaf plus the error-feedback correction of the
+        # previous one), so this is the honest f32 cost of the update.
+        out["changed_f32_bytes"] = full_fetched
+        out["delta_wire_ratio"] = (
+            out["delta_wire_bytes"] / max(full_fetched, 1.0))
+        out["delta_fetched_bytes"] = delta_fetched
+        out["full_fetched_bytes"] = full_fetched
+        out["fetched_ratio"] = delta_fetched / max(full_fetched, 1.0)
+        out["delta_crc_fallbacks"] = m["serve_delta_crc_fallbacks"]
+        out["bitwise_equal"] = float(bitwise)
+        out["wire_ratio_target"] = 0.25
+    finally:
+        for s in (on, off):
+            if s is not None:
+                s.stop()
+        srv.shutdown()
+    return out
+
+
+def bench_publish_steering_ab(payload_mb: float = 1.0,
+                              base_subscribers: int = 12,
+                              scale: int = 10,
+                              uplink_mb_s: float = 0.5,
+                              publishes: int = 2) -> Dict[str, float]:
+    """Relay-steering A/B at fleet scale (docs/design/serving.md).
+    Four uplink-capped legs, every node's aggregate egress pinned at
+    ``uplink_mb_s`` (:class:`_UplinkCapProxy`), deltas on throughout:
+
+    * ``base_subscribers`` steered through a depth-1 relay tree (the
+      small fleet) and the same fleet direct (its control),
+    * ``base_subscribers * scale`` steered through a depth-2 tree with
+      the SAME bounded fan-out at every node (the ~10x fleet — the
+      acceptance question: does publish-to-visible p95 stay ~flat?),
+    * ``base_subscribers * scale`` direct (steering off — every
+      subscriber on the root's one capped uplink; the control).
+
+    The paired controls turn "~flat" into a measured contrast: growing
+    the fleet 10x grows the steered p95 by roughly one extra tree
+    level (~2-3x, log depth), while the direct control's p95 grows
+    ~linearly with the fleet (~10x) because every subscriber shares
+    the root's one capped uplink.
+
+    Scaling the fleet grows the tree, never any single node's egress:
+    a 10x fleet adds one tree level (log growth), so p95 tracks tree
+    DEPTH x per-hop drain instead of fleet size. Subscribers find their
+    leaf via cascade steering — the root steers to an L1 relay, whose
+    own relay table steers onward to its least-loaded L2 child.
+
+    The defaults keep the modeled uplink slow relative to the CPU cost
+    of pumping bytes, so the capped links (not the single-core python
+    harness, which serializes every node of the simulated fleet) set
+    the measured latencies.
+
+    The large steered leg then kills one relay mid-run and publishes
+    again: its children must re-parent (rotate to the root, get
+    steered to a live relay) and the WHOLE fleet must converge on the
+    final generation bitwise — no torn observation is tolerated."""
+    from torchft_tpu.retry import RetryPolicy
+    from torchft_tpu.serving import (PublicationServer, WeightPublisher,
+                                     WeightRelay, WeightSubscriber)
+
+    rng = np.random.default_rng(23)
+    n_leaves = 12
+    per = max(int(payload_mb * 1e6 / 4 / n_leaves), 1)
+    state = {f"l{i}": rng.normal(size=per).astype(np.float32)
+             for i in range(n_leaves)}
+    template = {f"l{i}": np.zeros(per, np.float32)
+                for i in range(n_leaves)}
+    pol = RetryPolicy(max_attempts=5, base_delay_ms=10.0, jitter=0.0)
+
+    class _TimedSub(WeightSubscriber):
+        def __init__(self, *a, **kw):
+            super().__init__(*a, **kw)
+            self.seen: Dict[int, float] = {}
+
+        def _on_generation(self, held, body_digests):
+            self.seen[held.generation] = time.perf_counter()
+
+    def leg(n_subs: int, levels: list,
+            kill_relay: bool) -> Dict[str, float]:
+        steer = bool(levels)
+        pub = WeightPublisher(keep_generations=3, delta=True,
+                              relay_ttl_s=1.5)
+        srv = PublicationServer(pub, bind_host="127.0.0.1")
+        pub.publish(state, step=0)
+        root_proxy = _UplinkCapProxy(srv.address(), 10_000.0)
+        relays: list = []
+        relay_proxies: list = []
+        subs: list = []
+        res: Dict[str, float] = {}
+        try:
+            # Build the relay tree level by level (bounded fan-out at
+            # every node — the CDN shape). Children beat their PARENT,
+            # so each level registers in its parent's table and the
+            # cascade steer (root -> L1 -> ... -> leaf) walks
+            # subscribers down to a leaf relay.
+            prev = [(root_proxy, pub)]
+            for li, n in enumerate(levels):
+                cur = []
+                for i in range(n):
+                    parent_proxy, _ = prev[i % len(prev)]
+                    r = WeightRelay(parent_proxy.address(), template,
+                                    bind_host="127.0.0.1",
+                                    retry_policy=pol,
+                                    beat_interval_s=0.2,
+                                    relay_ttl_s=1.5,
+                                    long_poll_s=5.0,
+                                    poll_interval_s=0.02,
+                                    name=f"steer-relay{li}.{i}")
+                    rp = _UplinkCapProxy(r.address(), 10_000.0)
+                    r.set_advertise(rp.address())
+                    relays.append(r)
+                    relay_proxies.append(rp)
+                    cur.append((rp, r.publisher()))
+                for r in relays[-n:]:
+                    r.sync()
+                    r.start()
+                deadline = time.monotonic() + 20
+                while (sum(len(p.relay_rows()) for _, p in prev) < n
+                       and time.monotonic() < deadline):
+                    time.sleep(0.05)
+                prev = cur
+            subs = [_TimedSub(root_proxy.address(), template,
+                              retry_policy=pol, steer=steer, delta=True,
+                              long_poll_s=5.0, poll_interval_s=0.02,
+                              name=f"steer-sub{i}").start()
+                    for i in range(n_subs)]
+            deadline = time.monotonic() + 60
+            while any(s.generation() < 1 for s in subs):
+                if time.monotonic() > deadline:
+                    raise TimeoutError("steering fleet never warmed")
+                time.sleep(0.02)
+            lat_ms: list = []
+            st = state
+            gen = 0
+            # Publish 0 runs UNCAPPED: it seeds the quantized
+            # error-feedback steady state (every later small-touch
+            # publish moves exactly two leaves — the nudged one plus
+            # the EF correction of the previous), so the measured
+            # publishes are byte-identical. Caps clamp right after it.
+            for k in range(publishes + 1):
+                st = dict(st)
+                lk = f"l{k % n_leaves}"
+                st[lk] = (np.asarray(st[lk])
+                          + np.float32(1e-3)
+                          * rng.normal(size=per).astype(np.float32))
+                t0 = time.perf_counter()
+                gen = pub.publish(st, step=k + 1)
+                deadline = time.monotonic() + 60
+                while any(gen not in s.seen for s in subs):
+                    if time.monotonic() > deadline:
+                        raise TimeoutError(
+                            f"gen {gen} never fully visible "
+                            f"(n={n_subs} steer={steer})")
+                    time.sleep(0.005)
+                if k == 0:
+                    # Clock starts now: clamp every uplink to the cap.
+                    root_proxy.set_rate(uplink_mb_s)
+                    for rp in relay_proxies:
+                        rp.set_rate(uplink_mb_s)
+                    continue
+                lat_ms += [(s.seen[gen] - t0) * 1e3 for s in subs]
+            lat_ms.sort()
+            res["p50_ms"] = lat_ms[len(lat_ms) // 2]
+            res["p95_ms"] = lat_ms[
+                min(int(len(lat_ms) * 0.95), len(lat_ms) - 1)]
+            if kill_relay and relays:
+                # Kill a LEAF relay: its subscribers must rotate back
+                # to the root and get re-steered down a live branch.
+                dead = relays[-1]
+                dead_addr = relay_proxies[-1].address().rstrip("/")
+                orphans = sum(
+                    1 for s in subs
+                    if s._parents[0].rstrip("/") == dead_addr)
+                dead.stop()
+                relay_proxies[-1].shutdown()
+                st = dict(st)
+                st["l0"] = np.asarray(st["l0"]) + np.float32(1.0)
+                gen = pub.publish(st, step=publishes + 2)
+                deadline = time.monotonic() + 90
+                while any(gen not in s.seen for s in subs):
+                    if time.monotonic() > deadline:
+                        raise TimeoutError(
+                            "fleet never converged after relay kill")
+                    time.sleep(0.01)
+                res["kill_orphans"] = float(orphans)
+                res["kill_reparented"] = float(sum(
+                    1 for s in subs
+                    if s._parents[0].rstrip("/") != dead_addr))
+            # Torn-observation audit: every subscriber's held tree must
+            # be bitwise the final published generation (the publisher
+            # retains the reconstruction it served).
+            final = pub._head.state  # noqa: SLF001 — bench audit
+            torn = 0
+            for s in subs:
+                w = s.weights()
+                if not all(
+                        np.array_equal(
+                            np.asarray(w[key]).view(np.uint32),
+                            np.asarray(final[key]).view(np.uint32))
+                        for key in final):
+                    torn += 1
+            res["torn_observations"] = float(torn)
+            res["steers"] = float(
+                pub.metrics()["relay_steers"]
+                + sum(r.publisher().metrics().get("relay_steers", 0.0)
+                      for r in relays))
+        finally:
+            for s in subs:
+                s.request_stop()
+            for r in relays:
+                r.request_stop()
+            for s in subs:
+                s.stop()
+            for r in relays:
+                r.stop()
+            for rp in relay_proxies:
+                rp.shutdown()
+            root_proxy.shutdown()
+            srv.shutdown()
+        return res
+
+    big = base_subscribers * scale
+    small_levels = [2]
+    large_levels = [4, 20]
+    small = leg(base_subscribers, small_levels, kill_relay=False)
+    small_direct = leg(base_subscribers, [], kill_relay=False)
+    steered = leg(big, large_levels, kill_relay=True)
+    direct = leg(big, [], kill_relay=False)
+    return {
+        "payload_mbytes": per * 4 * n_leaves / 1e6,
+        "uplink_cap_mb_s": uplink_mb_s,
+        "relays_small": float(sum(small_levels)),
+        "relays_large": float(sum(large_levels)),
+        "subscribers_small": float(base_subscribers),
+        "subscribers_large": float(big),
+        "small_p50_ms": small["p50_ms"],
+        "small_p95_ms": small["p95_ms"],
+        "small_direct_p95_ms": small_direct["p95_ms"],
+        "steered_p50_ms": steered["p50_ms"],
+        "steered_p95_ms": steered["p95_ms"],
+        "direct_p50_ms": direct["p50_ms"],
+        "direct_p95_ms": direct["p95_ms"],
+        # ~flat == this ratio stays near 1 (one extra tree level) as
+        # the fleet grows 10x; the direct control grows ~linearly.
+        "steered_growth_p95_ratio": (
+            steered["p95_ms"] / max(small["p95_ms"], 1e-9)),
+        "direct_growth_p95_ratio": (
+            direct["p95_ms"] / max(small_direct["p95_ms"], 1e-9)),
+        "direct_over_steered_p95": (
+            direct["p95_ms"] / max(steered["p95_ms"], 1e-9)),
+        "steers": steered["steers"],
+        "kill_orphans": steered.get("kill_orphans", 0.0),
+        "kill_reparented": steered.get("kill_reparented", 0.0),
+        "torn_observations": (small["torn_observations"]
+                              + small_direct["torn_observations"]
+                              + steered["torn_observations"]
+                              + direct["torn_observations"]),
+    }
 
 
 def bench_qos_contention(payload_mb: float = 8.0, pub_streams: int = 6,
@@ -3200,6 +3541,51 @@ def main() -> None:
            "async_over_threaded_relay": round(
                pf["relay_agg_mb_s"]
                / max(pf_thr["relay_agg_mb_s"], 1e-9), 3)})
+
+    # Quantized delta publication A/B (ISSUE 20): delta wire bytes on a
+    # small-touch update must land at ~1/4 of the changed leaves' f32
+    # bytes, and the delta leg must hold bitwise identity with the
+    # full-fetch leg every generation.
+    da = bench_publish_delta_ab()
+    _emit({"metric": "publish_delta_ab",
+           "payload_mbytes": round(da["payload_mbytes"], 2),
+           "publishes": da["publishes"],
+           "delta_wire_bytes": da["delta_wire_bytes"],
+           "changed_f32_bytes": da["changed_f32_bytes"],
+           "delta_wire_ratio": round(da["delta_wire_ratio"], 4),
+           "fetched_ratio": round(da["fetched_ratio"], 4),
+           "delta_crc_fallbacks": da["delta_crc_fallbacks"],
+           "bitwise_equal": da["bitwise_equal"],
+           "vs_wire_target": round(
+               da["wire_ratio_target"]
+               / max(da["delta_wire_ratio"], 1e-9), 3)})
+
+    # Relay-steering A/B (ISSUE 20): with deltas + steering on, the
+    # ~10x fleet's publish-to-visible p95 must stay ~flat vs the small
+    # fleet under the same fixed uplink cap, and a relay killed mid-run
+    # must re-parent its children with zero torn observations.
+    sa = bench_publish_steering_ab()
+    _emit({"metric": "publish_steering_ab",
+           "payload_mbytes": round(sa["payload_mbytes"], 2),
+           "uplink_cap_mb_s": sa["uplink_cap_mb_s"],
+           "relays_small": sa["relays_small"],
+           "relays_large": sa["relays_large"],
+           "subscribers_small": sa["subscribers_small"],
+           "subscribers_large": sa["subscribers_large"],
+           "small_p95_ms": round(sa["small_p95_ms"], 1),
+           "small_direct_p95_ms": round(sa["small_direct_p95_ms"], 1),
+           "steered_p95_ms": round(sa["steered_p95_ms"], 1),
+           "direct_p95_ms": round(sa["direct_p95_ms"], 1),
+           "steered_growth_p95_ratio": round(
+               sa["steered_growth_p95_ratio"], 3),
+           "direct_growth_p95_ratio": round(
+               sa["direct_growth_p95_ratio"], 3),
+           "direct_over_steered_p95": round(
+               sa["direct_over_steered_p95"], 3),
+           "steers": sa["steers"],
+           "kill_orphans": sa["kill_orphans"],
+           "kill_reparented": sa["kill_reparented"],
+           "torn_observations": sa["torn_observations"]})
 
     # Heal-vs-publish contention on the shared substrate (ISSUE 17): a
     # saturating publication leg must not starve the heal class — the

@@ -115,6 +115,23 @@ if [[ "${1:-}" == "serve" ]]; then
     exit 0
 fi
 
+# Relay tier: the CDN-scale serving gate (docs/design/serving.md) —
+# quantized delta publication (the tft-publish-delta-1 doc/data routes,
+# per-leaf wire+recon crc verification with automatic exact-f32
+# fallback, verbatim relay adoption so grandchildren get bitwise the
+# root's reconstruction), the lock-striped relay beat table (TTL prune,
+# least-loaded pick, between-beat assignment spreading), steering
+# (head hints, subscriber re-parenting, dead-hint cooldown), and relay
+# registration/death re-parenting. Tier-1 and native-free; this tier
+# reruns just them on serving/bench changes. The steered-delta churn
+# soak is marked nightly+slow and rides the nightly tier.
+if [[ "${1:-}" == "relay" ]]; then
+    stage relay env JAX_PLATFORMS=cpu \
+        python -m pytest tests/test_serving.py -q -m relay
+    echo "== total: ${SECONDS}s"
+    exit 0
+fi
+
 # Policy tier: the adaptive FT policy layer's focused gate
 # (docs/design/adaptive_policy.md) — FTPolicy/PolicyController
 # ladder+hysteresis units, the Manager's commit-boundary switch

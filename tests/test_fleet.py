@@ -410,6 +410,10 @@ FLEET_METRIC_NAMES = frozenset([
     "torchft_fleet_rebalance_fraction",
     "torchft_fleet_stage_median_ms",
     "torchft_fleet_straggler_score", "torchft_fleet_group_step_ms",
+    # publication relay tier (docs/design/serving.md)
+    "torchft_fleet_relays", "torchft_fleet_relay_children",
+    "torchft_fleet_relay_lag_gens_max",
+    "torchft_fleet_relay_child_count", "torchft_fleet_relay_lag_gens",
 ])
 
 
@@ -454,6 +458,30 @@ class TestRenderers:
         assert 'replica_id="g\\"q\\\\z"' in text
         assert 'replica_id="g\\nnl"' in text
         assert "\ng\nnl" not in text
+
+    def test_relay_tier_rides_aggregate_and_exposition(self):
+        agg = FleetAggregator()
+        agg.ingest(mk_digest("g0", 100.0), now_ms=0)
+        agg.note_relays([
+            {"id": "r1", "addr": "http://r1/publish", "children": 3,
+             "lag_gens": 0, "age_s": 0.1},
+            {"id": "r2", "addr": "http://r2/publish", "children": 1,
+             "lag_gens": 2, "age_s": 0.4},
+        ])
+        st = agg.aggregate(now_ms=1)
+        assert st["fleet"]["relays"] == 2
+        assert st["fleet"]["relay_children"] == 4
+        assert st["fleet"]["relay_lag_gens_max"] == 2
+        assert [r["id"] for r in st["relays"]] == ["r1", "r2"]
+        text = status_prometheus(st)
+        assert _exposition_names(text) == FLEET_METRIC_NAMES
+        assert "torchft_fleet_relays 2.0" in text
+        assert "torchft_fleet_relay_children 4.0" in text
+        assert "torchft_fleet_relay_lag_gens_max 2.0" in text
+        assert 'torchft_fleet_relay_child_count{relay_id="r1"} 3.0' \
+            in text
+        assert 'torchft_fleet_relay_lag_gens{relay_id="r2"} 2.0' \
+            in text
 
     def test_fleet_table_renders_ranked_rows(self):
         st = self._status()

@@ -235,7 +235,18 @@ class TestMetricsSchema:
             for key in ("publish_generations", "publish_delta_ratio_last",
                         "publish_payload_bytes_last", "serve_requests",
                         "serve_bytes_sent", "publish_generation_last",
-                        "publish_step_last"):
+                        "publish_step_last",
+                        # quantized delta publication (ISSUE 20)
+                        "publish_delta_leaves_last",
+                        "publish_delta_fallback_leaves_last",
+                        "publish_delta_wire_bytes_last",
+                        "publish_delta_encode_ms_total",
+                        "publish_delta_sets",
+                        "serve_delta_requests", "serve_delta_bytes_sent",
+                        # self-organizing relay tier
+                        "relay_beats", "relay_steers", "relays_live",
+                        "relay_children_total", "relay_lag_gens_max",
+                        "serve_children"):
                 assert key in mx, key
             assert mx["publish_count"] == 1
             assert mx["publish_last_generation"] == 1

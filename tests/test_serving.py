@@ -8,6 +8,14 @@ bounds, Manager.publish commit coupling, and ranged-fetch connection
 reuse. The seeded subscriber-churn soak rides ``scripts/test.sh serve``
 nightly (markers ``serve`` + ``slow`` + ``nightly``).
 
+The CDN-scale half (marker ``relay``, ``scripts/test.sh relay``):
+the quantized delta wire (``tft-publish-delta-1`` doc/body routes,
+1/4-byte minimality, bitwise reconstruction, per-leaf crc fallback,
+verbatim relay adoption), the lock-striped ``_RelayTable`` battery,
+registration beats + head-fetch steering (dead-hint cooldown, TTL
+expiry, relay-death re-parenting), and the steered-delta churn soak
+(``relay`` + ``slow`` + ``nightly``).
+
 No native library needed: the tier is pure HTTP + numpy.
 """
 
@@ -29,12 +37,15 @@ from torchft_tpu.checkpointing import CheckpointServer, _ConnectionPool
 from torchft_tpu.retry import RetryError, RetryPolicy
 from torchft_tpu.serialization import manifest_delta
 from torchft_tpu.serving import (
+    DELTA_FORMAT,
     HEAD_FORMAT,
     PublicationServer,
     StaleWeightsError,
     WeightPublisher,
     WeightRelay,
     WeightSubscriber,
+    _DeltaSet,
+    _RelayTable,
     _serve_endpoint,
 )
 
@@ -485,8 +496,12 @@ class TestRelayTree:
             pub.publish(s2, step=2)
             relay.sync()
             down.sync()
-            assert down.metrics()["serve_delta_bytes_last"] == \
-                leaf_bytes("b1")
+            # only b1 moved; the relay's delta-mode publisher may serve
+            # it as an exact-gated quantized wire (the -1 shift
+            # reproduces bitwise), so the byte count is AT MOST the
+            # changed leaf's f32 size — never the whole tree
+            dm = down.metrics()
+            assert 0 < dm["serve_delta_bytes_last"] <= leaf_bytes("b1")
             assert_bitwise(down.weights(), s2)
             rm = relay.metrics()
             assert rm["relay_publish_generations"] == 2
@@ -808,6 +823,583 @@ class TestSubscriberChurnSoak:
                 assert_bitwise(s.weights(), expected)
             assert not torn, f"torn/unpublished trees observed: {torn}"
             assert sched.fault_count() > 0
+        finally:
+            chaos_mod.uninstall()
+            for s in subs:
+                s.stop()
+            for r in relays:
+                r.stop()
+            srv.shutdown()
+
+
+@pytest.mark.relay
+class TestRelayTable:
+    """The lock-striped beat table behind steering — unit battery."""
+
+    def test_beat_rows_ttl_prune_and_age(self):
+        t = _RelayTable(ttl_s=0.25)
+        t.beat("r1", {"addr": "http://a/publish", "boot": "b",
+                      "gen": 3, "children": 1})
+        t.beat("r2", {"addr": "http://b/publish", "boot": "b",
+                      "gen": 3, "children": 0})
+        rows = t.rows()
+        assert [r["id"] for r in rows] == ["r1", "r2"]
+        assert all(r["age_s"] >= 0.0 for r in rows)
+        assert t.count() == 2
+        time.sleep(0.35)
+        assert t.rows() == []  # TTL-pruned
+        assert t.count() == 0
+
+    def test_pick_least_loaded_fresh_same_boot(self):
+        t = _RelayTable(ttl_s=10.0)
+        t.beat("busy", {"addr": "http://busy", "boot": "b",
+                        "gen": 5, "children": 7})
+        t.beat("idle", {"addr": "http://idle", "boot": "b",
+                        "gen": 5, "children": 1})
+        t.beat("lagging", {"addr": "http://lag", "boot": "b",
+                           "gen": 2, "children": 0})  # > 1 gen behind
+        t.beat("otherlife", {"addr": "http://ob", "boot": "x",
+                             "gen": 5, "children": 0})  # old boot
+        assert t.pick("b", 5) == "http://idle"
+        # nobody steerable: a fresh-boot head with an empty-enough table
+        assert t.pick("nosuchboot", 5) is None
+
+    def test_pick_spreads_between_beats_and_resets_on_beat(self):
+        t = _RelayTable(ttl_s=10.0)
+        t.beat("r1", {"addr": "http://r1", "boot": "b",
+                      "gen": 1, "children": 0})
+        t.beat("r2", {"addr": "http://r2", "boot": "b",
+                      "gen": 1, "children": 0})
+        # four steers between beats alternate instead of dog-piling
+        got = sorted(t.pick("b", 1) for _ in range(4))
+        assert got == ["http://r1", "http://r1",
+                       "http://r2", "http://r2"]
+        # a fresh beat resets r1's between-beat assignment counter, so
+        # it immediately looks emptiest again
+        t.beat("r1", {"addr": "http://r1", "boot": "b",
+                      "gen": 1, "children": 0})
+        assert t.pick("b", 1) == "http://r1"
+
+    def test_pick_excludes_the_requesting_relay(self):
+        t = _RelayTable(ttl_s=10.0)
+        t.beat("only", {"addr": "http://only", "boot": "b",
+                        "gen": 1, "children": 0})
+        assert t.pick("b", 1, exclude_id="only") is None
+        assert t.pick("b", 1) == "http://only"
+
+
+@pytest.mark.relay
+class TestQuantizedDeltaPublication:
+    """The int8+pow2-scale delta wire (``tft-publish-delta-1``): doc
+    format, 1/4-byte minimality, bitwise reconstruction, per-leaf crc
+    fallback to the exact f32 route, and verbatim relay adoption."""
+
+    def _rig(self, **kw):
+        kw.setdefault("keep_generations", 2)
+        pub = WeightPublisher(delta=True, **kw)
+        srv = PublicationServer(pub, bind_host="127.0.0.1")
+        return pub, srv
+
+    def test_delta_doc_format_and_modes(self):
+        pub, srv = self._rig()
+        try:
+            s1 = make_state(seed=31)
+            pub.publish(s1, step=1)
+            s2 = dict(s1)
+            s2["b1"] = s1["b1"] + np.float32(1e-3)
+            pub.publish(s2, step=2)
+            with urllib.request.urlopen(
+                    f"{srv.address()}/2/delta?base=1", timeout=10) as r:
+                doc = json.loads(r.read())
+            assert doc["format"] == DELTA_FORMAT
+            assert doc["generation"] == 2 and doc["base"] == 1
+            assert doc["boot"] == pub.head()["boot"]
+            assert doc["body_len"] > 0
+            modes = {e["key"]: e["mode"] for e in doc["leaves"]}
+            assert modes["b1"] == "delta"
+            assert all(m == "carry" for k, m in modes.items()
+                       if k != "b1")
+            ent = next(e for e in doc["leaves"] if e["key"] == "b1")
+            for field in ("offset", "nbytes", "size", "seg_elems",
+                          "wire_crc32", "base_crc32", "crc32"):
+                assert field in ent, field
+            # the delta leaf's crc32 IS the full manifest digest: both
+            # routes describe the same bits
+            mf = json.loads(urllib.request.urlopen(
+                f"{srv.address()}/2/manifest", timeout=10).read())
+            mf_ent = next(e for e in mf["leaves"] if e["key"] == "b1")
+            assert ent["crc32"] == mf_ent["crc32"]
+            # unknown base: the subscriber's full-route fallback signal
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    f"{srv.address()}/2/delta?base=77", timeout=10)
+            assert ei.value.code == 404
+            # malformed: no base at all
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    f"{srv.address()}/2/delta", timeout=10)
+            assert ei.value.code == 400
+        finally:
+            srv.shutdown()
+
+    def test_delta_sync_bitwise_and_quarter_bytes(self):
+        pub, srv = self._rig()
+        dsub = fsub = None
+        try:
+            rng = np.random.default_rng(32)
+            s1 = make_state(seed=32)
+            pub.publish(s1, step=1)
+            dsub = WeightSubscriber(srv.address(), template(),
+                                    retry_policy=fast_policy())
+            fsub = WeightSubscriber(srv.address(), template(),
+                                    retry_policy=fast_policy(),
+                                    delta=False)
+            dsub.sync()
+            fsub.sync()
+            s2 = dict(s1)
+            s2["emb"] = (s1["emb"] + np.float32(1e-3)
+                         * rng.normal(size=_SIZES["emb"])
+                         .astype(np.float32))
+            pub.publish(s2, step=2)
+            assert dsub.sync() is True
+            assert fsub.sync() is True
+            dm = dsub.metrics()
+            assert dm["serve_delta_syncs"] == 1
+            assert dm["serve_delta_leaves_last"] == 1
+            assert dm["serve_delta_crc_fallbacks"] == 0
+            # wire minimality: int8 + pow2 scales ~ 1/4 of the changed
+            # leaves' f32 bytes (publisher-side accounting agrees)
+            pm = pub.metrics()
+            assert pm["publish_delta_sets"] >= 1
+            wire = pm["publish_delta_wire_bytes_last"]
+            assert 0 < wire <= 0.27 * pm["publish_delta_bytes_last"]
+            assert dm["serve_delta_wire_bytes_total"] == wire
+            # reconstruction is BITWISE the published state: the delta
+            # subscriber, the full subscriber, and the publisher's
+            # retained reconstruction all hold the same bits
+            dw, fw = dsub.weights(), fsub.weights()
+            assert_bitwise(dw, fw)
+            assert_bitwise(dw, pub._head.state)  # noqa: SLF001
+        finally:
+            for s in (dsub, fsub):
+                if s is not None:
+                    s.stop()
+            srv.shutdown()
+
+    def test_corrupt_delta_wire_falls_back_per_leaf_f32(self):
+        """A corrupt wire payload must lose the LEAF, not the sync: the
+        wire crc rejects it, the fallback counter ticks, and the leaf
+        rides the exact-f32 full route — final bits identical."""
+        pub, srv = self._rig()
+        sub = None
+        try:
+            s1 = make_state(seed=33)
+            pub.publish(s1, step=1)
+            sub = WeightSubscriber(srv.address(), template(),
+                                   retry_policy=fast_policy())
+            sub.sync()
+            s2 = dict(s1)
+            s2["w1"] = s1["w1"] * np.float32(1.001)
+            pub.publish(s2, step=2)
+            # corrupt one byte of the stored delta body in place
+            with pub._cond:  # noqa: SLF001 — fault injection
+                rec = pub._gens[2]
+            ds = pub._delta_set(rec, 1)  # noqa: SLF001
+            bad = bytearray(ds.body)
+            bad[len(bad) // 2] ^= 0xFF
+            rec.deltas[1] = _DeltaSet(ds.doc, bytes(bad))
+            assert sub.sync() is True
+            m = sub.metrics()
+            assert m["serve_delta_crc_fallbacks"] >= 1
+            assert m["serve_delta_syncs"] == 0
+            assert_bitwise(sub.weights(), pub._head.state)  # noqa: SLF001
+        finally:
+            if sub is not None:
+                sub.stop()
+            srv.shutdown()
+
+    def test_missing_delta_set_falls_back_to_full_route(self):
+        """A subscriber whose base generation fell out of the retained
+        window gets a 404 on the delta route and converges via the full
+        manifest/body path — delta is an optimization, never a
+        dependency."""
+        pub, srv = self._rig(keep_generations=2)
+        sub = None
+        try:
+            s = make_state(seed=34)
+            pub.publish(s, step=1)
+            sub = WeightSubscriber(srv.address(), template(),
+                                   retry_policy=fast_policy())
+            sub.sync()
+            for g in (2, 3):  # gen 1 (the sub's base) evicts at gen 3
+                s = dict(s)
+                s["b2"] = s["b2"] + np.float32(g)
+                pub.publish(s, step=g)
+            assert sub.sync() is True
+            assert sub.generation() == 3
+            m = sub.metrics()
+            assert m["serve_delta_syncs"] == 0  # full route took it
+            assert_bitwise(sub.weights(), pub._head.state)  # noqa: SLF001
+        finally:
+            if sub is not None:
+                sub.stop()
+            srv.shutdown()
+
+    def test_relay_adopts_delta_verbatim(self):
+        """The relay re-serves the root's wire payloads untouched, so a
+        grandchild's delta reconstruction is bitwise the ROOT's
+        reconstruction (re-encoding would drift: Int8Wire re-encode of
+        a reconstruction is not idempotent)."""
+        pub, srv = self._rig(keep_generations=3)
+        relay = down = None
+        try:
+            rng = np.random.default_rng(35)
+            s1 = make_state(seed=35)
+            pub.publish(s1, step=1)
+            relay = WeightRelay(srv.address(), template(),
+                                bind_host="127.0.0.1",
+                                retry_policy=fast_policy(),
+                                register=False, name="deltarelay")
+            relay.sync()
+            down = WeightSubscriber(relay.address(), template(),
+                                    retry_policy=fast_policy())
+            down.sync()
+            s2 = dict(s1)
+            s2["head"] = (s1["head"] + np.float32(1e-3)
+                          * rng.normal(size=_SIZES["head"])
+                          .astype(np.float32))
+            pub.publish(s2, step=2)
+            assert relay.sync() is True
+            assert relay.last_delta() is not None
+            assert down.sync() is True
+            dm = down.metrics()
+            assert dm["serve_delta_syncs"] == 1
+            assert dm["serve_delta_crc_fallbacks"] == 0
+            # grandchild bits == root publisher's retained bits
+            assert_bitwise(down.weights(), pub._head.state)  # noqa: SLF001
+            rm = relay.metrics()
+            assert rm["relay_serve_delta_requests"] >= 1
+            assert rm["relay_serve_delta_bytes_sent"] > 0
+        finally:
+            for x in (down, relay):
+                if x is not None:
+                    x.stop()
+            srv.shutdown()
+
+    def test_delta_off_publisher_serves_no_delta_routes(self, rig):
+        """Default publishers (delta off) never see delta requests: the
+        subscriber only tries the delta route when the head advertises
+        it."""
+        pub, srv, make_sub = rig
+        pub.publish(make_state(fill=1), step=1)
+        sub = make_sub()  # delta=True default, but head says no
+        sub.sync()
+        pub.publish(make_state(fill=2), step=2)
+        assert sub.sync() is True
+        assert pub.metrics()["serve_delta_requests"] == 0
+        assert sub.metrics()["serve_delta_syncs"] == 0
+
+
+@pytest.mark.relay
+class TestRelaySteering:
+    """Relay registration beats, the ``/publish/relays`` surface, and
+    head-fetch-time subscriber steering (live-relay hints, dead-hint
+    cooldown, TTL expiry, death re-parenting)."""
+
+    def test_beat_route_and_relays_endpoint(self, rig):
+        pub, srv, _ = rig
+        pub.publish(make_state(fill=1), step=1)
+        boot = pub.head()["boot"]
+        q = urllib.parse.urlencode(
+            [("id", "r1"), ("addr", "http://x:1/publish"),
+             ("boot", boot), ("gen", "1"), ("step", "1"),
+             ("children", "2"), ("bytes_sent", "5")])
+        with urllib.request.urlopen(
+                f"{srv.address()}/relay/beat?{q}", timeout=10) as r:
+            ack = json.loads(r.read())
+        assert ack["ok"] is True and ack["relays"] == 1
+        with urllib.request.urlopen(
+                f"{srv.address()}/relays", timeout=10) as r:
+            doc = json.loads(r.read())
+        (row,) = doc["relays"]
+        assert row["id"] == "r1" and row["lag_gens"] == 0
+        assert row["age_s"] >= 0.0
+        # malformed beat (no id) is a client error, not a crash
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                f"{srv.address()}/relay/beat?addr=http://x:1", timeout=10)
+        assert ei.value.code == 400
+        m = pub.metrics()
+        assert m["relay_beats"] == 1
+        assert m["relays_live"] == 1
+        assert m["relay_children_total"] == 2
+
+    def test_subscriber_steered_to_live_relay(self, rig):
+        pub, srv, make_sub = rig
+        pub.publish(make_state(fill=1), step=1)
+        relay = WeightRelay(srv.address(), template(),
+                            bind_host="127.0.0.1",
+                            retry_policy=fast_policy(),
+                            beat_interval_s=0.1,
+                            poll_interval_s=0.05,
+                            name="steer-r1")
+        try:
+            relay.sync()
+            relay.start()
+            deadline = time.monotonic() + 5.0
+            while not pub.relay_rows():
+                assert time.monotonic() < deadline, "relay never beat in"
+                time.sleep(0.02)
+            sub = make_sub()
+            assert sub.sync() is True
+            # the head hint re-parented the sub onto the relay
+            assert sub._parents[0] == relay.address()  # noqa: SLF001
+            assert sub.metrics()["serve_steers"] >= 1
+            assert pub.metrics()["relay_steers"] >= 1
+            # the next generation flows through the relay, not the root
+            pub.publish(make_state(fill=2), step=2)
+            deadline = time.monotonic() + 5.0
+            while relay.generation() < 2:
+                assert time.monotonic() < deadline
+                time.sleep(0.02)
+            assert sub.sync() is True
+            assert sub.generation() == 2
+            assert relay.metrics()["relay_serve_requests"] >= 1
+            assert_bitwise(sub.weights(), make_state(fill=2))
+        finally:
+            relay.stop()
+
+    def test_dead_hint_cools_down_and_root_serves(self, rig):
+        """A hint pointing at a dead relay must cost one failover, not
+        the sync: the subscriber rotates back to the root, remembers
+        the bad address for the cooldown window, and converges."""
+        pub, srv, make_sub = rig
+        pub.publish(make_state(fill=1), step=1)
+        # hand-beat a corpse into the table (port 1: refused fast)
+        pub.relay_beat({"id": "corpse",
+                        "addr": "http://127.0.0.1:1/publish",
+                        "boot": pub.head()["boot"],
+                        "gen": 1, "children": 0})
+        sub = make_sub()
+        assert sub.sync() is True
+        m = sub.metrics()
+        assert m["serve_steers"] >= 1
+        assert m["serve_parent_failovers"] >= 1
+        cur = sub._parents[sub._parent_idx  # noqa: SLF001
+                           % len(sub._parents)]  # noqa: SLF001
+        assert cur == srv.address().rstrip("/")
+        assert "http://127.0.0.1:1/publish" in sub._steer_bad  # noqa: SLF001
+        assert_bitwise(sub.weights(), make_state(fill=1))
+        # still on cooldown: the next sync ignores the lingering row
+        # (its TTL has not expired) instead of bouncing off it again
+        pub.publish(make_state(fill=2), step=2)
+        assert sub.sync() is True
+        assert sub.metrics()["serve_parent_failovers"] == \
+            m["serve_parent_failovers"]
+        assert_bitwise(sub.weights(), make_state(fill=2))
+
+    def test_registration_ttl_expires_dead_relay(self):
+        pub = WeightPublisher(keep_generations=2, relay_ttl_s=0.3)
+        srv = PublicationServer(pub, bind_host="127.0.0.1")
+        relay = None
+        try:
+            pub.publish(make_state(fill=1), step=1)
+            relay = WeightRelay(srv.address(), template(),
+                                bind_host="127.0.0.1",
+                                retry_policy=fast_policy(),
+                                beat_interval_s=0.1,
+                                poll_interval_s=0.05,
+                                name="ttl-r1")
+            relay.sync()
+            relay.start()
+            deadline = time.monotonic() + 5.0
+            while not pub.relay_rows():
+                assert time.monotonic() < deadline, "relay never beat in"
+                time.sleep(0.02)
+            assert relay.metrics()["relay_beats_sent"] >= 1
+            relay.stop()
+            relay = None
+            time.sleep(0.5)  # > ttl with no beats
+            assert pub.relay_rows() == []
+            assert pub.metrics()["relays_live"] == 0
+        finally:
+            if relay is not None:
+                relay.stop()
+            srv.shutdown()
+
+    def test_relay_death_mid_delta_reparents_subscriber(self):
+        """Kill the relay a steered subscriber is attached to, mid
+        delta stream: the sub's parent rotation walks it back to the
+        root and the next delta generation lands bitwise — no torn
+        observation, no stall."""
+        pub = WeightPublisher(keep_generations=3, delta=True)
+        srv = PublicationServer(pub, bind_host="127.0.0.1")
+        relay = sub = None
+        try:
+            rng = np.random.default_rng(36)
+            s1 = make_state(seed=36)
+            pub.publish(s1, step=1)
+            relay = WeightRelay(srv.address(), template(),
+                                bind_host="127.0.0.1",
+                                retry_policy=fast_policy(),
+                                beat_interval_s=0.1,
+                                poll_interval_s=0.05,
+                                name="doomed-r1")
+            relay.sync()
+            relay.start()
+            deadline = time.monotonic() + 5.0
+            while not pub.relay_rows():
+                assert time.monotonic() < deadline
+                time.sleep(0.02)
+            sub = WeightSubscriber(srv.address(), template(),
+                                   retry_policy=fast_policy(),
+                                   stall_timeout_sec=10.0)
+            sub.sync()
+            assert sub._parents[0] == relay.address()  # noqa: SLF001
+            # one delta generation THROUGH the relay first
+            s2 = dict(s1)
+            s2["w2"] = (s1["w2"] + np.float32(1e-3)
+                        * rng.normal(size=_SIZES["w2"])
+                        .astype(np.float32))
+            pub.publish(s2, step=2)
+            deadline = time.monotonic() + 5.0
+            while relay.generation() < 2:
+                assert time.monotonic() < deadline
+                time.sleep(0.02)
+            sub.sync()
+            assert sub.metrics()["serve_delta_syncs"] >= 1
+            # kill it; the table row ages out while the sub fails over
+            relay.stop()
+            relay = None
+            s3 = dict(s2)
+            s3["w2"] = (s2["w2"] + np.float32(1e-3)
+                        * rng.normal(size=_SIZES["w2"])
+                        .astype(np.float32))
+            pub.publish(s3, step=3)
+            assert sub.sync() is True
+            assert sub.generation() == 3
+            m = sub.metrics()
+            assert m["serve_parent_failovers"] >= 1
+            assert_bitwise(sub.weights(), pub._head.state)  # noqa: SLF001
+        finally:
+            if sub is not None:
+                sub.stop()
+            if relay is not None:
+                relay.stop()
+            srv.shutdown()
+
+    def test_request_stop_unblocks_long_poll(self, rig):
+        pub, srv, make_sub = rig
+        pub.publish(make_state(fill=1), step=1)
+        sub = make_sub(poll_interval_s=0.05)
+        sub.sync()
+        sub.start()
+        time.sleep(0.2)  # loop is long-polling for gen 2
+        t0 = time.monotonic()
+        sub.request_stop()
+        sub.stop()
+        assert time.monotonic() - t0 < 3.0
+
+
+@pytest.mark.relay
+@pytest.mark.slow
+@pytest.mark.nightly
+class TestSteeredDeltaChurnSoak:
+    """Nightly soak of the whole CDN stack at once: a delta-mode root,
+    registered relays beating into the steering table, subscribers that
+    arrive knowing only the root and get steered out, serve-channel
+    chaos, a relay killed mid-stream (its table row keeps advertising
+    it — steered subs must bounce off, cool down, and converge via the
+    root), and subscriber churn. Uniform fill states shift every leaf
+    by exactly 1.0 per generation, which the pow2-scale int8 wire
+    quantizes EXACTLY, so the fill-uniformity torn check and the final
+    bitwise oracle both stay valid under quantized deltas."""
+
+    def test_steered_delta_churn_soak(self):
+        sched = ChaosSchedule(seed=1907, endpoints={
+            "serve": EndpointChaos(reset_rate=0.04, short_rate=0.06),
+        })
+        chaos_mod.install(sched)
+        pub = WeightPublisher(keep_generations=3, delta=True,
+                              relay_ttl_s=1.5)
+        srv = PublicationServer(pub, bind_host="127.0.0.1")
+        relays = [WeightRelay(srv.address(), template(),
+                              bind_host="127.0.0.1",
+                              retry_policy=fast_policy(),
+                              poll_interval_s=0.05,
+                              beat_interval_s=0.2,
+                              name=f"steer-soak-relay{i}").start()
+                  for i in range(2)]
+        deadline = time.monotonic() + 10.0
+        while len(pub.relay_rows()) < 2:
+            assert time.monotonic() < deadline, "relays never registered"
+            time.sleep(0.05)
+        # every subscriber knows ONLY the root; steering spreads them
+        subs = [WeightSubscriber(
+                    srv.address(), template(),
+                    retry_policy=fast_policy(), poll_interval_s=0.05,
+                    name=f"steer-soak-sub{i}").start()
+                for i in range(4)]
+        published = set()
+        torn: list = []
+
+        def check(sub):
+            try:
+                tree = sub.weights()
+            except StaleWeightsError:
+                return
+            vals = {k: tree[k][0] for k in _SIZES}
+            first = next(iter(vals.values()))
+            if not all(v == first for v in vals.values()) \
+                    or int(first) not in published:
+                torn.append((sub._name, vals))
+
+        try:
+            final_gen = 14
+            for g in range(1, final_gen + 1):
+                pub.publish(make_state(fill=g), step=g)
+                published.add(g)
+                for s in subs:
+                    check(s)
+                if g == 5:
+                    # kill relay 0's serve plane mid-stream; its beats
+                    # keep flowing, so the table still advertises it —
+                    # steered subs must bounce off and cool down
+                    sched.kill_endpoint(_serve_endpoint(
+                        relays[0].address()))
+                if g == 8:
+                    subs[0].stop()
+                    subs[0] = WeightSubscriber(
+                        srv.address(), template(),
+                        retry_policy=fast_policy(),
+                        poll_interval_s=0.05,
+                        name="steer-soak-sub0b").start()
+                if g == 10:
+                    sched.revive_endpoint(_serve_endpoint(
+                        relays[0].address()))
+                time.sleep(0.25)
+            # the pow2 wire kept every generation exact
+            assert_bitwise(pub._head.state,  # noqa: SLF001
+                           make_state(fill=final_gen))
+            deadline = time.monotonic() + 90
+            expected = make_state(fill=final_gen)
+            for s in subs:
+                while True:
+                    check(s)
+                    if s.generation() == final_gen:
+                        break
+                    assert time.monotonic() < deadline, \
+                        f"{s._name} never converged " \
+                        f"(at gen {s.generation()})"
+                    time.sleep(0.1)
+                assert_bitwise(s.weights(), expected)
+            assert not torn, f"torn/unpublished trees observed: {torn}"
+            assert sched.fault_count() > 0
+            # the stack actually exercised its new machinery
+            assert pub.metrics()["relay_beats"] > 0
+            assert pub.metrics()["relay_steers"] > 0
+            assert sum(s.metrics()["serve_delta_syncs"]
+                       for s in subs) > 0
         finally:
             chaos_mod.uninstall()
             for s in subs:

@@ -1368,6 +1368,29 @@ std::string Lighthouse::fleet_metrics_text(const FleetAggregate& agg) {
        << "torchft_fleet_rebalance_fraction{replica_id=\"" << rid
        << "\"} " << fmt_double(g.rebalance_fraction) << "\n";
   }
+  // Publication relay tier (docs/design/serving.md): the lighthouse
+  // aggregates no relay beats itself (the publisher owns the table),
+  // so the scalar families render zero and the per-relay families
+  // render names only — but the EXPOSITION NAME SET stays identical to
+  // the Python renderer's (tests/test_fleet.py freezes both against
+  // FLEET_METRIC_NAMES; scrape configs read either endpoint).
+  os << "# HELP torchft_fleet_relays live publication relays\n"
+     << "# TYPE torchft_fleet_relays gauge\n"
+     << "torchft_fleet_relays 0.0\n"
+     << "# HELP torchft_fleet_relay_children downstream consumers "
+        "across the relay tier\n"
+     << "# TYPE torchft_fleet_relay_children gauge\n"
+     << "torchft_fleet_relay_children 0.0\n"
+     << "# HELP torchft_fleet_relay_lag_gens_max worst relay staleness "
+        "(generations behind the head)\n"
+     << "# TYPE torchft_fleet_relay_lag_gens_max gauge\n"
+     << "torchft_fleet_relay_lag_gens_max 0.0\n"
+     << "# HELP torchft_fleet_relay_child_count per-relay downstream "
+        "consumers\n"
+     << "# TYPE torchft_fleet_relay_child_count gauge\n"
+     << "# HELP torchft_fleet_relay_lag_gens per-relay staleness "
+        "(generations behind the head)\n"
+     << "# TYPE torchft_fleet_relay_lag_gens gauge\n";
   return os.str();
 }
 

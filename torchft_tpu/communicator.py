@@ -34,7 +34,7 @@ from __future__ import annotations
 import logging
 from abc import ABC, abstractmethod
 from concurrent.futures import Future
-from typing import Any, Callable, List, Optional, Sequence
+from typing import Any, Callable, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -228,6 +228,45 @@ class Int8Wire:
         return Int8Wire(np.zeros(size, np.int8),
                         np.zeros(nseg, np.float32),
                         np.zeros(nseg, np.float32), seg_elems)
+
+    # ------------------------------------------------ delta publication
+    # The serving tier's quantized-delta primitive (ISSUE 20,
+    # docs/design/serving.md): encode ``new - base`` as one wire and
+    # reconstruct ``base + dequantize(wire)``. Both sides MUST use
+    # these two spellings — the power-of-two scales make ``q*scale``
+    # exact and the f32 add rounds once, so the publisher's encode-time
+    # reconstruction and a subscriber's decode-time reconstruction are
+    # bit-identical, which is what lets the published manifest digest
+    # double as the delta's end-to-end verification.
+
+    @staticmethod
+    def delta_encode(base: Any, new: Any,
+                     seg_elems: int = INT8_SEG_ELEMS
+                     ) -> Tuple["Int8Wire", np.ndarray]:
+        """Quantize ``new - base`` (both flattened f32) and return
+        ``(wire, reconstruction)`` where ``reconstruction`` is exactly
+        what :meth:`delta_apply` on the receiving side produces from
+        the same wire bytes."""
+        b = np.ravel(np.asarray(base)).astype(np.float32, copy=False)
+        n = np.ravel(np.asarray(new)).astype(np.float32, copy=False)
+        wire = Int8Wire.quantize(n - b, seg_elems)
+        return wire, Int8Wire.delta_apply(b, wire)
+
+    @staticmethod
+    def delta_apply(base: Any, wire: "Int8Wire") -> np.ndarray:
+        """Reconstruct a delta-published buffer: ``base + wire`` in f32
+        — the ONE reconstruction spelling (see :meth:`delta_encode`)."""
+        b = np.ravel(np.asarray(base)).astype(np.float32, copy=False)
+        return (b + wire.dequantize(np.float32)).astype(np.float32,
+                                                        copy=False)
+
+    def max_quant_step(self) -> float:
+        """Upper bound on this wire's per-element quantization error
+        (half the largest segment scale) — the publish-time "does int8
+        resolve this delta?" gate: a diff whose dynamic range forces a
+        step coarser than the caller's tolerance defeats int8 and the
+        leaf falls back to exact f32."""
+        return float(self.scales.max(initial=np.float32(0))) * 0.5
 
 
 def shard_bounds(size: int, world: int) -> np.ndarray:

@@ -483,6 +483,10 @@ class FleetAggregator:
         # yields an empty table, and only rebalance-armed managers
         # adopt it.
         self.rebalancer = Rebalancer()
+        # Publication relay tier (docs/design/serving.md): the latest
+        # relay-table rows adopted via note_relays(). The publisher
+        # owns TTL pruning; this is a mirror for export.
+        self._relay_rows: List[Dict[str, Any]] = []
 
     def ingest(self, digest: StepDigest,
                now_ms: Optional[int] = None) -> None:
@@ -498,6 +502,15 @@ class FleetAggregator:
     def note_commit_counts(self, replica_id: str, committed: int,
                            aborted: int) -> None:
         self._commit_counts[replica_id] = (int(committed), int(aborted))
+
+    def note_relays(self, rows: List[Dict[str, Any]]) -> None:
+        """Adopt the publication tier's relay table
+        (:meth:`torchft_tpu.serving.WeightPublisher.relay_rows` — rows
+        already TTL-pruned and ``lag_gens``-annotated by the
+        publisher). The aggregate and the Prometheus exposition then
+        carry the relay tier beside the training fleet, so steering
+        and operators read one signal."""
+        self._relay_rows = [dict(r) for r in rows]
 
     def remove(self, replica_id: str) -> None:
         """Drop a departed group immediately (farewell / eviction): its
@@ -747,9 +760,16 @@ class FleetAggregator:
                     self.rebalancer.shrinks_total,
                 "rebalance_restores_total":
                     self.rebalancer.restores_total,
+                "relays": len(self._relay_rows),
+                "relay_children": sum(
+                    int(r.get("children", 0)) for r in self._relay_rows),
+                "relay_lag_gens_max": max(
+                    (int(r.get("lag_gens", 0))
+                     for r in self._relay_rows), default=0),
             },
             "straggler": straggler,
             "groups": groups,
+            "relays": self._relay_rows,
         }
 
 
@@ -995,6 +1015,38 @@ def status_prometheus(status: Dict[str, Any],
         lines.append(
             f'torchft_fleet_rebalance_fraction{{replica_id="{rid}"}} '
             f'{float(g.get("rebalance_fraction", 1.0))!r}')
+    # Publication relay tier (docs/design/serving.md): the same rows
+    # the publisher's steering pick reads, so the operator's "is the
+    # uplink saturated" drill and the steering decision never diverge.
+    lines += [
+        "# HELP torchft_fleet_relays live publication relays",
+        "# TYPE torchft_fleet_relays gauge",
+        f"torchft_fleet_relays {float(f.get('relays', 0))!r}",
+        "# HELP torchft_fleet_relay_children downstream consumers "
+        "across the relay tier",
+        "# TYPE torchft_fleet_relay_children gauge",
+        f"torchft_fleet_relay_children "
+        f"{float(f.get('relay_children', 0))!r}",
+        "# HELP torchft_fleet_relay_lag_gens_max worst relay staleness "
+        "(generations behind the head)",
+        "# TYPE torchft_fleet_relay_lag_gens_max gauge",
+        f"torchft_fleet_relay_lag_gens_max "
+        f"{float(f.get('relay_lag_gens_max', 0))!r}",
+        "# HELP torchft_fleet_relay_child_count per-relay downstream "
+        "consumers",
+        "# TYPE torchft_fleet_relay_child_count gauge",
+        "# HELP torchft_fleet_relay_lag_gens per-relay staleness "
+        "(generations behind the head)",
+        "# TYPE torchft_fleet_relay_lag_gens gauge",
+    ]
+    for r in status.get("relays", []):
+        rlid = _escape_label(str(r.get("id", "")))
+        lines.append(
+            f'torchft_fleet_relay_child_count{{relay_id="{rlid}"}} '
+            f'{float(r.get("children", 0))!r}')
+        lines.append(
+            f'torchft_fleet_relay_lag_gens{{relay_id="{rlid}"}} '
+            f'{float(r.get("lag_gens", 0))!r}')
     return "\n".join(lines) + "\n"
 
 

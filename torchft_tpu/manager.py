@@ -4755,7 +4755,16 @@ class Manager:
         ``user_state`` overrides the published tree (default: the
         registered ``state_dict`` callable — the weights, not the
         manager metadata). Returns the generation id, or ``None`` when
-        refused."""
+        refused.
+
+        A ``WeightPublisher(delta=True)`` additionally encodes each
+        generation as int8+pow2-scale deltas against the retained
+        prior ones (the ~4× byte path, served at
+        ``/publish/<g>/delta``); its delta counters and the relay
+        registration table's gauges ride the same publisher-metrics
+        merge into :meth:`metrics`, and :meth:`relay_rows` exposes the
+        table itself for the fleet export
+        (:meth:`torchft_tpu.fleet.FleetAggregator.note_relays`)."""
         with self._metrics_lock:
             healing = self._healing
             quarantined = self._sdc_quarantined
@@ -4799,6 +4808,18 @@ class Manager:
         (``…/publish`` on the checkpoint server's port) — what
         subscribers and first-level relays dial."""
         return self._ckpt_server.publish_address()
+
+    def relay_rows(self) -> list:
+        """Live relay-registration rows of the attached publisher
+        (``[]`` before the first :meth:`publish`) — what the fleet
+        export adopts via
+        :meth:`torchft_tpu.fleet.FleetAggregator.note_relays`, so the
+        steering signal and the operator's saturation drill
+        (docs/pod_runbook.md) read the same table."""
+        pub = self._publisher
+        rows = getattr(pub, "relay_rows", None) if pub is not None \
+            else None
+        return rows() if rows is not None else []
 
     def cold_start(self, directory: str, prefix: str = "ckpt_",
                    ram_peers: Optional[list] = None) -> Optional[str]:

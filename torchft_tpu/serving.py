@@ -56,8 +56,11 @@ import time
 import urllib.error
 import urllib.parse
 import uuid
+import zlib
 from collections import OrderedDict
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
 
 from torchft_tpu import chaos, transport
 from torchft_tpu.checkpointing import (
@@ -68,15 +71,20 @@ from torchft_tpu.checkpointing import (
     _heal_transient,
     _snapshot_tree,
 )
+from torchft_tpu.communicator import INT8_SEG_ELEMS, Int8Wire
 from torchft_tpu.transport import (
     ConnectionPool as _ConnectionPool,
+    CountingReader as _CountingReader,
     check_bearer_auth as _check_bearer_auth,
+    fetch_json as _fetch_json,
     looks_peer_dead as _looks_donor_dead,
     open_url as _open_url,
     serve_ranged_body as _serve_ranged_body,
+    serve_ranged_bytes as _serve_ranged_bytes,
 )
 from torchft_tpu.retry import RetryError, RetryPolicy
 from torchft_tpu.serialization import (
+    _read_exact_into,
     device_put_like,
     manifest_delta,
     manifest_from,
@@ -87,7 +95,16 @@ from torchft_tpu.utils import advertise_host
 logger: logging.Logger = logging.getLogger(__name__)
 
 HEAD_FORMAT = "tft-publish-head-1"
+# Quantized-delta wire document (docs/design/serving.md): per array
+# leaf the mode is "carry" (digest unchanged vs the base generation),
+# "delta" (an int8+pow2-scale Int8Wire payload in the delta body), or
+# "full" (int8 cannot resolve it — fetch exact f32 from the full
+# route). The delta-mode entry's ``crc32`` EQUALS the full manifest's
+# digest for that leaf, so a reconstruction verifies against the same
+# content address a full fetch would.
+DELTA_FORMAT = "tft-publish-delta-1"
 _GEN_RE = re.compile(r"^/publish/(\d+)(/manifest)?$")
+_DELTA_RE = re.compile(r"^/publish/(\d+)/delta(/data)?$")
 
 
 class StaleWeightsError(RuntimeError):
@@ -108,10 +125,12 @@ def _serve_endpoint(addr: str) -> str:
 class _Generation:
     """One immutable published snapshot: the (host- or device-side)
     state tree, its streaming plan, per-array-leaf digests in body
-    order, and the manifest served to subscribers."""
+    order, the manifest served to subscribers, and any quantized delta
+    sets encoded against retained prior generations (``deltas``: base
+    generation id → :class:`_DeltaSet`)."""
 
     __slots__ = ("generation", "step", "boot", "state", "plan",
-                 "digests", "manifest")
+                 "digests", "manifest", "deltas")
 
     def __init__(self, generation: int, step: int, boot: str, state: Any,
                  plan: Any, digests: List[int], manifest: dict) -> None:
@@ -122,6 +141,110 @@ class _Generation:
         self.plan = plan
         self.digests = digests
         self.manifest = manifest
+        self.deltas: "OrderedDict[int, _DeltaSet]" = OrderedDict()
+
+
+class _DeltaSet:
+    """One generation's quantized delta against one base generation:
+    the JSON document (``GET /publish/<g>/delta?base=<g0>``) and the
+    concatenated Int8Wire payload body it points into
+    (``…/delta/data?base=<g0>``, HTTP Range honored). Immutable once
+    built — relays propagate it verbatim (minus leaves they could not
+    verify, rewritten as ``full``)."""
+
+    __slots__ = ("doc", "body")
+
+    def __init__(self, doc: dict, body: bytes) -> None:
+        self.doc = doc
+        self.body = body
+
+
+class _RelayTable:
+    """Lock-striped relay registry on a publisher — the way the
+    Lighthouse's beat table tracks managers: relays re-register with
+    periodic beats carrying load/staleness/child-count, entries expire
+    after ``ttl_s`` without a beat, and steering reads the pruned live
+    set. Striping keeps a 100+-relay beat fan-in from serializing on
+    one lock with the head-serving path."""
+
+    _STRIPES = 8
+
+    def __init__(self, ttl_s: float = 10.0) -> None:
+        self.ttl_s = float(ttl_s)
+        self._stripes = [(threading.Lock(), {})
+                         for _ in range(self._STRIPES)]
+
+    def _stripe(self, relay_id: str) -> Tuple[threading.Lock, dict]:
+        return self._stripes[hash(relay_id) % self._STRIPES]
+
+    def beat(self, relay_id: str, row: dict) -> int:
+        """Upsert one relay's beat. The relay's reported ``children``
+        already includes subscribers steered to it before this beat, so
+        the steer-assignment counter resets here (it exists to spread
+        steers issued BETWEEN beats)."""
+        lock, d = self._stripe(relay_id)
+        now = time.monotonic()
+        with lock:
+            row = dict(row)
+            row["id"] = relay_id
+            row["beat_t"] = now
+            row["assigned"] = 0
+            d[relay_id] = row
+        return self.count()
+
+    def rows(self) -> List[dict]:
+        """Live rows (TTL-pruned), each annotated with ``age_s``."""
+        now = time.monotonic()
+        out: List[dict] = []
+        for lock, d in self._stripes:
+            with lock:
+                for rid in [r for r, row in d.items()
+                            if now - row["beat_t"] > self.ttl_s]:
+                    del d[rid]
+                out.extend(dict(row) for row in d.values())
+        for row in out:
+            row["age_s"] = now - row.pop("beat_t")
+        out.sort(key=lambda r: r["id"])
+        return out
+
+    def count(self) -> int:
+        return sum(len(d) for _, d in self._stripes)
+
+    def pick(self, boot: str, head_gen: int, max_lag_gens: int = 1,
+             exclude_id: Optional[str] = None) -> Optional[str]:
+        """Steering decision: the least-loaded live relay that is fresh
+        enough to serve (same publisher life, held generation within
+        ``max_lag_gens`` of the head). Load = reported child count plus
+        steers assigned since its last beat, so a burst of head
+        requests between two beats spreads instead of dog-piling the
+        emptiest relay. Returns its advertised address (None: nobody
+        steerable — the caller serves directly)."""
+        best: Optional[dict] = None
+        best_key: Optional[tuple] = None
+        now = time.monotonic()
+        for lock, d in self._stripes:
+            with lock:
+                for rid, row in d.items():
+                    if rid == exclude_id:
+                        continue
+                    if now - row["beat_t"] > self.ttl_s:
+                        continue
+                    if row.get("boot") != boot:
+                        continue
+                    if int(row.get("gen", 0)) < head_gen - max_lag_gens:
+                        continue
+                    key = (int(row.get("children", 0))
+                           + int(row.get("assigned", 0)), rid)
+                    if best_key is None or key < best_key:
+                        best_key, best = key, row
+        if best is None:
+            return None
+        lock, d = self._stripe(best["id"])
+        with lock:
+            row = d.get(best["id"])
+            if row is not None:
+                row["assigned"] = int(row.get("assigned", 0)) + 1
+        return str(best["addr"])
 
 
 class WeightPublisher:
@@ -153,13 +276,29 @@ class WeightPublisher:
     """
 
     def __init__(self, keep_generations: int = 2,
-                 snapshot: bool = True) -> None:
+                 snapshot: bool = True,
+                 delta: bool = False,
+                 delta_rtol: float = 1e-5,
+                 relay_ttl_s: float = 10.0) -> None:
         self._cond = threading.Condition()
         self._gens: "OrderedDict[int, _Generation]" = OrderedDict()
         self._head: Optional[_Generation] = None
         self._boot = uuid.uuid4().hex[:12]
         self._keep = max(int(keep_generations), 1)
         self._snapshot = snapshot
+        # Quantized delta publication (ISSUE 20). When on, publish()
+        # re-expresses each changed f32 leaf as base + int8-quantized
+        # diff and PUBLISHES THE RECONSTRUCTION (within delta_rtol of
+        # the trainer's leaf, see _delta_substitute) so the delta route
+        # and the full route serve the same bits. Off by default: the
+        # published bytes are then exactly the trainer's, and the delta
+        # routes 404 (subscribers fall back to full fetches silently).
+        self._delta = bool(delta)
+        self._delta_rtol = float(delta_rtol)
+        self._delta_lock = threading.Lock()   # serializes lazy encodes
+        self._relays = _RelayTable(ttl_s=relay_ttl_s)
+        self._children: "OrderedDict[str, float]" = OrderedDict()
+        self._children_lock = threading.Lock()
         self._m: Dict[str, float] = {
             "publish_generations": 0.0,
             "publish_digest_ms_total": 0.0,
@@ -167,8 +306,17 @@ class WeightPublisher:
             "publish_delta_bytes_last": 0.0,
             "publish_payload_bytes_last": 0.0,
             "publish_delta_ratio_last": 1.0,
+            "publish_delta_leaves_last": 0.0,
+            "publish_delta_fallback_leaves_last": 0.0,
+            "publish_delta_wire_bytes_last": 0.0,
+            "publish_delta_encode_ms_total": 0.0,
+            "publish_delta_sets": 0.0,
             "serve_requests": 0.0,
             "serve_bytes_sent": 0.0,
+            "serve_delta_requests": 0.0,
+            "serve_delta_bytes_sent": 0.0,
+            "relay_beats": 0.0,
+            "relay_steers": 0.0,
         }
 
     # ------------------------------------------------------------ publish
@@ -176,7 +324,8 @@ class WeightPublisher:
     def publish(self, state: Any, step: int = 0,
                 generation: Optional[int] = None,
                 digests: Optional[List[int]] = None,
-                boot: Optional[str] = None) -> int:
+                boot: Optional[str] = None,
+                adopt_delta: Optional[dict] = None) -> int:
         """Register ``state`` as the next generation and wake every
         long-polling subscriber. The snapshot is copied on-device first
         (:func:`~torchft_tpu.checkpointing._snapshot_tree`) unless the
@@ -184,13 +333,39 @@ class WeightPublisher:
         trees are already immutable host copies). ``digests`` reuses
         crcs already verified (relays again) — otherwise one batched
         ``device_get`` digest pass runs here, off the commit's critical
-        path. Returns the generation id."""
+        path. Returns the generation id.
+
+        With ``delta=True`` (and no caller-supplied ``digests``), each
+        changed float32 leaf is additionally encoded as an int8+pow2
+        delta against the previous head: the leaf's PUBLISHED content
+        becomes the deterministic reconstruction (within
+        ``delta_rtol``; a leaf int8 cannot resolve publishes exact —
+        see :meth:`_delta_substitute`), so the full route, the delta
+        route, and the manifest digests all describe the same bits.
+        ``adopt_delta`` is the relay propagation path
+        (:meth:`WeightSubscriber.last_delta`): a verified upstream
+        delta set re-served verbatim, attached before the head swap so
+        long-pollers released by this publish already see ``delta:
+        true`` in the head."""
         t0 = time.perf_counter()
         if self._snapshot:
             state = _snapshot_tree(state)
         plan = plan_pytree(state)
+        # Peek at the previous head lock-free: publish() is
+        # single-writer by contract and readers never mutate _head.
+        prev_peek = self._head
+        pending: Optional[Dict[int, tuple]] = None
+        enc_stats = (0, 0, 0, 0.0)
+        if (self._delta and digests is None and prev_peek is not None
+                and prev_peek.boot == (boot or self._boot)
+                and (generation is None
+                     or int(generation) > prev_peek.generation)):
+            state, plan, pending, enc_stats = self._delta_substitute(
+                state, plan, prev_peek)
         digs = list(digests) if digests is not None else plan.digests()
         digest_ms = (time.perf_counter() - t0) * 1e3
+        adopted_set = (self._propagated_delta(adopt_delta)
+                       if adopt_delta else None)
         with self._cond:
             boot = boot or self._boot
             prev = self._head
@@ -216,6 +391,16 @@ class WeightPublisher:
                 prev.manifest if prev is not None else None, manifest)
             rec = _Generation(gen, int(step), boot, state, plan, digs,
                               manifest)
+            if pending and prev is not None:
+                self._finalize_delta(rec, prev, pending)
+            if adopted_set is not None:
+                base_gen, ds = adopted_set
+                if ds.doc.get("boot") == boot and int(
+                        ds.doc.get("generation", -1)) == gen:
+                    rec.deltas[int(base_gen)] = ds
+                    self._m["publish_delta_sets"] += 1
+            while len(rec.deltas) > max(self._keep, 2):
+                rec.deltas.popitem(last=False)
             self._gens[gen] = rec
             self._head = rec
             while len(self._gens) > self._keep:
@@ -231,8 +416,273 @@ class WeightPublisher:
             self._m["publish_delta_ratio_last"] = (
                 delta["changed_bytes"] / delta["total_bytes"]
                 if delta["total_bytes"] else 1.0)
+            self._m["publish_delta_leaves_last"] = float(enc_stats[0])
+            self._m["publish_delta_fallback_leaves_last"] = float(
+                enc_stats[1])
+            self._m["publish_delta_wire_bytes_last"] = float(enc_stats[2])
+            self._m["publish_delta_encode_ms_total"] += enc_stats[3]
             self._cond.notify_all()
         return gen
+
+    # ------------------------------------------------- delta publication
+
+    def _delta_substitute(self, state: Any, plan: Any, prev: _Generation
+                          ) -> Tuple[Any, Any, Dict[int, tuple],
+                                     Tuple[int, int, int, float]]:
+        """Encode each eligible changed f32 leaf as an
+        :class:`~torchft_tpu.communicator.Int8Wire` delta against the
+        previous head and substitute the deterministic RECONSTRUCTION
+        into the published tree. That substitution is what makes the
+        delta bitwise-coherent: an int8 delta of an arbitrary f32
+        update cannot reproduce the trainer's exact new bytes, so the
+        published generation IS the reconstruction — full-route and
+        delta-route fetchers converge on identical bits, and the error
+        does not accumulate across generations because each new delta
+        targets the trainer's TRUE leaves from the previously published
+        base (quantized error feedback, the same discipline as the ring
+        wire's EF residual).
+
+        Per-leaf fallback to exact f32 (the leaf publishes unmodified)
+        when: the leaf or its base is not float32 / shapes differ /
+        non-finite values are present, or the wire's quantization step
+        exceeds ``delta_rtol`` times the leaf's max magnitude — the
+        "dynamic range defeats int8" gate.
+
+        Returns ``(state, plan, pending, (encoded, fallbacks,
+        wire_bytes, encode_ms))`` where ``pending`` maps array-leaf
+        index → ``(base_idx, payload, size, seg_elems)`` for
+        :meth:`_finalize_delta`."""
+        import jax
+
+        t0 = time.perf_counter()
+        entries = [e for e in plan.header["leaves"]
+                   if e["kind"] == "array"]
+        flat_idx = [i for i, e in enumerate(plan.header["leaves"])
+                    if e["kind"] == "array"]
+        prev_arr = [e for e in prev.plan.header["leaves"]
+                    if e["kind"] == "array"]
+        prev_by_key = {e["key"]: j for j, e in enumerate(prev_arr)}
+        leaves, treedef = jax.tree_util.tree_flatten(state)
+        pending: Dict[int, tuple] = {}
+        fallbacks = 0
+        wire_bytes = 0
+        for j, e in enumerate(entries):
+            pj = prev_by_key.get(e["key"])
+            if pj is None:
+                continue
+            pe = prev_arr[pj]
+            if (e["dtype"] != "float32" or pe["dtype"] != "float32"
+                    or list(e["shape"]) != list(pe["shape"])
+                    or int(e["nbytes"]) == 0):
+                continue
+            new_leaf = np.ascontiguousarray(
+                np.asarray(leaves[flat_idx[j]]).reshape(-1),
+                dtype=np.float32)
+            base = np.ascontiguousarray(
+                np.asarray(prev.plan.array_leaves[pj]).reshape(-1),
+                dtype=np.float32)
+            if np.array_equal(new_leaf.view(np.uint32),
+                              base.view(np.uint32)):
+                continue    # bit-identical: the manifest diff carries it
+            if not (np.isfinite(new_leaf).all()
+                    and np.isfinite(base).all()):
+                fallbacks += 1
+                continue    # quantized zeros would silently replace them
+            wire, recon = Int8Wire.delta_encode(base, new_leaf)
+            limit = self._delta_rtol * max(
+                float(np.abs(new_leaf).max(initial=np.float32(0))),
+                1e-30)
+            if wire.max_quant_step() > limit:
+                fallbacks += 1
+                continue    # dynamic range defeats int8: publish exact
+            payload = wire.to_bytes()
+            leaves[flat_idx[j]] = recon.reshape(e["shape"])
+            pending[j] = (pj, payload, wire.size, wire.seg_elems)
+            wire_bytes += len(payload)
+        if pending:
+            state = jax.tree_util.tree_unflatten(treedef, leaves)
+            plan = plan_pytree(state)
+        encode_ms = (time.perf_counter() - t0) * 1e3
+        return state, plan, pending, (len(pending), fallbacks,
+                                      wire_bytes, encode_ms)
+
+    def _finalize_delta(self, rec: _Generation, base: _Generation,
+                        pending: Dict[int, tuple]) -> None:
+        """Assemble the delta document + body for ``rec`` vs ``base``
+        once the published digests exist (called under ``_cond``). Each
+        delta entry's ``crc32`` is the published leaf's manifest digest
+        — a subscriber's reconstruction verifies against the exact same
+        content address a full fetch would."""
+        prev_by_key = {e["key"]: j for j, e in enumerate(
+            [e for e in base.plan.header["leaves"]
+             if e["kind"] == "array"])}
+        arr_entries = [e for e in rec.manifest["leaves"]
+                       if e["kind"] == "array"]
+        body = bytearray()
+        out: List[dict] = []
+        for j, e in enumerate(arr_entries):
+            ent: Dict[str, Any] = {"i": j, "key": e["key"]}
+            pj = prev_by_key.get(e["key"])
+            if (pj is not None and j not in pending
+                    and base.digests[pj] == rec.digests[j]):
+                ent["mode"] = "carry"
+            elif j in pending:
+                pj2, payload, size, seg = pending[j]
+                ent.update(mode="delta", offset=len(body),
+                           nbytes=len(payload), size=int(size),
+                           seg_elems=int(seg),
+                           wire_crc32=zlib.crc32(payload),
+                           base_crc32=int(base.digests[pj2]),
+                           crc32=int(rec.digests[j]))
+                body += payload
+            else:
+                ent["mode"] = "full"
+            out.append(ent)
+        rec.deltas[base.generation] = _DeltaSet(
+            self._delta_doc(rec, base.generation, out, len(body)),
+            bytes(body))
+        self._m["publish_delta_sets"] += 1
+
+    @staticmethod
+    def _delta_doc(rec: _Generation, base_gen: int, leaves: List[dict],
+                   body_len: int) -> dict:
+        return {
+            "format": DELTA_FORMAT,
+            "generation": rec.generation,
+            "base": int(base_gen),
+            "boot": rec.boot,
+            "step": rec.step,
+            "body_len": int(body_len),
+            "data": f"/publish/{rec.generation}/delta/data"
+                    f"?base={int(base_gen)}",
+            "leaves": leaves,
+        }
+
+    def _propagated_delta(self, ld: dict
+                          ) -> Optional[Tuple[int, _DeltaSet]]:
+        """Rebuild an upstream delta set from what a relay actually
+        verified (:meth:`WeightSubscriber.last_delta`): applied leaves
+        keep their wire payloads (re-offset into a fresh body), leaves
+        the relay fell back on are rewritten as ``full`` — a relay
+        never re-serves delta bytes it did not crc-verify and apply
+        itself."""
+        doc = ld.get("doc") or {}
+        payloads = ld.get("payloads") or {}
+        body = bytearray()
+        out: List[dict] = []
+        for ent in doc.get("leaves", ()):
+            j = int(ent.get("i", -1))
+            mode = ent.get("mode")
+            if mode == "delta" and j in payloads:
+                e2 = dict(ent)
+                e2["offset"] = len(body)
+                body += payloads[j]
+                out.append(e2)
+            elif mode == "carry":
+                out.append({"i": j, "key": ent.get("key"),
+                            "mode": "carry"})
+            else:
+                out.append({"i": j, "key": ent.get("key"),
+                            "mode": "full"})
+        base_gen = int(doc.get("base", -1))
+        if base_gen < 0:
+            return None
+        new_doc = {
+            "format": DELTA_FORMAT,
+            "generation": int(doc.get("generation", -1)),
+            "base": base_gen,
+            "boot": doc.get("boot"),
+            "step": int(doc.get("step", 0)),
+            "body_len": len(body),
+            "data": f"/publish/{int(doc.get('generation', -1))}"
+                    f"/delta/data?base={base_gen}",
+            "leaves": out,
+        }
+        return base_gen, _DeltaSet(new_doc, bytes(body))
+
+    def _delta_set(self, rec: _Generation,
+                   base_gen: int) -> Optional[_DeltaSet]:
+        """The delta set of ``rec`` against ``base_gen`` — cached
+        (publish-time encode or relay adoption), else lazily encoded
+        when delta mode is on and the base is still retained. The lazy
+        path serves subscribers that skipped generations: because
+        ``rec``'s published bytes are already fixed, a lazily encoded
+        leaf is kept ONLY when its reconstruction crc-matches the
+        published digest exactly (chained quantized deltas rarely
+        compose exactly, so skip-base sets are typically full-heavy —
+        correct, just not byte-minimal)."""
+        with self._cond:
+            ds = rec.deltas.get(base_gen)
+            base = self._gens.get(base_gen)
+        if ds is not None:
+            return ds
+        if (not self._delta or base is None or base.boot != rec.boot
+                or base.generation >= rec.generation):
+            return None
+        with self._delta_lock:
+            with self._cond:
+                ds = rec.deltas.get(base_gen)
+            if ds is not None:
+                return ds
+            ds = self._encode_exact_delta(rec, base)
+            with self._cond:
+                rec.deltas[base_gen] = ds
+                while len(rec.deltas) > max(self._keep, 2):
+                    rec.deltas.popitem(last=False)
+                self._m["publish_delta_sets"] += 1
+        return ds
+
+    def _encode_exact_delta(self, rec: _Generation,
+                            base: _Generation) -> _DeltaSet:
+        """Lazy encode of ``rec`` vs an arbitrary retained ``base``,
+        gated on exact digest reproduction per leaf (see
+        :meth:`_delta_set`)."""
+        base_arr = [e for e in base.plan.header["leaves"]
+                    if e["kind"] == "array"]
+        base_by_key = {e["key"]: j for j, e in enumerate(base_arr)}
+        arr_entries = [e for e in rec.manifest["leaves"]
+                       if e["kind"] == "array"]
+        body = bytearray()
+        out: List[dict] = []
+        for j, e in enumerate(arr_entries):
+            ent: Dict[str, Any] = {"i": j, "key": e["key"]}
+            pj = base_by_key.get(e["key"])
+            if pj is not None and base.digests[pj] == rec.digests[j]:
+                ent["mode"] = "carry"
+                out.append(ent)
+                continue
+            pe = base_arr[pj] if pj is not None else None
+            if (pe is None or e["dtype"] != "float32"
+                    or pe["dtype"] != "float32"
+                    or list(e["shape"]) != list(pe["shape"])
+                    or int(e["nbytes"]) == 0):
+                ent["mode"] = "full"
+                out.append(ent)
+                continue
+            bleaf = np.ascontiguousarray(
+                np.asarray(base.plan.array_leaves[pj]).reshape(-1),
+                dtype=np.float32)
+            nleaf = np.ascontiguousarray(
+                np.asarray(rec.plan.array_leaves[j]).reshape(-1),
+                dtype=np.float32)
+            wire, recon = Int8Wire.delta_encode(bleaf, nleaf)
+            crc = zlib.crc32(recon.view(np.uint8).data)
+            if crc != int(rec.digests[j]):
+                ent["mode"] = "full"    # not exactly reproducible
+                out.append(ent)
+                continue
+            payload = wire.to_bytes()
+            ent.update(mode="delta", offset=len(body),
+                       nbytes=len(payload), size=wire.size,
+                       seg_elems=wire.seg_elems,
+                       wire_crc32=zlib.crc32(payload),
+                       base_crc32=int(base.digests[pj]),
+                       crc32=int(rec.digests[j]))
+            body += payload
+            out.append(ent)
+        return _DeltaSet(
+            self._delta_doc(rec, base.generation, out, len(body)),
+            bytes(body))
 
     def head(self) -> Optional[dict]:
         """The newest generation's head document (``None`` before the
@@ -252,6 +702,10 @@ class WeightPublisher:
             "total_len": int(rec.plan.total_len),
             "manifest": f"/publish/{rec.generation}/manifest",
             "data": f"/publish/{rec.generation}",
+            # Subscribers only spend a delta request when the head
+            # advertises one could exist (delta mode, or an adopted
+            # relay set) — old-style publishers cost no extra RTT.
+            "delta": bool(self._delta or rec.deltas),
         }
 
     def wait_head(self, after_gen: Optional[int], after_boot: Optional[str],
@@ -276,13 +730,75 @@ class WeightPublisher:
                 self._cond.wait(timeout=remaining)
 
     def metrics(self) -> Dict[str, float]:
+        rows = self.relay_rows()
         with self._cond:
             out = dict(self._m)
             out["publish_generation_last"] = float(
                 self._head.generation if self._head is not None else 0)
             out["publish_step_last"] = float(
                 self._head.step if self._head is not None else 0)
+        out["relays_live"] = float(len(rows))
+        out["relay_children_total"] = float(
+            sum(int(r.get("children", 0)) for r in rows))
+        out["relay_lag_gens_max"] = float(
+            max((int(r.get("lag_gens", 0)) for r in rows), default=0))
+        out["serve_children"] = float(self.children_count())
         return out
+
+    # -------------------------------------------------- relay registry
+
+    def relay_beat(self, row: dict) -> dict:
+        """Record one relay's registration beat (load / staleness /
+        child count) into the lock-striped table; steering and the
+        fleet export read the same rows. Returns the beat ack."""
+        rid = str(row.get("id", "")) or uuid.uuid4().hex[:12]
+        n = self._relays.beat(rid, row)
+        with self._cond:
+            self._m["relay_beats"] += 1
+        return {"ok": True, "relays": n,
+                "ttl_s": self._relays.ttl_s}
+
+    def relay_rows(self) -> List[dict]:
+        """Live relay table rows (TTL-pruned), annotated with
+        ``lag_gens`` against the current head — what ``GET
+        /publish/relays``, the Prometheus fleet families
+        (:meth:`torchft_tpu.fleet.FleetAggregator.note_relays`), and
+        the pod runbook's saturation drill all read."""
+        rows = self._relays.rows()
+        with self._cond:
+            head = self._head
+        for r in rows:
+            if head is not None and r.get("boot") == head.boot:
+                r["lag_gens"] = max(
+                    head.generation - int(r.get("gen", 0)), 0)
+            else:
+                # Another publisher life entirely: the relay is a full
+                # boot behind — count every head generation as lag.
+                r["lag_gens"] = (head.generation if head is not None
+                                 else 0)
+        return rows
+
+    def note_child(self, sub_id: str) -> None:
+        """Track a distinct downstream consumer (head requests carry
+        ``sub=<id>``) for the relay-beat child count and the
+        ``serve_children`` gauge; entries age out with the relay TTL."""
+        now = time.monotonic()
+        with self._children_lock:
+            self._children[sub_id] = now
+            self._children.move_to_end(sub_id)
+            ttl = self._relays.ttl_s
+            while self._children:
+                k, t = next(iter(self._children.items()))
+                if now - t > ttl or len(self._children) > 4096:
+                    del self._children[k]
+                else:
+                    break
+
+    def children_count(self) -> int:
+        now = time.monotonic()
+        with self._children_lock:
+            return sum(1 for t in self._children.values()
+                       if now - t <= self._relays.ttl_s)
 
     # ------------------------------------------------------------- serving
 
@@ -301,12 +817,52 @@ class WeightPublisher:
                         else None)
             wait_boot = qs.get("wait_boot", [None])[0]
             timeout_s = float(qs.get("timeout_s", ["0"])[0])
+            sub_id = qs.get("sub", [None])[0]
+            if sub_id:
+                self.note_child(sub_id)
             head = self.wait_head(wait_gen, wait_boot,
                                   min(timeout_s, send_timeout_sec))
             if head is None:
                 handler.send_error(404, "nothing published yet")
                 return
+            if qs.get("steer", ["0"])[0] == "1":
+                relay = self._relays.pick(
+                    str(head.get("boot", "")),
+                    int(head["generation"]), exclude_id=sub_id)
+                if relay is not None:
+                    head = dict(head)
+                    head["relay"] = relay
+                    with self._cond:
+                        self._m["relay_steers"] += 1
             self._send_json(handler, head, send_timeout_sec)
+            return
+        if path == "/publish/relay/beat":
+            qs = urllib.parse.parse_qs(query)
+            try:
+                row = {
+                    "id": qs["id"][0],
+                    "addr": qs["addr"][0],
+                    "boot": qs.get("boot", [""])[0],
+                    "gen": int(qs.get("gen", ["0"])[0]),
+                    "step": int(qs.get("step", ["0"])[0]),
+                    "children": int(qs.get("children", ["0"])[0]),
+                    "bytes_sent": float(qs.get("bytes_sent", ["0"])[0]),
+                }
+            except (KeyError, ValueError, IndexError):
+                handler.send_error(400, "malformed relay beat")
+                return
+            self._send_json(handler, self.relay_beat(row),
+                            send_timeout_sec)
+            return
+        if path == "/publish/relays":
+            self._send_json(
+                handler, {"relays": self.relay_rows(),
+                          "ttl_s": self._relays.ttl_s},
+                send_timeout_sec)
+            return
+        md = _DELTA_RE.match(path)
+        if md is not None:
+            self._handle_delta(handler, md, query, send_timeout_sec)
             return
         m = _GEN_RE.match(path)
         if m is None:
@@ -329,6 +885,39 @@ class WeightPublisher:
                                   send_timeout_sec)
         with self._cond:
             self._m["serve_bytes_sent"] += sent
+
+    def _handle_delta(self, handler: Any, md: "re.Match", query: str,
+                      send_timeout_sec: float) -> None:
+        """Serve ``GET /publish/<g>/delta?base=<g0>`` (the delta
+        document) and ``…/delta/data?base=<g0>`` (the Range-served
+        Int8Wire body). 404 whenever no delta set exists for the pair —
+        the subscriber's signal to fall back to the full route, same as
+        an evicted generation."""
+        qs = urllib.parse.parse_qs(query)
+        try:
+            base_gen = int(qs["base"][0])
+        except (KeyError, ValueError, IndexError):
+            handler.send_error(400, "delta request needs ?base=<gen>")
+            return
+        with self._cond:
+            self._m["serve_delta_requests"] += 1
+            rec = self._gens.get(int(md.group(1)))
+        if rec is None:
+            handler.send_error(
+                404, f"generation {md.group(1)} unknown or evicted")
+            return
+        ds = self._delta_set(rec, base_gen)
+        if ds is None:
+            handler.send_error(
+                404, f"no delta for base generation {base_gen}")
+            return
+        if md.group(2):
+            sent = _serve_ranged_bytes(handler, memoryview(ds.body),
+                                       send_timeout_sec)
+            with self._cond:
+                self._m["serve_delta_bytes_sent"] += sent
+            return
+        self._send_json(handler, ds.doc, send_timeout_sec)
 
     def _send_json(self, handler: Any, obj: dict,
                    send_timeout_sec: float) -> None:
@@ -460,6 +1049,9 @@ class WeightSubscriber:
                  auth_token: Optional[str] = None,
                  retry_policy: Optional[RetryPolicy] = None,
                  stall_timeout_sec: float = 30.0,
+                 delta: bool = True,
+                 steer: bool = True,
+                 steer_cooldown_s: float = 15.0,
                  name: str = "subscriber") -> None:
         if isinstance(parents, str):
             parents = [parents]
@@ -467,6 +1059,17 @@ class WeightSubscriber:
             raise ValueError("at least one parent address required")
         self._parents = [p.rstrip("/") for p in parents]
         self._parent_idx = 0
+        # Steering (ISSUE 20): the configured parents are the roots we
+        # always fall back to; a head's relay hint prepends a steered
+        # parent, and a steered parent that dies goes on cooldown so
+        # the root's (TTL-stale) table cannot bounce us straight back.
+        self._root_parents = list(self._parents)
+        self._delta_fetch = bool(delta)
+        self._steer = bool(steer)
+        self._steer_cooldown_s = float(steer_cooldown_s)
+        self._steer_bad: Dict[str, float] = {}
+        self._sub_id = uuid.uuid4().hex[:12]
+        self._last_delta: Optional[dict] = None
         self._target = target
         self._dput = device_put_like if device_put else None
         self._poll_interval_s = float(poll_interval_s)
@@ -514,6 +1117,11 @@ class WeightSubscriber:
             "serve_parent_failovers": 0.0,
             "serve_sync_errors": 0.0,
             "serve_digest_rejects": 0.0,
+            "serve_delta_leaves_last": 0.0,
+            "serve_delta_wire_bytes_total": 0.0,
+            "serve_delta_crc_fallbacks": 0.0,
+            "serve_delta_syncs": 0.0,
+            "serve_steers": 0.0,
         }
 
     # -------------------------------------------------------------- readers
@@ -610,6 +1218,8 @@ class WeightSubscriber:
         adopted: Optional[tuple] = None     # (boot, gen) session follows
         adopted_mf: Optional[dict] = None
         carried = 0
+        delta_tried = False
+        self._last_delta = None
         while True:
             addr = self._parents[self._parent_idx]
             endpoint = _serve_endpoint(addr)
@@ -633,6 +1243,8 @@ class WeightSubscriber:
                 empty_heads = 0
                 held = self._held
                 self._note_head(head)
+                if session is None and self._maybe_steer(head, addr):
+                    continue    # re-parented onto the hinted relay
                 stale_boot = (held is not None and
                               head.get("boot") in self._left_boots)
                 if (held is not None
@@ -691,6 +1303,18 @@ class WeightSubscriber:
                     adopted = (boot, gen)
                     adopted_mf = mf
                     carried = self._preseed(session, held)
+                    delta_tried = False
+                if (not delta_tried and self._delta_fetch
+                        and head.get("delta") and held is not None
+                        and held.boot == boot and not session.complete()):
+                    # Quantized-delta leg, once per adopted generation:
+                    # every leaf it verifies+commits never rides the
+                    # full span fetch; anything it cannot verify stays
+                    # missing and falls back to exact f32 below (the
+                    # per-leaf fallback). Transport failures here
+                    # classify exactly like span-fetch failures.
+                    delta_tried = True
+                    self._fetch_delta(addr, endpoint, session, held, gen)
                 if not session.complete():
                     session.rounds += 1
                     for span in session.spans():
@@ -730,6 +1354,11 @@ class WeightSubscriber:
                 no_progress = 0 if progressed else no_progress + 1
                 if dead or no_progress >= attempts:
                     rotations += 1
+                    if addr not in self._root_parents:
+                        # A steered relay went bad: cooldown before the
+                        # root's (TTL-stale) table can hint it again.
+                        self._steer_bad[addr] = (
+                            time.monotonic() + self._steer_cooldown_s)
                     if rotations > len(self._parents):
                         with self._lock:
                             self._m["serve_sync_errors"] += 1
@@ -753,6 +1382,163 @@ class WeightSubscriber:
                 time.sleep(delay)
 
     # ------------------------------------------------------------- plumbing
+
+    def _maybe_steer(self, head: dict, addr: str) -> bool:
+        """Act on a head's relay hint: re-parent onto the hinted relay
+        (it becomes parents[0]; the configured roots stay as
+        last-resort fallbacks, the relay-death re-parenting path).
+        Returns True when the parent list changed — the sync loop
+        restarts its round against the new parent. Hints to a
+        cooled-down relay (one we just classified dead) are ignored
+        until the root's TTL catches up."""
+        hint = head.get("relay") if self._steer else None
+        if not hint:
+            return False
+        hint = str(hint).rstrip("/")
+        now = time.monotonic()
+        self._steer_bad = {a: t for a, t in self._steer_bad.items()
+                           if t > now}
+        if (hint == addr or hint in self._steer_bad
+                or hint == self._parents[self._parent_idx]):
+            return False
+        self._parents = [hint] + [p for p in self._root_parents
+                                  if p != hint]
+        self._parent_idx = 0
+        with self._lock:
+            self._m["serve_steers"] += 1
+        logger.info("%s: steered to relay %s", self._name, hint)
+        return True
+
+    def last_delta(self) -> Optional[dict]:
+        """The delta set verified and applied by the most recent sync
+        (``None`` when the sync was full-fetch): the upstream document
+        plus the raw wire payloads actually applied, keyed by array
+        index — what a relay hands to
+        :meth:`WeightPublisher.publish`'s ``adopt_delta`` so the
+        quantized bytes propagate down the tree without re-encoding
+        (re-quantizing a reconstruction is NOT bitwise; propagation
+        is)."""
+        return self._last_delta
+
+    def _fetch_delta(self, addr: str, endpoint: str,
+                     session: _HealSession, held: _Held,
+                     gen: int) -> None:
+        """Fetch + apply the quantized delta document for ``gen``
+        against the held generation. Per leaf: verify the wire payload
+        crc, reconstruct with the ONE shared spelling
+        (:meth:`~torchft_tpu.communicator.Int8Wire.delta_apply`), and
+        verify the reconstruction against the full manifest digest
+        before committing — so the torn-read and bitwise guarantees are
+        exactly the full-fetch path's. Any leaf that fails stays
+        missing (counted in ``serve_delta_crc_fallbacks``) and is
+        fetched as exact f32 by the caller's span loop. A 404 (no
+        delta for this base / old publisher) returns quietly."""
+        url = f"{addr}/{gen}/delta?base={held.generation}"
+        try:
+            doc = _fetch_json(url, self._stall, self._auth_token,
+                              pool=self._pool)
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                return    # no delta for this base / pre-delta publisher
+            raise
+        if (doc.get("format") != DELTA_FORMAT
+                or doc.get("boot") != held.boot
+                or int(doc.get("base", -1)) != held.generation
+                or int(doc.get("generation", -1)) != gen):
+            return    # not the delta we asked for: full path covers it
+        wanted: List[tuple] = []
+        for ent in doc.get("leaves", ()):
+            if ent.get("mode") != "delta":
+                continue
+            try:
+                j = int(ent["i"])
+                if not 0 <= j < len(session.arr_order):
+                    continue
+                pi = session.arr_order[j]
+                if pi in session.committed or pi not in held.leaves:
+                    continue
+                entry = session.pairs[pi][0]
+                # The delta entry must describe the adopted manifest's
+                # exact content AND our held base's exact content —
+                # digests are content addresses, so either mismatch
+                # means this wire would reconstruct the wrong bytes.
+                if int(entry.get("crc32", -1)) != int(ent["crc32"]):
+                    continue
+                if held.crcs.get(pi) != int(ent["base_crc32"]):
+                    continue
+                wanted.append((int(ent["offset"]), int(ent["nbytes"]),
+                               int(ent["size"]), int(ent["seg_elems"]),
+                               int(ent["wire_crc32"]), int(ent["crc32"]),
+                               j, pi))
+            except (KeyError, ValueError, TypeError):
+                continue
+        if not wanted:
+            return
+        wanted.sort()
+        spans: List[list] = []
+        for w in wanted:
+            off, nbytes = w[0], w[1]
+            if spans and spans[-1][1] == off:
+                spans[-1][1] = off + nbytes
+                spans[-1][2].append(w)
+            else:
+                spans.append([off, off + nbytes, [w]])
+        data_url = (f"{addr}/{gen}/delta/data"
+                    f"?base={held.generation}")
+        applied: Dict[int, bytes] = {}
+        fallbacks = 0
+        wire_bytes = 0
+        for a, b, items in spans:
+            tok = chaos.begin(endpoint, "fetch")
+            resp = _open_url(data_url, self._stall, self._auth_token,
+                             headers={"Range": f"bytes={a}-{b - 1}"},
+                             pool=self._pool)
+            counter = [0]
+            try:
+                reader = _CountingReader(
+                    chaos.wrap_reader(resp, endpoint), counter)
+                status = getattr(resp, "status", None) or resp.getcode()
+                if status == 200 and a > 0:
+                    remaining = a
+                    while remaining > 0:
+                        chunk = reader.read(min(1 << 20, remaining))
+                        if not chunk:
+                            raise ValueError(
+                                "truncated publication delta stream")
+                        remaining -= len(chunk)
+                for (off, nbytes, size, seg, wire_crc, crc, j, pi) \
+                        in items:
+                    buf = bytearray(nbytes)
+                    _read_exact_into(reader, memoryview(buf))
+                    if zlib.crc32(buf) != wire_crc:
+                        fallbacks += 1
+                        continue    # stays missing: exact-f32 fallback
+                    wire = Int8Wire.from_bytes(bytes(buf), size, seg)
+                    entry = session.pairs[pi][0]
+                    recon = Int8Wire.delta_apply(
+                        held.leaves[pi], wire).reshape(entry["shape"])
+                    got = zlib.crc32(
+                        recon.reshape(-1).view(np.uint8).data)
+                    if got != crc:
+                        fallbacks += 1
+                        continue    # stays missing: exact-f32 fallback
+                    session.commit(pi, recon, got, donor=addr)
+                    applied[j] = bytes(buf)
+                    wire_bytes += nbytes
+            finally:
+                resp.close()
+                session.note_bytes(counter[0])
+            chaos.end(tok)
+        with self._lock:
+            self._m["serve_delta_leaves_last"] = float(len(applied))
+            self._m["serve_delta_wire_bytes_total"] += wire_bytes
+            self._m["serve_delta_crc_fallbacks"] += fallbacks
+            if applied:
+                self._m["serve_delta_syncs"] += 1
+        if applied:
+            self._last_delta = {"gen": gen, "base": held.generation,
+                                "boot": held.boot, "doc": doc,
+                                "payloads": applied}
 
     def _note_head(self, head: dict) -> None:
         with self._lock:
@@ -804,10 +1590,16 @@ class WeightSubscriber:
     def _fetch_head(self, addr: str, endpoint: str,
                     wait_s: float) -> Optional[dict]:
         held = self._held
-        q = ""
+        params: List[tuple] = []
         if wait_s > 0 and held is not None:
-            q = (f"?wait_gen={held.generation}&wait_boot={held.boot}"
-                 f"&timeout_s={wait_s:g}")
+            params += [("wait_gen", held.generation),
+                       ("wait_boot", held.boot),
+                       ("timeout_s", f"{wait_s:g}")]
+        if self._steer:
+            # Opt into relay steering and identify ourselves so the
+            # publisher's child-count gauge sees distinct consumers.
+            params += [("steer", "1"), ("sub", self._sub_id)]
+        q = ("?" + urllib.parse.urlencode(params)) if params else ""
         with self._lock:
             self._m["serve_head_polls"] += 1
         tok = chaos.begin(endpoint, "head")
@@ -931,6 +1723,13 @@ class WeightSubscriber:
                 # loop against a broken parent.
                 self._stop_ev.wait(0.01)
 
+    def request_stop(self) -> None:
+        """Signal the poll loop to exit without waiting for it — fleet
+        teardown signals EVERY subscriber first, then joins each via
+        :meth:`stop`, so a hundred parked long-polls unwind
+        concurrently instead of serializing one join apiece."""
+        self._stop_ev.set()
+
     def stop(self) -> None:
         self._stop_ev.set()
         t, self._thread = self._thread, None
@@ -959,18 +1758,59 @@ class WeightRelay(WeightSubscriber):
     reused (already verified leaf-by-leaf on the way in), so relaying
     costs zero re-hashing; generation identity propagating unchanged is
     what makes a downstream failover between this relay and the root
-    publisher seamless."""
+    publisher seamless.
+
+    Self-organization (docs/design/serving.md): when ``register`` is
+    on, a daemon thread beats ``GET <parent>/relay/beat`` every
+    ``beat_interval_s`` carrying this relay's address, held
+    boot/generation/step, downstream child count, and bytes served —
+    the rows the parent's steering pick and the fleet's Prometheus
+    export both read. Relays beat their *current* parent, so a relay
+    subscribed to another relay registers there, and the tree deepens
+    without configuration. Steering is OFF for the relay's own upstream
+    fetch (``steer=False``): a steered relay could be pointed at a peer
+    relay and form a cycle; relays pin to their configured parents and
+    rely on the existing rotation for failover.
+
+    Delta propagation: the verified wire payloads of each upstream
+    delta sync are handed to the relay's publisher via ``adopt_delta``
+    (re-quantizing a reconstruction is NOT bitwise — propagating the
+    exact payloads is), so downstream subscribers get the same ~4×
+    byte saving without the relay re-encoding anything."""
 
     def __init__(self, parents: Any, target: Any,
                  bind_host: str = "0.0.0.0",
                  keep_generations: int = 2,
-                 name: str = "relay", **kw: Any) -> None:
+                 name: str = "relay",
+                 register: bool = True,
+                 beat_interval_s: float = 2.0,
+                 relay_id: Optional[str] = None,
+                 advertise: Optional[str] = None,
+                 relay_ttl_s: float = 10.0, **kw: Any) -> None:
+        kw.setdefault("steer", False)
         super().__init__(parents, target, name=name, **kw)
+        # Registered (steering-visible) address override — what a relay
+        # behind a proxy/NAT tells the parent to steer children to;
+        # default the bound server's own address.
+        self._advertise = advertise.rstrip("/") if advertise else None
         self._relay_publisher = WeightPublisher(
-            keep_generations=keep_generations, snapshot=False)
+            keep_generations=keep_generations, snapshot=False,
+            delta=True, relay_ttl_s=relay_ttl_s)
         self._relay_server = PublicationServer(
             self._relay_publisher, bind_host=bind_host,
             auth_token=self._auth_token)
+        self._relay_id = relay_id or f"relay-{uuid.uuid4().hex[:12]}"
+        # Head requests identify the relay by its relay id, so the
+        # parent's child gauge and the steering exclude-requester rule
+        # see one consistent identity.
+        self._sub_id = self._relay_id
+        self._register = bool(register)
+        self._beat_interval_s = float(beat_interval_s)
+        self._beat_stop = threading.Event()
+        self._beat_thread: Optional[threading.Thread] = None
+        with self._lock:
+            self._m["relay_beats_sent"] = 0.0
+            self._m["relay_beat_failures"] = 0.0
 
     def address(self) -> str:
         """Downstream-facing base URL (``…/publish``)."""
@@ -978,6 +1818,15 @@ class WeightRelay(WeightSubscriber):
 
     def publisher(self) -> WeightPublisher:
         return self._relay_publisher
+
+    def relay_id(self) -> str:
+        return self._relay_id
+
+    def set_advertise(self, addr: Optional[str]) -> None:
+        """(Re)set the registered address (see ``advertise``) — for
+        rigs that front the relay with a proxy they only know after
+        construction."""
+        self._advertise = addr.rstrip("/") if addr else None
 
     def metrics(self) -> Dict[str, float]:
         out = super().metrics()
@@ -987,16 +1836,72 @@ class WeightRelay(WeightSubscriber):
 
     def _on_generation(self, held: _Held,
                        body_digests: List[int]) -> None:
+        ld = self.last_delta()
+        if ld is not None and (ld["gen"] != held.generation
+                               or ld["boot"] != held.boot):
+            ld = None
         self._relay_publisher.publish(
             held.tree, step=held.step, generation=held.generation,
-            digests=body_digests, boot=held.boot)
+            digests=body_digests, boot=held.boot, adopt_delta=ld)
+
+    # --------------------------------------------------- registration
+
+    def _beat_once(self) -> dict:
+        """One registration beat to the current parent. Raises on
+        transport failure (the loop counts it; a dead parent's table
+        row simply ages out at the parent that remains)."""
+        held = self._held
+        pub = self._relay_publisher
+        pm = pub.metrics()
+        params = [
+            ("id", self._relay_id),
+            ("addr", self._advertise or self.address()),
+            ("boot", held.boot if held is not None else ""),
+            ("gen", str(held.generation if held is not None else -1)),
+            ("step", str(held.step if held is not None else 0)),
+            ("children", str(pub.children_count())),
+            ("bytes_sent", str(pm.get("serve_bytes_sent", 0.0))),
+        ]
+        parent = self._parents[self._parent_idx % len(self._parents)]
+        url = (f"{parent}/relay/beat?"
+               f"{urllib.parse.urlencode(params)}")
+        # One-shot (no shared pool): the sync loop owns the pooled
+        # parent connection; beats must never interleave with it.
+        return _fetch_json(url, self._stall, self._auth_token)
+
+    def _beat_loop(self) -> None:
+        while not self._beat_stop.is_set():
+            try:
+                self._beat_once()
+                with self._lock:
+                    self._m["relay_beats_sent"] += 1
+            except Exception:  # noqa: BLE001 — keep beating
+                with self._lock:
+                    self._m["relay_beat_failures"] += 1
+            if self._beat_stop.wait(self._beat_interval_s):
+                return
+
+    def start(self) -> "WeightRelay":
+        super().start()
+        if self._register and self._beat_thread is None:
+            self._beat_stop.clear()
+            self._beat_thread = threading.Thread(
+                target=self._beat_loop, daemon=True,
+                name=f"{self._name}-beat")
+            self._beat_thread.start()
+        return self
 
     def stop(self) -> None:
+        self._beat_stop.set()
+        t, self._beat_thread = self._beat_thread, None
+        if t is not None:
+            t.join(timeout=self._stall + 5)
         super().stop()
         self._relay_server.shutdown()
 
 
 __all__ = [
+    "DELTA_FORMAT",
     "HEAD_FORMAT",
     "PublicationServer",
     "StaleWeightsError",

@@ -163,6 +163,21 @@ def note_ring_bytes(nbytes: int) -> None:
     _counters.note(QoS.RING, nbytes)
 
 
+def set_nodelay(sock: Optional[socket.socket]) -> None:
+    """Best-effort ``TCP_NODELAY`` on a substrate socket. The byte
+    paths are request/response over keep-alive connections: with Nagle
+    on, every small head/manifest/delta-doc exchange can stall a
+    delayed-ACK interval (~40ms) — several round trips per sync, it
+    dominates publish-to-visible latency. Failures are ignored (unix
+    sockets, platforms without the knob)."""
+    if sock is None:
+        return
+    try:
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    except (OSError, AttributeError):
+        pass
+
+
 def mark_socket(sock: socket.socket, qos: QoS) -> None:
     """Best-effort kernel-level priority tag for a raw byte-path socket
     (IP DSCP + Linux ``SO_PRIORITY``); failures are ignored — QoS
@@ -578,8 +593,10 @@ class ConnectionPool:
                                                   timeout=stall)
             try:
                 conn.timeout = stall
-                if conn.sock is not None:
-                    conn.sock.settimeout(stall)
+                if conn.sock is None:
+                    conn.connect()
+                    set_nodelay(conn.sock)
+                conn.sock.settimeout(stall)
                 conn.request(method, path, headers=hdrs)
                 resp = conn.getresponse()
                 break
@@ -678,6 +695,8 @@ def push_ranged(base_url: str, path: str, view: memoryview,
                                       timeout=timeout_sec)
     pushed = 0
     try:
+        conn.connect()
+        set_nodelay(conn.sock)
         for start, end in chunk_spans(total, chunk_bytes):
             if fault is not None:
                 fault()
@@ -1209,6 +1228,7 @@ class _AsyncHTTPServer:
         if self.closing:
             writer.close()
             return
+        set_nodelay(writer.get_extra_info("socket"))
         _counters.bump("conns")
         conn = _AsyncConnection(self.core, self, reader, writer)
         self.conns.add(conn)
@@ -1256,6 +1276,9 @@ class _ThreadedHTTPHost(ThreadingHTTPServer):
                  route: Callable[[Any], None], name: str) -> None:
         class Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
+            # Keep-alive request/response pairs: Nagle + delayed-ACK
+            # stalls dominate small-exchange latency (see set_nodelay).
+            disable_nagle_algorithm = True
 
             def log_message(self, fmt: str, *args: Any) -> None:
                 logger.debug("transport http: " + fmt, *args)
